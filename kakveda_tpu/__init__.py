@@ -1,0 +1,20 @@
+"""kakveda-tpu: a TPU-native LLM failure-intelligence platform.
+
+A ground-up JAX/XLA re-design of the capabilities of
+``prateekdevisingh/kakveda`` (see SURVEY.md): traces are ingested and
+classified into failures, failures are canonicalized into a Global Failure
+Knowledge Base (GFKB), recurring failures become patterns, new executions get
+pre-flight "this failed before" warnings via similarity matching, and per-app
+health is scored over time.
+
+Where the reference runs nine FastAPI containers talking JSON-over-HTTP with
+a per-query TF-IDF refit over a JSONL file, this framework keeps one
+device-resident intelligence core: hashed n-gram failure embeddings, a
+sharded HBM-resident GFKB index answering cosine-kNN pre-flight matches, batch
+clustering for pattern mining, and an in-tree JAX Llama replacing the Ollama
+HTTP model calls — all sharded with ``jax.sharding`` over a TPU mesh. A thin
+host service layer (aiohttp) keeps the reference's external REST/event
+contracts.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,1 @@
+"""kakveda-tpu command line interface."""

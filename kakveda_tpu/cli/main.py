@@ -1,0 +1,165 @@
+"""`kakveda-tpu` CLI: init | up | down | status | reset | logs | doctor | version.
+
+Verb parity with the reference CLI (reference: kakveda_cli/cli.py:46-409),
+re-targeted at the single-process TPU platform: where the reference
+orchestrates a 9-container docker-compose stack, `up` here starts the
+in-process service layer (all reference REST contracts on one port) and
+`doctor` checks the JAX/TPU environment instead of the Docker daemon.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+from pathlib import Path
+
+
+def _cmd_version(args: argparse.Namespace) -> int:
+    from kakveda_tpu import __version__
+
+    print(f"kakveda-tpu {__version__}")
+    return 0
+
+
+def _cmd_init(args: argparse.Namespace) -> int:
+    from kakveda_tpu.core.config import write_default_config
+
+    root = Path(args.dir)
+    cfg = root / "config" / "config.yaml"
+    if cfg.exists() and not args.force:
+        print(f"config already exists at {cfg} (use --force to overwrite)")
+    else:
+        write_default_config(cfg)
+        print(f"wrote {cfg}")
+    (root / "data").mkdir(parents=True, exist_ok=True)
+    print(f"data dir ready at {root / 'data'}")
+    return 0
+
+
+def _cmd_doctor(args: argparse.Namespace) -> int:
+    checks = []
+
+    def check(name, fn):
+        try:
+            detail = fn()
+            checks.append((name, True, detail))
+        except Exception as e:  # noqa: BLE001 — doctor reports, never crashes
+            checks.append((name, False, f"{type(e).__name__}: {e}"))
+
+    def _jax():
+        import jax
+
+        return f"{jax.__version__} backend={jax.default_backend()} devices={len(jax.devices())}"
+
+    check("python", lambda: sys.version.split()[0])
+    check("jax", _jax)
+    check("config", lambda: str(Path(os.environ.get("KAKVEDA_CONFIG_PATH", "config/config.yaml")).resolve()))
+    check("data dir writable", lambda: _writable(os.environ.get("KAKVEDA_DATA_DIR", "data")))
+
+    ok = all(c[1] for c in checks)
+    for name, good, detail in checks:
+        print(f"[{'ok' if good else 'FAIL'}] {name}: {detail}")
+    return 0 if ok else 1
+
+
+def _writable(d: str) -> str:
+    p = Path(d)
+    p.mkdir(parents=True, exist_ok=True)
+    probe = p / ".probe"
+    probe.write_text("ok")
+    probe.unlink()
+    return str(p.resolve())
+
+
+def _cmd_reset(args: argparse.Namespace) -> int:
+    root = Path(args.dir)
+    data = root / "data"
+    if not data.exists():
+        print(f"nothing to reset at {data}")
+        return 0
+    if not args.yes:
+        print(f"would delete {data} — re-run with --yes to confirm")
+        return 1
+    shutil.rmtree(data)
+    print(f"deleted {data}")
+    return 0
+
+
+def _cmd_status(args: argparse.Namespace) -> int:
+    data = Path(args.dir) / "data"
+    status = {"data_dir": str(data), "exists": data.exists()}
+    for name in ("failures", "patterns", "health"):
+        f = data / f"{name}.jsonl"
+        status[name] = sum(1 for ln in f.read_text().splitlines() if ln.strip()) if f.exists() else 0
+    print(json.dumps(status, indent=2))
+    return 0
+
+
+def _cmd_up(args: argparse.Namespace) -> int:
+    try:
+        from kakveda_tpu.service.main import run_server
+    except ImportError:
+        print("the HTTP service layer is not available in this build", file=sys.stderr)
+        return 1
+    return run_server(host=args.host, port=args.port, data_dir=str(Path(args.dir) / "data"))
+
+
+def _cmd_down(args: argparse.Namespace) -> int:
+    print("kakveda-tpu runs in the foreground; stop it with Ctrl-C or your process manager")
+    return 0
+
+
+def _cmd_logs(args: argparse.Namespace) -> int:
+    print("logs stream to stdout of the `up` process (KAKVEDA_LOG_FORMAT=json|text)")
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="kakveda-tpu", description=__doc__.splitlines()[0])
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    sp = sub.add_parser("init", help="write default config + create data dir")
+    sp.add_argument("--dir", default=".", help="project root")
+    sp.add_argument("--force", action="store_true")
+    sp.set_defaults(fn=_cmd_init)
+
+    sp = sub.add_parser("up", help="start the platform server")
+    sp.add_argument("--dir", default=".")
+    sp.add_argument("--host", default="127.0.0.1")
+    sp.add_argument("--port", type=int, default=8100)
+    sp.set_defaults(fn=_cmd_up)
+
+    sp = sub.add_parser("down", help="how to stop the server")
+    sp.set_defaults(fn=_cmd_down)
+
+    sp = sub.add_parser("status", help="show data-store row counts")
+    sp.add_argument("--dir", default=".")
+    sp.set_defaults(fn=_cmd_status)
+
+    sp = sub.add_parser("reset", help="delete local data stores")
+    sp.add_argument("--dir", default=".")
+    sp.add_argument("--yes", action="store_true")
+    sp.set_defaults(fn=_cmd_reset)
+
+    sp = sub.add_parser("logs", help="where logs go")
+    sp.set_defaults(fn=_cmd_logs)
+
+    sp = sub.add_parser("doctor", help="check the runtime environment")
+    sp.set_defaults(fn=_cmd_doctor)
+
+    sp = sub.add_parser("version", help="print version")
+    sp.set_defaults(fn=_cmd_version)
+
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,36 @@
+"""Shared host-side kernel: schemas, fingerprinting, config, runtime.
+
+TPU-native counterpart of the reference's ``services/shared/`` package
+(reference: services/shared/models.py, fingerprint.py, config.py,
+runtime.py).
+"""
+
+from kakveda_tpu.core.schemas import (  # noqa: F401
+    CanonicalFailureRecord,
+    FailureMatch,
+    FailureMatchRequest,
+    FailureMatchResponse,
+    FailureSignal,
+    HealthPoint,
+    IngestRequest,
+    PatternEntity,
+    Severity,
+    TracePayload,
+    WarningRequest,
+    WarningResponse,
+)
+from kakveda_tpu.core.fingerprint import (  # noqa: F401
+    CitationCheck,
+    detect_citation_markers,
+    fingerprint,
+    normalize_prompt,
+    prompt_intent_tags,
+    signature_text,
+)
+from kakveda_tpu.core.config import ConfigStore  # noqa: F401
+from kakveda_tpu.core.runtime import (  # noqa: F401
+    RuntimeConfig,
+    ensure_request_id,
+    get_runtime_config,
+    setup_logging,
+)

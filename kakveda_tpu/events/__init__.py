@@ -1,0 +1,3 @@
+"""Host-side event plane."""
+
+from kakveda_tpu.events.bus import EventBus, TOPIC_TRACE_INGESTED, TOPIC_FAILURE_DETECTED, TOPIC_CHILD_SAFETY  # noqa: F401

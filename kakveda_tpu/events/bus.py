@@ -1,0 +1,90 @@
+"""In-process asyncio event bus with the reference's pub/sub contract.
+
+The reference runs a dedicated event-bus container doing HTTP fan-out with
+volatile in-memory subscriptions — best-effort, at-most-once, exceptions
+dropped on the floor, subscriptions lost on restart
+(reference: services/event_bus/app.py:25-54). Here the intelligence pipeline
+is in-process, so delivery to local subscribers is a function call with
+structured error accounting; remote integrations (external agents,
+dashboards in other processes) subscribe with a callback URL and get the
+same HTTP POST contract the reference speaks. Device-side propagation
+(index shard updates) rides XLA collectives, not this bus — see
+kakveda_tpu.parallel.
+
+Improvements over the reference, deliberate: delivery results are reported
+(not silently swallowed), and local handlers are awaited with a timeout so
+one stuck consumer can't wedge the fan-out.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any, Awaitable, Callable, Dict, List, Union
+
+log = logging.getLogger("kakveda.events")
+
+TOPIC_TRACE_INGESTED = "trace.ingested"
+TOPIC_FAILURE_DETECTED = "failure.detected"
+TOPIC_CHILD_SAFETY = "child_safety_alert"
+
+Handler = Callable[[dict], Union[Awaitable[Any], Any]]
+
+
+class EventBus:
+    """Topic → subscriber fan-out. Subscribers are async/sync callables or
+    HTTP callback URLs (the reference's external contract)."""
+
+    def __init__(self, delivery_timeout: float = 3.0):
+        self._subs: Dict[str, List[Union[Handler, str]]] = {}
+        self.delivery_timeout = delivery_timeout
+
+    def subscribe(self, topic: str, handler: Union[Handler, str]) -> int:
+        subs = self._subs.setdefault(topic, [])
+        if handler not in subs:
+            subs.append(handler)
+        return len(subs)
+
+    def unsubscribe(self, topic: str, handler: Union[Handler, str]) -> None:
+        subs = self._subs.get(topic, [])
+        if handler in subs:
+            subs.remove(handler)
+
+    def topics(self) -> Dict[str, int]:
+        return {k: len(v) for k, v in self._subs.items()}
+
+    async def _deliver(self, sub: Union[Handler, str], event: dict) -> bool:
+        try:
+            if isinstance(sub, str):
+                import httpx
+
+                async with httpx.AsyncClient(timeout=self.delivery_timeout) as client:
+                    await client.post(sub, json=event)
+                return True
+            if asyncio.iscoroutinefunction(sub):
+                await asyncio.wait_for(sub(event), timeout=self.delivery_timeout)
+            else:
+                # Sync handlers run in the executor so a blocking consumer
+                # can't wedge the loop, with the same delivery timeout.
+                loop = asyncio.get_running_loop()
+                result = await asyncio.wait_for(
+                    loop.run_in_executor(None, sub, event), timeout=self.delivery_timeout
+                )
+                if asyncio.iscoroutine(result):  # sync factory returning a coroutine
+                    await asyncio.wait_for(result, timeout=self.delivery_timeout)
+            return True
+        except Exception as e:  # noqa: BLE001 — fan-out must not break on one subscriber
+            log.warning("event delivery failed: %s -> %r: %s", type(e).__name__, sub, e)
+            return False
+
+    async def publish(self, topic: str, event: dict) -> int:
+        """Fan out to all subscribers concurrently; returns delivered count."""
+        subs = list(self._subs.get(topic, []))
+        if not subs:
+            return 0
+        results = await asyncio.gather(*[self._deliver(s, event) for s in subs])
+        return sum(results)
+
+    def publish_sync(self, topic: str, event: dict) -> int:
+        """Publish from synchronous code (spins a private loop)."""
+        return asyncio.run(self.publish(topic, event))

@@ -1,0 +1,3 @@
+"""Device-resident Global Failure Knowledge Base."""
+
+from kakveda_tpu.index.gfkb import GFKB  # noqa: F401

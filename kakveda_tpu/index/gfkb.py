@@ -1,0 +1,369 @@
+"""Global Failure Knowledge Base — the framework's center of gravity.
+
+Capability parity with the reference GFKB service
+(reference: services/gfkb/app.py:23-198): append-only JSONL persistence with
+versioning-by-append, ``F-%04d``/``FP-%04d`` id minting, top-k similarity
+match, and pattern upsert with identity-by-name. Re-designed TPU-first:
+
+  * every canonical failure's ``signature_text`` is embedded once at upsert
+    time (hashed n-grams, kakveda_tpu.ops.featurizer) and lives in an
+    HBM-resident [capacity, dim] matrix sharded over the mesh's ``data``
+    axis — instead of the reference's read-the-whole-file + TF-IDF-refit per
+    match request (reference: services/gfkb/app.py:54-56,81-89);
+  * a match is one compiled matmul + sharded top-k (kakveda_tpu.ops.knn),
+    batched across concurrent queries;
+  * the index is fully replayable from ``failures.jsonl`` (checkpoint =
+    the append log, mirroring the reference's durability-by-append design).
+
+Deliberate deviations from the reference, both documented here:
+  * id minting counts *canonical* failures, not JSONL rows — the reference
+    mints ``F-{len(rows)+1}`` so version appends create id gaps
+    (reference: services/gfkb/app.py:117); here ids are dense.
+  * the reference applies the ``failure_type`` filter *after* truncating to
+    top-5 so a type-filtered query can return fewer (or zero) matches even
+    when matching failures exist (reference: services/gfkb/app.py:89-91).
+    ``type_filter="post"`` (default) preserves that observable behavior;
+    a device-side pre-selection mask is planned as a follow-up.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+from jax.sharding import Mesh
+
+from kakveda_tpu.core.schemas import (
+    CanonicalFailureRecord,
+    FailureMatch,
+    PatternEntity,
+    Severity,
+    utcnow,
+)
+from kakveda_tpu.ops.featurizer import HashedNGramFeaturizer
+from kakveda_tpu.ops.knn import ShardedKnn, batch_bucket
+from kakveda_tpu.parallel.mesh import create_mesh
+
+
+class GFKB:
+    """Failure + pattern store with a device-resident similarity index."""
+
+    def __init__(
+        self,
+        data_dir: str | Path = "data",
+        mesh: Optional[Mesh] = None,
+        capacity: int = 1 << 14,
+        dim: int = 2048,
+        top_k: int = 5,
+        featurizer: Optional[HashedNGramFeaturizer] = None,
+        persist: bool = True,
+    ):
+        self.data_dir = Path(data_dir)
+        self.persist = persist
+        if persist:
+            self.data_dir.mkdir(parents=True, exist_ok=True)
+        self.failures_path = self.data_dir / "failures.jsonl"
+        self.patterns_path = self.data_dir / "patterns.jsonl"
+
+        self.mesh = mesh if mesh is not None else create_mesh("data:-1")
+        self.featurizer = featurizer or HashedNGramFeaturizer(dim=dim)
+        self.top_k = top_k
+        self._knn = ShardedKnn(self.mesh, capacity, dim, k=top_k)
+        self._emb, self._valid = self._knn.alloc()
+
+        # Host-side metadata: one entry per canonical failure, slot-aligned.
+        self._records: List[CanonicalFailureRecord] = []
+        self._slot_by_key: Dict[Tuple[str, str], int] = {}
+        self._patterns: Dict[str, PatternEntity] = {}  # name -> latest
+        self._lock = threading.Lock()
+
+        if persist:
+            self._replay()
+
+    # ------------------------------------------------------------------
+    # persistence
+    # ------------------------------------------------------------------
+
+    def _append_jsonl(self, path: Path, obj: dict) -> None:
+        if not self.persist:
+            return
+        with path.open("a", encoding="utf-8") as f:
+            f.write(json.dumps(obj, ensure_ascii=False) + "\n")
+
+    def _replay(self) -> None:
+        """Rebuild host metadata + device index from the append logs."""
+        if self.failures_path.exists():
+            latest: Dict[Tuple[str, str], CanonicalFailureRecord] = {}
+            order: List[Tuple[str, str]] = []
+            for line in self.failures_path.read_text(encoding="utf-8").splitlines():
+                if not line.strip():
+                    continue
+                rec = CanonicalFailureRecord.model_validate(json.loads(line))
+                key = (rec.failure_type, rec.signature_text)
+                if key not in latest:
+                    order.append(key)
+                latest[key] = rec
+            if order:
+                self._records = [latest[k] for k in order]
+                self._slot_by_key = {k: i for i, k in enumerate(order)}
+                vecs = self.featurizer.encode_batch([latest[k].signature_text for k in order])
+                self._ensure_capacity(len(order))
+                slots = np.arange(len(order), dtype=np.int32)
+                self._emb, self._valid = self._knn.insert(self._emb, self._valid, vecs, slots)
+
+        if self.patterns_path.exists():
+            for line in self.patterns_path.read_text(encoding="utf-8").splitlines():
+                if not line.strip():
+                    continue
+                p = PatternEntity.model_validate(json.loads(line))
+                self._patterns[p.name] = p
+
+    # ------------------------------------------------------------------
+    # failures
+    # ------------------------------------------------------------------
+
+    @property
+    def count(self) -> int:
+        return len(self._records)
+
+    def list_failures(self) -> List[CanonicalFailureRecord]:
+        with self._lock:
+            return list(self._records)
+
+    def _ensure_capacity(self, needed: int) -> None:
+        if needed <= self._knn.capacity:
+            return
+        new_cap = self._knn.capacity
+        while new_cap < needed:
+            new_cap *= 2
+        # Growth is an explicit re-shard event: allocate a doubled index and
+        # re-embed from host metadata (rare; amortized O(1) per insert).
+        knn = ShardedKnn(self.mesh, new_cap, self._knn.dim, k=self.top_k)
+        emb, valid = knn.alloc()
+        if self._records:
+            vecs = self.featurizer.encode_batch([r.signature_text for r in self._records])
+            slots = np.arange(len(self._records), dtype=np.int32)
+            emb, valid = knn.insert(emb, valid, vecs, slots)
+        self._knn, self._emb, self._valid = knn, emb, valid
+
+    def upsert_failure(
+        self,
+        *,
+        failure_type: str,
+        signature_text: str,
+        app_id: str,
+        impact_severity: Severity,
+        context_signature: Optional[dict] = None,
+        root_cause: Optional[str] = None,
+        resolution: Optional[str] = None,
+    ) -> Tuple[CanonicalFailureRecord, bool]:
+        """Versioned upsert; returns (record, created).
+
+        Identity is (failure_type, signature_text) — same as the reference's
+        reverse scan (reference: services/gfkb/app.py:108-113). Updates bump
+        version/occurrences, merge affected apps, and let root cause /
+        resolution evolve; every write re-appends to the JSONL log.
+        """
+        with self._lock:
+            key = (failure_type, signature_text)
+            slot = self._slot_by_key.get(key)
+            now = utcnow()
+            if slot is None:
+                created = True
+                rec = CanonicalFailureRecord(
+                    failure_id=f"F-{len(self._records) + 1:04d}",
+                    version=1,
+                    created_at=now,
+                    updated_at=now,
+                    failure_type=failure_type,
+                    root_cause=root_cause,
+                    context_signature=context_signature or {},
+                    impact_severity=impact_severity,
+                    resolution=resolution,
+                    occurrences=1,
+                    affected_apps=[app_id],
+                    signature_text=signature_text,
+                )
+                slot = len(self._records)
+                self._ensure_capacity(slot + 1)
+                self._records.append(rec)
+                self._slot_by_key[key] = slot
+                vec = self.featurizer.encode_batch([signature_text])
+                self._emb, self._valid = self._knn.insert(
+                    self._emb, self._valid, vec, np.asarray([slot], dtype=np.int32)
+                )
+            else:
+                created = False
+                old = self._records[slot]
+                rec = old.model_copy(deep=True)
+                rec.version += 1
+                rec.updated_at = now
+                rec.occurrences += 1
+                if app_id not in rec.affected_apps:
+                    rec.affected_apps.append(app_id)
+                rec.root_cause = root_cause or rec.root_cause
+                rec.resolution = resolution or rec.resolution
+                rec.context_signature = context_signature or rec.context_signature
+                self._records[slot] = rec
+                # Same signature text => identical embedding; no device write.
+            self._append_jsonl(self.failures_path, rec.model_dump(mode="json"))
+            return rec, created
+
+    def upsert_failures_batch(self, items: Sequence[dict]) -> List[Tuple[CanonicalFailureRecord, bool]]:
+        """Batched upsert for the streaming-ingest path.
+
+        New signatures are embedded in one ``encode_batch`` and written to the
+        device in one scatter — the 10k traces/sec path.
+        """
+        out: List[Tuple[CanonicalFailureRecord, bool]] = []
+        new_slots: List[int] = []
+        new_texts: List[str] = []
+        with self._lock:
+            now = utcnow()
+            for item in items:
+                key = (item["failure_type"], item["signature_text"])
+                slot = self._slot_by_key.get(key)
+                if slot is None:
+                    rec = CanonicalFailureRecord(
+                        failure_id=f"F-{len(self._records) + 1:04d}",
+                        version=1,
+                        created_at=now,
+                        updated_at=now,
+                        failure_type=item["failure_type"],
+                        root_cause=item.get("root_cause"),
+                        context_signature=item.get("context_signature") or {},
+                        impact_severity=Severity(item["impact_severity"]),
+                        resolution=item.get("resolution"),
+                        occurrences=1,
+                        affected_apps=[item["app_id"]],
+                        signature_text=item["signature_text"],
+                    )
+                    slot = len(self._records)
+                    self._records.append(rec)
+                    self._slot_by_key[key] = slot
+                    new_slots.append(slot)
+                    new_texts.append(rec.signature_text)
+                    out.append((rec, True))
+                else:
+                    old = self._records[slot]
+                    rec = old.model_copy(deep=True)
+                    rec.version += 1
+                    rec.updated_at = now
+                    rec.occurrences += 1
+                    if item["app_id"] not in rec.affected_apps:
+                        rec.affected_apps.append(item["app_id"])
+                    rec.root_cause = item.get("root_cause") or rec.root_cause
+                    rec.resolution = item.get("resolution") or rec.resolution
+                    rec.context_signature = item.get("context_signature") or rec.context_signature
+                    self._records[slot] = rec
+                    out.append((rec, False))
+                self._append_jsonl(self.failures_path, rec.model_dump(mode="json"))
+            if new_slots:
+                self._ensure_capacity(len(self._records))
+                vecs = self.featurizer.encode_batch(new_texts)
+                self._emb, self._valid = self._knn.insert(
+                    self._emb, self._valid, vecs, np.asarray(new_slots, dtype=np.int32)
+                )
+        return out
+
+    # ------------------------------------------------------------------
+    # match
+    # ------------------------------------------------------------------
+
+    def match(
+        self,
+        signature_text: str,
+        failure_type: Optional[str] = None,
+        type_filter: str = "post",
+    ) -> List[FailureMatch]:
+        return self.match_batch([signature_text], failure_type, type_filter)[0]
+
+    def match_batch(
+        self,
+        signature_texts: Sequence[str],
+        failure_type: Optional[str] = None,
+        type_filter: str = "post",
+    ) -> List[List[FailureMatch]]:
+        """Top-k similarity matches for a batch of queries (one device call)."""
+        q = self.featurizer.encode_batch(list(signature_texts))
+        b = q.shape[0]
+        bb = batch_bucket(b)
+        if bb != b:
+            q = np.concatenate([q, np.zeros((bb - b, q.shape[1]), dtype=q.dtype)])
+
+        # The device call runs under the lock: inserts donate the (emb, valid)
+        # buffers, so a concurrent upsert would invalidate a lock-free
+        # snapshot (and a capacity growth would change the slot mapping).
+        with self._lock:
+            if not self._records:
+                return [[] for _ in signature_texts]
+            records = list(self._records)
+            scores, slots = self._knn.topk(self._emb, self._valid, q)
+
+        out: List[List[FailureMatch]] = []
+        for i in range(b):
+            row: List[FailureMatch] = []
+            for s, slot in zip(scores[i], slots[i]):
+                if s <= -1.0 or slot >= len(records):
+                    continue  # padding / invalid rows
+                rec = records[int(slot)]
+                if failure_type and rec.failure_type != failure_type:
+                    continue
+                row.append(
+                    FailureMatch(
+                        failure_id=rec.failure_id,
+                        version=rec.version,
+                        # f32 accumulation can nudge an exact self-match a hair
+                        # past 1.0; cosine is bounded, so clamp.
+                        score=min(1.0, max(-1.0, float(s))),
+                        failure_type=rec.failure_type,
+                        suggested_mitigation=rec.resolution,
+                    )
+                )
+            out.append(row)
+        return out
+
+    # ------------------------------------------------------------------
+    # patterns
+    # ------------------------------------------------------------------
+
+    def list_patterns(self) -> List[PatternEntity]:
+        """Latest record per pattern (dedup-for-presentation, like the
+        reference's GET /patterns, services/gfkb/app.py:150-157)."""
+        with self._lock:
+            return list(self._patterns.values())
+
+    def upsert_pattern(
+        self,
+        *,
+        name: str,
+        failure_ids: Sequence[str],
+        affected_apps: Sequence[str],
+        description: Optional[str] = None,
+    ) -> Tuple[PatternEntity, bool]:
+        """Identity-by-name pattern upsert with set-union merge
+        (reference: services/gfkb/app.py:168-198)."""
+        with self._lock:
+            existing = self._patterns.get(name)
+            if existing is None:
+                p = PatternEntity(
+                    pattern_id=f"FP-{len(self._patterns) + 1:04d}",
+                    name=name,
+                    created_at=utcnow(),
+                    failure_ids=sorted(set(failure_ids)),
+                    affected_apps=sorted(set(affected_apps)),
+                    description=description,
+                )
+                created = True
+            else:
+                p = existing.model_copy(deep=True)
+                p.failure_ids = sorted(set(list(p.failure_ids) + list(failure_ids)))
+                p.affected_apps = sorted(set(list(p.affected_apps) + list(affected_apps)))
+                p.description = description or p.description
+                created = False
+            self._patterns[name] = p
+            self._append_jsonl(self.patterns_path, p.model_dump(mode="json"))
+            return p, created

@@ -1,0 +1,3 @@
+"""Model runtimes: deterministic stub, in-tree JAX Llama, Ollama-compat client."""
+
+from kakveda_tpu.models.runtime import GenerateResult, ModelRuntime, StubRuntime, get_runtime  # noqa: F401

@@ -1,0 +1,135 @@
+"""Model runtime abstraction: stub | tpu (JAX Llama) | ollama.
+
+The reference calls Ollama over HTTP and falls back to a deterministic
+citation-bearing stub on any error
+(reference: services/dashboard/app.py:1182-1258,
+scripts/demo_client.py:23-40). That stub *is* the test backend: it always
+emits fake citations, so the full failure pipeline is exercisable with no
+LLM.
+
+Here the runtime is a first-class interface:
+
+  * ``StubRuntime`` — byte-for-byte the reference's canned response, zero
+    dependencies, the hermetic default.
+  * ``LlamaRuntime`` (kakveda_tpu.models.llama) — the in-tree JAX Llama,
+    TP-sharded on the same mesh as the GFKB index; replaces the Ollama HTTP
+    hop with an on-pod forward pass.
+  * ``OllamaRuntime`` — HTTP client kept for drop-in compatibility with
+    reference deployments; falls back to the stub like the reference does.
+
+Every result carries provider/model/latency metadata in the reference's
+meta shape so dashboards and eval scorecards transfer unchanged.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Protocol
+
+# The reference's exact stub text (services/dashboard/app.py:1193-1199) —
+# fake citations that trip the rule classifier deterministically.
+STUB_RESPONSE = (
+    "Here is a summary with references.\n\n"
+    "References:\n"
+    "[1] Smith et al. (2020) A Study on Things.\n"
+    "[2] Doe (2021) Another Paper.\n"
+)
+
+
+@dataclass
+class GenerateResult:
+    text: str
+    meta: Dict[str, Any] = field(default_factory=dict)
+
+
+class ModelRuntime(Protocol):
+    name: str
+
+    def generate(self, prompt: str, *, model: Optional[str] = None, max_tokens: int = 256) -> GenerateResult: ...
+
+
+class StubRuntime:
+    """Deterministic canned-response backend — the hermetic test model."""
+
+    name = "stub"
+
+    def __init__(self, model_label: str = "stub"):
+        self.model_label = model_label
+
+    def generate(self, prompt: str, *, model: Optional[str] = None, max_tokens: int = 256) -> GenerateResult:
+        started = time.perf_counter()
+        text = STUB_RESPONSE
+        return GenerateResult(
+            text=text,
+            meta={
+                "provider": "stub",
+                "model": model or self.model_label,
+                "latency_ms": int((time.perf_counter() - started) * 1000),
+            },
+        )
+
+
+class OllamaRuntime:
+    """HTTP client for an external Ollama, with stub fallback on any error —
+    reference-compatible behavior (services/dashboard/app.py:1182-1199)."""
+
+    name = "ollama"
+
+    def __init__(self, url: Optional[str] = None, model: Optional[str] = None, timeout: float = 8.0):
+        self.url = url or os.environ.get("OLLAMA_URL", "http://localhost:11434")
+        self.model = model or os.environ.get("OLLAMA_MODEL", "llama3")
+        self.timeout = timeout
+        self._stub = StubRuntime()
+
+    def generate(self, prompt: str, *, model: Optional[str] = None, max_tokens: int = 256) -> GenerateResult:
+        import httpx
+
+        mdl = model or self.model
+        started = time.perf_counter()
+        try:
+            r = httpx.post(
+                f"{self.url}/api/generate",
+                json={"model": mdl, "prompt": prompt, "stream": False},
+                timeout=self.timeout,
+            )
+            r.raise_for_status()
+            latency_ms = int((time.perf_counter() - started) * 1000)
+            return GenerateResult(
+                text=r.json().get("response") or "",
+                meta={"provider": "ollama", "model": mdl, "url": self.url, "latency_ms": latency_ms},
+            )
+        except Exception as e:  # noqa: BLE001 — any failure falls back to the stub
+            latency_ms = int((time.perf_counter() - started) * 1000)
+            res = self._stub.generate(prompt, model=mdl)
+            res.meta.update(
+                {"latency_ms": latency_ms, "url": self.url, "error": f"{type(e).__name__}: {e}"}
+            )
+            return res
+
+
+_RUNTIMES: Dict[str, Any] = {}
+
+
+def get_runtime(name: Optional[str] = None) -> ModelRuntime:
+    """Resolve the configured runtime (KAKVEDA_MODEL_RUNTIME: stub|tpu|ollama)."""
+    name = (name or os.environ.get("KAKVEDA_MODEL_RUNTIME", "stub")).lower()
+    if name in _RUNTIMES:
+        return _RUNTIMES[name]
+    if name == "stub":
+        rt: ModelRuntime = StubRuntime()
+    elif name == "ollama":
+        rt = OllamaRuntime()
+    elif name == "tpu":
+        try:
+            from kakveda_tpu.models.llama import LlamaRuntime
+        except ImportError as e:
+            raise NotImplementedError(
+                "the tpu model runtime requires kakveda_tpu.models.llama"
+            ) from e
+        rt = LlamaRuntime.from_env()
+    else:
+        raise ValueError(f"unknown model runtime: {name!r}")
+    _RUNTIMES[name] = rt
+    return rt

@@ -1,0 +1,9 @@
+"""Device kernels: featurization, kNN, clustering, health scoring.
+
+This package is the native-performance tier of the framework — the JAX/XLA
+replacement for the reference's sklearn TF-IDF + cosine path
+(reference: services/shared/similarity.py:14-20) and its O(N)-per-query
+match loop (reference: services/gfkb/app.py:79-102).
+"""
+
+from kakveda_tpu.ops.featurizer import HashedNGramFeaturizer  # noqa: F401

@@ -1,0 +1,57 @@
+"""Device-side clustering of failure embeddings.
+
+Connected components of the threshold cosine-similarity graph, computed by
+iterative min-label propagation — every step is a masked matmul-shaped op
+that XLA maps onto the MXU/VPU, with a ``lax.while_loop`` until fixpoint:
+
+    A      = (E @ E^T) >= threshold          # adjacency, [N, N]
+    l_i    <- min_j { l_j : A[i, j] }        # propagate smallest label
+    repeat until no label changes (≤ graph diameter iterations)
+
+This replaces "pattern detection" as a group-by on failure_type
+(reference: services/pattern_detector/app.py:40-47) with actual similarity
+clustering over the index embeddings. Intended as a periodic batch job over
+up to ~100k canonical failures (N² adjacency); larger indexes should mine
+patterns over a recent window.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def _propagate_labels(adj: jax.Array) -> jax.Array:
+    n = adj.shape[0]
+    init = jnp.arange(n, dtype=jnp.int32)
+
+    def cond(state):
+        labels, changed, it = state
+        return jnp.logical_and(changed, it < n)
+
+    def body(state):
+        labels, _, it = state
+        # min over neighbors' labels (self-edge keeps own label).
+        big = jnp.iinfo(jnp.int32).max
+        neigh = jnp.where(adj, labels[None, :], big)
+        new = jnp.minimum(labels, jnp.min(neigh, axis=1))
+        return new, jnp.any(new != labels), it + 1
+
+    labels, _, _ = jax.lax.while_loop(cond, body, (init, jnp.bool_(True), jnp.int32(0)))
+    return labels
+
+
+def cluster_embeddings(vecs: np.ndarray, threshold: float = 0.6) -> np.ndarray:
+    """Connected-component labels for L2-normalized embeddings [N, d].
+
+    Returns int32 labels [N]; rows in the same component share a label
+    (the smallest member index).
+    """
+    v = jnp.asarray(vecs, dtype=jnp.float32)
+    sims = v @ v.T
+    adj = sims >= threshold
+    # Ensure self-edges so isolated rows keep their own label.
+    adj = jnp.logical_or(adj, jnp.eye(v.shape[0], dtype=bool))
+    return np.asarray(_propagate_labels(adj))

@@ -1,0 +1,138 @@
+"""Hashed n-gram text featurizer — fixed-dimension failure embeddings.
+
+The reference scores similarity by re-fitting a TF-IDF vectorizer on
+(query + full corpus) for every single match request
+(reference: services/shared/similarity.py:14-20, called from
+services/gfkb/app.py:81-89) — O(N·d) work per pre-flight check and
+impossible to keep device-resident because the feature space changes with
+every insert.
+
+Here each signature text maps to a *fixed* d-dimensional vector via signed
+feature hashing of word uni+bigrams (Weinberger et al., 2009 — "hashing
+trick"), so:
+
+  * embeddings are computed once at insert time and live in HBM;
+  * a pre-flight match is one matmul + top-k on device;
+  * the feature space never changes — no refit, no retrace.
+
+Field-aware weighting replaces TF-IDF's idf as the discriminative mechanism:
+signature texts lead with stable intent tags
+(reference: services/shared/fingerprint.py:51-66), and tokens inside the
+``intent_tags:`` field get a configurable weight boost so that prompts with
+the same failure *shape* score high even when their wording differs — the
+same determinism the reference gets from keeping tags as the primary TF-IDF
+signal.
+
+Hashing is zlib.crc32-based: stable across processes, platforms and
+restarts, so an index snapshot is valid forever.
+"""
+
+from __future__ import annotations
+
+import re
+import zlib
+from typing import Dict, Iterable, List, Sequence, Tuple
+
+import numpy as np
+
+_TOKEN_RE = re.compile(r"[a-z0-9_]+")
+
+# Signature fields, in `signature_text` order. ``weight`` is the term-weight
+# boost; ``atomic`` fields contribute whole comma-separated items as single
+# features instead of word n-grams. Intent tags dominate (they are the stable
+# cross-app signal, reference: services/shared/fingerprint.py:54-58); the
+# prompt hint contributes wording detail; env keys are near-boilerplate and
+# get muted so unrelated prompts sharing an environment don't look similar.
+_FIELD_SPECS: Dict[str, Tuple[float, bool]] = {
+    "intent_tags": (3.0, True),
+    "prompt_hint": (1.0, False),
+    "tools": (1.0, True),
+    "env_keys": (0.25, True),
+}
+
+_FIELD_SPLIT = " | "
+
+
+def _terms(text: str) -> List[str]:
+    """Word unigrams + adjacent bigrams of the lowercased text."""
+    words = _TOKEN_RE.findall(text.lower())
+    grams = list(words)
+    grams.extend(f"{a} {b}" for a, b in zip(words, words[1:]))
+    return grams
+
+
+def _hash_term(term: str) -> Tuple[int, float]:
+    """Stable (bucket, sign) for a term via crc32."""
+    h = zlib.crc32(term.encode("utf-8"))
+    sign = 1.0 if (h >> 31) & 1 == 0 else -1.0
+    return h & 0x7FFFFFFF, sign
+
+
+class HashedNGramFeaturizer:
+    """Signed feature hashing of word 1-2 grams into a fixed dim.
+
+    ``dim`` must be a power of two (bucket = hash & (dim-1)). Stateless and
+    thread-safe: terms hash directly (crc32 is cheaper than a memo dict, and
+    a memo over arbitrary user prompts would grow without bound).
+    """
+
+    def __init__(
+        self,
+        dim: int = 2048,
+        field_specs: Dict[str, Tuple[float, bool]] | None = None,
+    ):
+        if dim & (dim - 1) != 0:
+            raise ValueError(f"dim must be a power of two, got {dim}")
+        self.dim = dim
+        self.field_specs = dict(field_specs or _FIELD_SPECS)
+
+    def _bucket(self, term: str) -> Tuple[int, float]:
+        h, sign = _hash_term(term)
+        return h & (self.dim - 1), sign
+
+    def _weighted_terms(self, text: str) -> List[Tuple[str, float]]:
+        """(term, weight) features for one text.
+
+        Segments of a signature text are recognized by their field prefix
+        (``intent_tags:...``); the label itself is stripped so structural
+        boilerplate never contributes similarity. Atomic fields emit each
+        comma-separated item as a single feature (an intent tag is one
+        indivisible signal, not a bag of words). Free-form text falls back to
+        plain word n-grams at weight 1.0, so arbitrary strings embed too.
+        """
+        feats: List[Tuple[str, float]] = []
+        for seg in text.split(_FIELD_SPLIT):
+            name, sep, rest = seg.partition(":")
+            spec = self.field_specs.get(name.strip().lower()) if sep else None
+            if spec is None:
+                feats.extend((t, 1.0) for t in _terms(seg))
+                continue
+            weight, atomic = spec
+            if atomic:
+                for item in rest.split(","):
+                    item = item.strip().lower()
+                    if item:
+                        feats.append((f"{name}={item}", weight))
+            else:
+                feats.extend((t, weight) for t in _terms(rest))
+        return feats
+
+    def encode(self, text: str) -> np.ndarray:
+        """One L2-normalized float32 vector of shape [dim]."""
+        return self.encode_batch([text])[0]
+
+    def encode_batch(self, texts: Sequence[str]) -> np.ndarray:
+        """[B, dim] float32, rows L2-normalized (zero row for empty text)."""
+        out = np.zeros((len(texts), self.dim), dtype=np.float32)
+        for i, text in enumerate(texts):
+            row = out[i]
+            for term, w in self._weighted_terms(text):
+                b, sign = self._bucket(term)
+                row[b] += sign * w
+            n = float(np.linalg.norm(row))
+            if n > 0.0:
+                row /= n
+        return out
+
+    def encode_signatures(self, sigs: Iterable[str]) -> np.ndarray:
+        return self.encode_batch(list(sigs))

@@ -1,0 +1,12 @@
+"""Device-mesh + collective utilities.
+
+The reference's "distributed backend" is an HTTP pub/sub bus with best-effort
+fan-out (reference: services/event_bus/app.py:25-54). Here, device-side state
+(the GFKB embedding index, pattern labels) is sharded over a
+``jax.sharding.Mesh`` and kept coherent with XLA collectives over ICI —
+all_gather for cross-shard top-k merge, psum for global statistics — while a
+host-side asyncio bus (kakveda_tpu.events) keeps the external integration
+contract.
+"""
+
+from kakveda_tpu.parallel.mesh import create_mesh, local_device_count, parse_mesh_shape  # noqa: F401

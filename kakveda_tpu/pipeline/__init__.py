@@ -1,0 +1,12 @@
+"""Intelligence pipeline: classification, patterns, warnings, health.
+
+TPU-first re-design of the reference's L3 reactor services
+(reference: services/failure_classifier/, pattern_detector/,
+warning_policy/, health_scoring/) — batched ops over the device-resident
+GFKB instead of per-event HTTP hops.
+"""
+
+from kakveda_tpu.pipeline.classifier import RuleClassifier, classify_trace  # noqa: F401
+from kakveda_tpu.pipeline.warning import WarningPolicy  # noqa: F401
+from kakveda_tpu.pipeline.patterns import PatternDetector  # noqa: F401
+from kakveda_tpu.pipeline.health_score import HealthScorer  # noqa: F401

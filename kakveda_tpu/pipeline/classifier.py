@@ -1,0 +1,60 @@
+"""Failure classification over ingested traces.
+
+Rule tier mirrors the reference's demo classifier
+(reference: services/failure_classifier/app.py:30-91): a trace whose prompt
+asks for citations and whose response contains citation markers is a
+``HALLUCINATION_CITATION`` (medium severity) — deterministic, hermetic, and
+the backbone of the e2e tests. Designed batch-first: ``classify_batch``
+processes whole trace batches for the 10k traces/sec streaming path, and an
+optional LLM classifier tier (kakveda_tpu.models) can re-judge ambiguous
+traces on-device.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Sequence
+
+from kakveda_tpu.core.fingerprint import detect_citation_markers, prompt_intent_tags
+from kakveda_tpu.core.schemas import FailureSignal, Severity, TracePayload
+
+HALLUCINATION_CITATION = "HALLUCINATION_CITATION"
+
+_ROOT_CAUSE = "Model produced citations without provided sources"
+_MITIGATION = "Ask model to explicitly say 'no sources available' when none are provided"
+
+
+def _wants_citations(prompt: str) -> bool:
+    # Keyword list matches the reference classifier exactly
+    # (reference: services/failure_classifier/app.py:35-46); the intent
+    # tagger uses the same vocabulary, so reuse it.
+    return "intent:citations_required" in prompt_intent_tags(prompt)
+
+
+def classify_trace(trace: TracePayload) -> Optional[FailureSignal]:
+    """Single-trace rule classification; None when the trace looks healthy."""
+    if not (_wants_citations(trace.prompt) and detect_citation_markers(trace.response).has_citation_markers):
+        return None
+    return FailureSignal(
+        trace_id=trace.trace_id,
+        ts=trace.ts,
+        app_id=trace.app_id,
+        failure_type=HALLUCINATION_CITATION,
+        severity=Severity.medium,
+        root_cause=_ROOT_CAUSE,
+        mitigation=_MITIGATION,
+        context_signature={
+            "prompt_shape": trace.prompt[:200],
+            "model": trace.model,
+            "tools": trace.tools,
+            "env": trace.env,
+        },
+    )
+
+
+@dataclass
+class RuleClassifier:
+    """Batch rule classifier for the streaming ingest path."""
+
+    def classify_batch(self, traces: Sequence[TracePayload]) -> List[Optional[FailureSignal]]:
+        return [classify_trace(t) for t in traces]

@@ -1,0 +1,124 @@
+"""Per-app health scoring over a rolling failure window.
+
+Scoring math matches the reference exactly
+(reference: services/health_scoring/app.py:58-108):
+
+    weighted          = Σ severity_weight over the window (≤50 events)
+    failure_rate      = min(1, n / 10)
+    recurrent_penalty = Σ_type max(0, count-1) * 2.5
+    avg_recovery      = 30 + 10 * recurrent_penalty   (placeholder metric)
+    score             = max(0, base − 5·weighted − recurrent_penalty)
+
+Severity weights and base come from hot-reloaded config
+(reference: config/config.yaml:8-13). Points append to ``health.jsonl`` —
+durable-by-append like every other store. ``on_failures_batch`` is the
+streaming entry: one config read and one file append per batch.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from collections import defaultdict, deque
+from pathlib import Path
+from typing import Deque, Dict, List, Optional
+
+from kakveda_tpu.core.config import ConfigStore
+from kakveda_tpu.core.schemas import FailureSignal, HealthPoint, utcnow
+
+WINDOW = 50
+EXECUTIONS_PER_WINDOW = 10.0
+RECURRENCE_UNIT = 2.5
+WEIGHT_SCALE = 5.0
+
+
+class HealthScorer:
+    def __init__(
+        self,
+        data_dir: str | Path = "data",
+        config: Optional[ConfigStore] = None,
+        persist: bool = True,
+    ):
+        self.config = config or ConfigStore()
+        self.persist = persist
+        self.data_dir = Path(data_dir)
+        if persist:
+            self.data_dir.mkdir(parents=True, exist_ok=True)
+        self.health_path = self.data_dir / "health.jsonl"
+        self._windows: Dict[str, Deque[dict]] = defaultdict(lambda: deque(maxlen=WINDOW))
+        self._lock = threading.Lock()
+
+    def _append_all(self, points: List[HealthPoint]) -> None:
+        if not self.persist or not points:
+            return
+        with self.health_path.open("a", encoding="utf-8") as f:
+            for point in points:
+                f.write(json.dumps(point.model_dump(mode="json"), ensure_ascii=False) + "\n")
+
+    def _score_one(self, failure: FailureSignal, weights: Dict[str, float], base: float) -> HealthPoint:
+        """Window update + score math; caller holds the lock and owns I/O."""
+        w = float(weights.get(failure.severity.value, 1.0))
+        window = self._windows[failure.app_id]
+        window.append(
+            {
+                "ts": failure.ts.isoformat(),
+                "severity": failure.severity.value,
+                "weight": w,
+                "failure_type": failure.failure_type,
+            }
+        )
+        events = list(window)
+
+        n = len(events)
+        weighted = sum(e["weight"] for e in events)
+        counts: Dict[str, int] = defaultdict(int)
+        for e in events:
+            counts[str(e["failure_type"])] += 1
+        recurrent_penalty = sum(max(0, c - 1) for c in counts.values()) * RECURRENCE_UNIT
+        score = max(0.0, base - weighted * WEIGHT_SCALE - recurrent_penalty)
+
+        return HealthPoint(
+            ts=utcnow(),
+            app_id=failure.app_id,
+            score=score,
+            failure_rate=min(1.0, n / EXECUTIONS_PER_WINDOW),
+            recurrent_penalty=recurrent_penalty,
+            avg_recovery_time_sec=30.0 + 10.0 * recurrent_penalty,
+            notes={
+                "window_failures": n,
+                "weighted": weighted,
+                "top_failure": max(counts, key=counts.get) if counts else None,
+                "last_failure": events[-1]["failure_type"] if events else None,
+                "last_severity": events[-1]["severity"] if events else None,
+            },
+        )
+
+    def on_failure(self, failure: FailureSignal) -> HealthPoint:
+        return self.on_failures_batch([failure])[0]
+
+    def on_failures_batch(self, failures: List[FailureSignal]) -> List[HealthPoint]:
+        """Streaming-path batch entry: one config read and one JSONL append
+        for the whole batch, in order."""
+        weights = self.config.severity_weights()
+        base = self.config.base_score()
+        with self._lock:
+            points = [self._score_one(f, weights, base) for f in failures]
+        self._append_all(points)
+        return points
+
+    def history(self, app_id: str, limit: int = 50) -> List[dict]:
+        """Tail of the persisted health timeline for one app
+        (reference: services/health_scoring/app.py:116-130)."""
+        if not self.health_path.exists():
+            return []
+        pts = []
+        for line in self.health_path.read_text(encoding="utf-8").splitlines():
+            if not line.strip():
+                continue
+            try:
+                obj = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if obj.get("app_id") == app_id:
+                pts.append(obj)
+        return pts[-limit:]

@@ -1,0 +1,92 @@
+"""Pattern detection: recurring failures spanning multiple apps.
+
+Parity tier mirrors the reference's reactor
+(reference: services/pattern_detector/app.py:28-60): on a citation-
+hallucination failure, group GFKB failures by type and upsert the named
+pattern once ≥2 apps are affected.
+
+Beyond parity, ``mine_patterns`` runs device-side clustering over the full
+GFKB embedding matrix (threshold cosine graph → connected components via
+iterative label propagation, kakveda_tpu.ops.clustering) and surfaces
+clusters that span multiple apps as discovered patterns — the batch job the
+reference never had.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Dict, List, Optional
+
+from kakveda_tpu.core.schemas import FailureSignal, PatternEntity
+from kakveda_tpu.index.gfkb import GFKB
+from kakveda_tpu.pipeline.classifier import HALLUCINATION_CITATION
+
+_CITATION_PATTERN_NAME = "Citation hallucination without sources"
+_CITATION_PATTERN_DESC = "Same prompt pattern causes hallucinated citations across apps"
+
+
+class PatternDetector:
+    def __init__(self, gfkb: GFKB, min_apps: int = 2):
+        self.gfkb = gfkb
+        self.min_apps = min_apps
+
+    def on_failure(self, failure: FailureSignal) -> Optional[PatternEntity]:
+        """Reactor invoked on every failure.detected event."""
+        if failure.failure_type != HALLUCINATION_CITATION:
+            return None
+
+        relevant = [f for f in self.gfkb.list_failures() if f.failure_type == failure.failure_type]
+        affected = sorted({a for f in relevant for a in f.affected_apps})
+        if len(affected) < self.min_apps:
+            return None
+        failure_ids = sorted({f.failure_id for f in relevant})
+        pattern, _ = self.gfkb.upsert_pattern(
+            name=_CITATION_PATTERN_NAME,
+            failure_ids=failure_ids,
+            affected_apps=affected,
+            description=_CITATION_PATTERN_DESC,
+        )
+        return pattern
+
+    def mine_patterns(self, threshold: float = 0.6) -> List[PatternEntity]:
+        """Batch pattern mining over the whole GFKB via device clustering.
+
+        Clusters canonical failures by embedding similarity; any cluster of
+        ≥2 failures spanning ≥min_apps apps becomes (or refreshes) a pattern
+        named after its dominant failure type.
+        """
+        from kakveda_tpu.ops.clustering import cluster_embeddings
+
+        records = self.gfkb.list_failures()
+        if len(records) < 2:
+            return []
+        vecs = self.gfkb.featurizer.encode_batch([r.signature_text for r in records])
+        labels = cluster_embeddings(vecs, threshold=threshold)
+
+        groups: Dict[int, List[int]] = defaultdict(list)
+        for i, lbl in enumerate(labels):
+            groups[int(lbl)].append(i)
+
+        out: List[PatternEntity] = []
+        for members in groups.values():
+            if len(members) < 2:
+                continue
+            recs = [records[i] for i in members]
+            apps = sorted({a for r in recs for a in r.affected_apps})
+            if len(apps) < self.min_apps:
+                continue
+            types = sorted({r.failure_type for r in recs})
+            dominant = max(types, key=lambda t: sum(1 for r in recs if r.failure_type == t))
+            name = (
+                _CITATION_PATTERN_NAME
+                if dominant == HALLUCINATION_CITATION
+                else f"Recurring {dominant.lower().replace('_', ' ')}"
+            )
+            pattern, _ = self.gfkb.upsert_pattern(
+                name=name,
+                failure_ids=sorted({r.failure_id for r in recs}),
+                affected_apps=apps,
+                description=f"Cluster of {len(recs)} similar failures ({', '.join(types)})",
+            )
+            out.append(pattern)
+        return out

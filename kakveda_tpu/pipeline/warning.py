@@ -1,0 +1,82 @@
+"""Pre-flight warning policy — 'has something like this failed before?'
+
+Parity with the reference's warning service
+(reference: services/warning_policy/app.py:19-72): build the signature text,
+match against the GFKB, compare the best score to the config threshold
+(default 0.8), attach a pattern id when a known pattern covers the matched
+failure type, and answer block|warn|silent with a confidence score.
+
+Unlike the reference — which pays an HTTP hop to GFKB plus a full TF-IDF
+refit per request — this policy calls the device index in-process; the match
+is a warm compiled matmul+top-k, and ``warn_batch`` amortizes many
+concurrent pre-flight checks into one device call (the <10 ms p50 path).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from kakveda_tpu.core.config import ConfigStore
+from kakveda_tpu.core.fingerprint import signature_text
+from kakveda_tpu.core.schemas import WarningRequest, WarningResponse
+from kakveda_tpu.index.gfkb import GFKB
+from kakveda_tpu.pipeline.classifier import HALLUCINATION_CITATION
+
+# The demo pattern the reference's policy knows how to attach
+# (reference: services/warning_policy/app.py:40-48).
+_CITATION_PATTERN_NAME = "Citation hallucination without sources"
+
+
+class WarningPolicy:
+    def __init__(self, gfkb: GFKB, config: Optional[ConfigStore] = None):
+        self.gfkb = gfkb
+        self.config = config or ConfigStore()
+
+    def warn(self, req: WarningRequest) -> WarningResponse:
+        return self.warn_batch([req])[0]
+
+    def warn_batch(self, reqs: Sequence[WarningRequest]) -> List[WarningResponse]:
+        threshold = self.config.similarity_threshold()
+        default_action = self.config.default_action()
+
+        sigs = [signature_text(r.prompt, r.tools, r.env) for r in reqs]
+        all_matches = self.gfkb.match_batch(sigs)
+        patterns = self.gfkb.list_patterns()
+
+        out: List[WarningResponse] = []
+        for matches in all_matches:
+            best = matches[0] if matches else None
+            score = best.score if best else 0.0
+
+            pattern_id = None
+            if best and best.failure_type == HALLUCINATION_CITATION:
+                for p in patterns:
+                    if p.name == _CITATION_PATTERN_NAME:
+                        pattern_id = p.pattern_id
+                        break
+
+            if best and score >= threshold:
+                out.append(
+                    WarningResponse(
+                        action=default_action,
+                        confidence=score,
+                        pattern_id=pattern_id,
+                        references=[best],
+                        message=(
+                            f"This execution matches past failure type {best.failure_type} "
+                            f"(failure_id={best.failure_id}, similarity={score:.2f}). "
+                            f"Suggested mitigation: {best.suggested_mitigation or 'n/a'}"
+                        ),
+                    )
+                )
+            else:
+                out.append(
+                    WarningResponse(
+                        action="silent" if default_action == "silent" else "warn",
+                        confidence=score,
+                        pattern_id=pattern_id,
+                        references=[],
+                        message="No high-similarity match found in GFKB.",
+                    )
+                )
+        return out

@@ -1,0 +1,157 @@
+"""The assembled intelligence core — one object, the whole platform.
+
+The reference spreads this across seven containers talking JSON-over-HTTP
+(event-bus, ingestion, gfkb, failure-classifier, pattern-detector,
+warning-policy, health-scoring; reference: docker-compose.yml). Here the
+same pipeline is one in-process object holding the device-resident GFKB:
+
+    ingest(trace)  → publish trace.ingested
+                   → rule classifier → GFKB upsert (device embed + insert)
+                   → publish failure.detected
+                   → pattern detector → pattern upsert
+                   → health scorer    → health point append
+    warn(request)  → device kNN match → policy decision
+
+``ingest_batch`` is the streaming path: classify, embed and insert whole
+batches in single device calls (the 10k traces/sec target). The HTTP
+service layer (kakveda_tpu.service) and dashboard mount this core; external
+subscribers can still attach callback URLs to the bus for the reference's
+pub/sub contract.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import List, Optional, Sequence
+
+from jax.sharding import Mesh
+
+from kakveda_tpu.core.config import ConfigStore
+from kakveda_tpu.core.fingerprint import signature_text
+from kakveda_tpu.core.schemas import (
+    FailureSignal,
+    HealthPoint,
+    PatternEntity,
+    TracePayload,
+    WarningRequest,
+    WarningResponse,
+)
+from kakveda_tpu.events.bus import (
+    TOPIC_FAILURE_DETECTED,
+    TOPIC_TRACE_INGESTED,
+    EventBus,
+)
+from kakveda_tpu.index.gfkb import GFKB
+from kakveda_tpu.pipeline.classifier import RuleClassifier
+from kakveda_tpu.pipeline.health_score import HealthScorer
+from kakveda_tpu.pipeline.patterns import PatternDetector
+from kakveda_tpu.pipeline.warning import WarningPolicy
+
+
+class Platform:
+    """Wires bus + GFKB + classifier + patterns + warnings + health."""
+
+    def __init__(
+        self,
+        data_dir: str | Path = "data",
+        config: Optional[ConfigStore] = None,
+        mesh: Optional[Mesh] = None,
+        capacity: int = 1 << 14,
+        dim: Optional[int] = None,
+        persist: bool = True,
+    ):
+        self.config = config or ConfigStore()
+        self.data_dir = Path(data_dir)
+        d = dim or self.config.embedding_dim()
+
+        self.bus = EventBus()
+        self.gfkb = GFKB(
+            data_dir=self.data_dir,
+            mesh=mesh,
+            capacity=capacity,
+            dim=d,
+            top_k=self.config.match_top_k(),
+            persist=persist,
+        )
+        self.classifier = RuleClassifier()
+        self.patterns = PatternDetector(self.gfkb)
+        self.warning_policy = WarningPolicy(self.gfkb, self.config)
+        self.health = HealthScorer(self.data_dir, self.config, persist=persist)
+
+        # Internal pipeline reactors ride the same bus external subscribers use.
+        self.bus.subscribe(TOPIC_TRACE_INGESTED, self._on_trace_event)
+        self.bus.subscribe(TOPIC_FAILURE_DETECTED, self._on_failure_event)
+
+    # ------------------------------------------------------------------
+    # event reactors (dict payloads — the bus speaks JSON shapes)
+    # ------------------------------------------------------------------
+
+    async def _on_trace_event(self, event: dict) -> None:
+        trace = TracePayload.model_validate(event)
+        await self._classify_and_record([trace])
+
+    async def _on_failure_event(self, event: dict) -> None:
+        failure = FailureSignal.model_validate(event)
+        self.patterns.on_failure(failure)
+        self.health.on_failure(failure)
+
+    # ------------------------------------------------------------------
+    # core flows
+    # ------------------------------------------------------------------
+
+    async def _classify_and_record(self, traces: Sequence[TracePayload]) -> List[FailureSignal]:
+        signals = self.classifier.classify_batch(traces)
+        found = [(t, s) for t, s in zip(traces, signals) if s is not None]
+        if not found:
+            return []
+        self.gfkb.upsert_failures_batch(
+            [
+                {
+                    "failure_type": s.failure_type,
+                    "root_cause": s.root_cause,
+                    "context_signature": s.context_signature,
+                    "impact_severity": s.severity.value,
+                    "resolution": s.mitigation,
+                    "signature_text": signature_text(t.prompt, t.tools, t.env),
+                    "app_id": t.app_id,
+                }
+                for t, s in found
+            ]
+        )
+        for _, s in found:
+            await self.bus.publish(TOPIC_FAILURE_DETECTED, s.model_dump(mode="json"))
+        return [s for _, s in found]
+
+    async def ingest(self, trace: TracePayload) -> None:
+        """The reference's POST /ingest → publish trace.ingested
+        (reference: services/ingestion/app.py:15-21)."""
+        await self.bus.publish(TOPIC_TRACE_INGESTED, trace.model_dump(mode="json"))
+
+    async def ingest_batch(self, traces: Sequence[TracePayload]) -> List[FailureSignal]:
+        """Streaming ingest: classify + embed + insert whole batches in single
+        device calls. Bypasses per-trace bus fan-out for throughput but still
+        publishes failure.detected so reactors and external subscribers see
+        every failure."""
+        return await self._classify_and_record(traces)
+
+    def warn(self, req: WarningRequest) -> WarningResponse:
+        return self.warning_policy.warn(req)
+
+    def warn_batch(self, reqs: Sequence[WarningRequest]) -> List[WarningResponse]:
+        return self.warning_policy.warn_batch(reqs)
+
+    # ------------------------------------------------------------------
+    # views
+    # ------------------------------------------------------------------
+
+    def failures(self):
+        return self.gfkb.list_failures()
+
+    def patterns_list(self) -> List[PatternEntity]:
+        return self.gfkb.list_patterns()
+
+    def health_history(self, app_id: str, limit: int = 50) -> List[dict]:
+        return self.health.history(app_id, limit)
+
+    def health_points(self, app_id: str) -> List[HealthPoint]:
+        return [HealthPoint.model_validate(p) for p in self.health.history(app_id)]

@@ -1,0 +1,26 @@
+"""Test bootstrap: run everything on a simulated 8-device CPU mesh.
+
+The XLA host-device-count flag must be set before jax initializes its
+backends. This image's sitecustomize pre-registers a TPU ('axon') platform
+and pins ``jax_platforms`` via jax.config, so an env var alone is not enough
+— we override through jax.config here, before any test touches a device.
+Set KAKVEDA_TEST_PLATFORM=tpu to run the suite on real hardware instead.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+if os.environ.get("KAKVEDA_TEST_PLATFORM", "cpu") == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def tmp_data_dir(tmp_path):
+    return tmp_path / "data"

@@ -1,0 +1,57 @@
+"""CLI tests (reference analogue: tests/test_cli.py — help/exit-code checks)."""
+
+import json
+
+import pytest
+
+from kakveda_tpu.cli.main import build_parser, main
+
+
+def test_help_lists_verbs(capsys):
+    with pytest.raises(SystemExit) as ei:
+        build_parser().parse_args(["--help"])
+    assert ei.value.code == 0
+    out = capsys.readouterr().out
+    for verb in ("init", "up", "down", "status", "reset", "logs", "doctor", "version"):
+        assert verb in out
+
+
+def test_no_command_errors():
+    with pytest.raises(SystemExit) as ei:
+        build_parser().parse_args([])
+    assert ei.value.code == 2
+
+
+def test_version(capsys):
+    assert main(["version"]) == 0
+    assert "kakveda-tpu" in capsys.readouterr().out
+
+
+def test_init_and_status_and_reset(tmp_path, capsys):
+    assert main(["init", "--dir", str(tmp_path)]) == 0
+    assert (tmp_path / "config" / "config.yaml").exists()
+    assert (tmp_path / "data").is_dir()
+
+    assert main(["status", "--dir", str(tmp_path)]) == 0
+    capsys.readouterr()
+
+    # init twice without --force refuses to overwrite
+    assert main(["init", "--dir", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "already exists" in out
+
+    # reset requires --yes
+    assert main(["reset", "--dir", str(tmp_path)]) == 1
+    assert (tmp_path / "data").exists()
+    assert main(["reset", "--dir", str(tmp_path), "--yes"]) == 0
+    assert not (tmp_path / "data").exists()
+
+
+def test_status_counts_rows(tmp_path, capsys):
+    data = tmp_path / "data"
+    data.mkdir(parents=True)
+    (data / "failures.jsonl").write_text('{"a":1}\n{"a":2}\n')
+    assert main(["status", "--dir", str(tmp_path)]) == 0
+    status = json.loads(capsys.readouterr().out)
+    assert status["failures"] == 2
+    assert status["patterns"] == 0

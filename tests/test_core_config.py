@@ -1,0 +1,65 @@
+"""Config store + runtime config tests (reference: services/shared/config.py,
+runtime.py)."""
+
+import time
+
+import yaml
+
+from kakveda_tpu.core.config import ConfigStore, write_default_config
+from kakveda_tpu.core.runtime import ensure_request_id, get_runtime_config
+
+
+def test_missing_file_returns_empty_and_defaults(tmp_path):
+    cs = ConfigStore(tmp_path / "nope.yaml")
+    assert cs.get() == {}
+    assert cs.similarity_threshold() == 0.8
+    assert cs.default_action() == "warn"
+    assert cs.severity_weights() == {"low": 1.0, "medium": 3.0, "high": 7.0}
+
+
+def test_reads_yaml(tmp_path):
+    p = tmp_path / "c.yaml"
+    p.write_text(yaml.safe_dump({"failure_matching": {"similarity_threshold": 0.5}}))
+    cs = ConfigStore(p)
+    assert cs.similarity_threshold() == 0.5
+
+
+def test_hot_reload_on_mtime_change(tmp_path):
+    p = tmp_path / "c.yaml"
+    p.write_text(yaml.safe_dump({"failure_matching": {"similarity_threshold": 0.5}}))
+    cs = ConfigStore(p)
+    assert cs.similarity_threshold() == 0.5
+    time.sleep(0.02)
+    p.write_text(yaml.safe_dump({"failure_matching": {"similarity_threshold": 0.9}}))
+    # mtime change forces reload even inside the poll interval
+    assert cs.similarity_threshold() == 0.9
+
+
+def test_write_default_config_roundtrip(tmp_path):
+    p = write_default_config(tmp_path / "cfg" / "config.yaml")
+    cs = ConfigStore(p)
+    assert cs.similarity_threshold() == 0.8
+    assert cs.embedding_dim() == 2048
+
+
+def test_runtime_config_defaults(monkeypatch):
+    monkeypatch.delenv("KAKVEDA_ENV", raising=False)
+    cfg = get_runtime_config(service_name="svc")
+    assert cfg.env == "dev"
+    assert cfg.model_runtime == "stub"
+    assert cfg.otel_service_name == "svc"
+
+
+def test_runtime_config_env_override(monkeypatch):
+    monkeypatch.setenv("KAKVEDA_MODEL_RUNTIME", "tpu")
+    monkeypatch.setenv("KAKVEDA_INDEX_CAPACITY", "4096")
+    cfg = get_runtime_config(service_name="svc")
+    assert cfg.model_runtime == "tpu"
+    assert cfg.index_capacity == 4096
+
+
+def test_ensure_request_id():
+    assert ensure_request_id("abc") == "abc"
+    rid = ensure_request_id(None)
+    assert len(rid) == 32
+    assert ensure_request_id("x" * 500) == "x" * 128

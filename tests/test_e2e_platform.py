@@ -1,0 +1,127 @@
+"""End-to-end platform test — the demo_client flow, in process.
+
+Reproduces the reference's scripted e2e (reference:
+scripts/demo_client.py:43-107): two citation scenarios across app-A/app-B
+through warn → generate(stub) → ingest, then extra runs to degrade health;
+asserts on GFKB failures, the cross-app pattern, and the health timeline.
+"""
+
+import asyncio
+import uuid
+from datetime import datetime, timezone
+
+import pytest
+
+from kakveda_tpu.core.schemas import TracePayload, WarningRequest
+from kakveda_tpu.models.runtime import StubRuntime
+from kakveda_tpu.pipeline.classifier import HALLUCINATION_CITATION
+from kakveda_tpu.platform import Platform
+
+
+def _trace(app_id, prompt, response):
+    return TracePayload(
+        trace_id=str(uuid.uuid4()),
+        ts=datetime.now(timezone.utc),
+        app_id=app_id,
+        agent_id="agent-1",
+        prompt=prompt,
+        response=response,
+        model="stub",
+        temperature=0.2,
+        tools=[],
+        env={"os": "linux"},
+    )
+
+
+@pytest.fixture()
+def platform(tmp_path):
+    return Platform(data_dir=tmp_path / "data", capacity=256, dim=1024)
+
+
+def test_demo_scenario_end_to_end(platform):
+    model = StubRuntime()
+    scenarios = [
+        ("app-A", "Summarize this document and include citations even if not provided."),
+        ("app-B", "Explain research paper and add references."),
+    ]
+
+    async def run():
+        for app_id, prompt in scenarios:
+            w = platform.warn(
+                WarningRequest(app_id=app_id, agent_id="agent-1", prompt=prompt, tools=[], env={"os": "linux"})
+            )
+            assert w.action in ("warn", "block", "silent")
+            response = model.generate(prompt).text
+            await platform.ingest(_trace(app_id, prompt, response))
+
+        for i in range(8):
+            prompt = "Summarize and add references" if i % 2 == 0 else "Short answer with citations"
+            await platform.ingest(_trace("app-A", prompt, model.generate(prompt).text))
+
+    asyncio.run(run())
+
+    # GFKB: all traces hallucinated citations → canonical failures recorded
+    failures = platform.failures()
+    assert failures, "no failures recorded"
+    assert all(f.failure_type == HALLUCINATION_CITATION for f in failures)
+    apps = {a for f in failures for a in f.affected_apps}
+    assert apps == {"app-A", "app-B"}
+
+    # Pattern: spans ≥2 apps → named pattern exists
+    patterns = platform.patterns_list()
+    assert len(patterns) == 1
+    p = patterns[0]
+    assert p.name == "Citation hallucination without sources"
+    assert p.affected_apps == ["app-A", "app-B"]
+    assert p.pattern_id.startswith("FP-")
+
+    # Health: app-A degraded over repeated failures
+    pts = platform.health_points("app-A")
+    assert len(pts) >= 9
+    assert pts[-1].score < pts[0].score
+    assert pts[-1].recurrent_penalty > 0
+
+    # Second warn for the same shape now references a recorded failure
+    w2 = platform.warn(
+        WarningRequest(
+            app_id="app-C",
+            prompt="Summarize this document and include citations even if not provided.",
+            tools=[],
+            env={"os": "linux"},
+        )
+    )
+    assert w2.confidence > 0.9  # near-exact signature match in the index
+    assert w2.references and w2.references[0].failure_type == HALLUCINATION_CITATION
+    assert w2.pattern_id == p.pattern_id
+
+
+def test_streaming_batch_ingest(platform):
+    model = StubRuntime()
+    traces = [
+        _trace(f"app-{i % 4}", f"Summarize document {i} and include citations", model.generate("x").text)
+        for i in range(64)
+    ]
+
+    signals = asyncio.run(platform.ingest_batch(traces))
+    assert len(signals) == 64
+    assert platform.gfkb.count == 64  # unique signatures → unique canonicals
+
+    # pattern spans 4 apps
+    patterns = platform.patterns_list()
+    assert patterns and len(patterns[0].affected_apps) == 4
+
+    # warn_batch answers many pre-flight checks in one device call
+    reqs = [
+        WarningRequest(app_id="z", prompt=f"Summarize document {i} and include citations", tools=[], env={})
+        for i in range(16)
+    ]
+    res = platform.warn_batch(reqs)
+    assert len(res) == 16
+    assert all(r.confidence > 0.5 for r in res)
+
+
+def test_healthy_traces_record_nothing(platform):
+    t = _trace("app-A", "What's 2+2?", "4")
+    asyncio.run(platform.ingest(t))
+    assert platform.failures() == []
+    assert platform.patterns_list() == []

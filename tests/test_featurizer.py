@@ -1,0 +1,58 @@
+"""Featurizer tests: determinism, normalization, and the similarity-ordering
+invariant the reference tests (reference: tests/test_similarity.py:4-12)."""
+
+import numpy as np
+
+from kakveda_tpu.core.fingerprint import signature_text
+from kakveda_tpu.ops.featurizer import HashedNGramFeaturizer
+
+
+def _sig(prompt):
+    return signature_text(prompt, [], {"os": "linux"})
+
+
+def test_deterministic_across_instances():
+    a = HashedNGramFeaturizer(1024).encode("hello world citations")
+    b = HashedNGramFeaturizer(1024).encode("hello world citations")
+    np.testing.assert_array_equal(a, b)
+
+
+def test_rows_are_l2_normalized():
+    f = HashedNGramFeaturizer(2048)
+    v = f.encode_batch([_sig("Summarize with citations"), _sig("explain stuff")])
+    norms = np.linalg.norm(v, axis=1)
+    np.testing.assert_allclose(norms, 1.0, atol=1e-5)
+
+
+def test_empty_text_is_zero_vector():
+    v = HashedNGramFeaturizer(1024).encode("")
+    assert float(np.linalg.norm(v)) == 0.0
+
+
+def test_ordering_invariant_citation_query():
+    """Citation-ish query must score the citation corpus doc above the
+    unrelated doc — the reference's core similarity invariant."""
+    f = HashedNGramFeaturizer(2048)
+    query = _sig("Explain research paper and add references.")
+    citation_doc = _sig("Summarize this document and include citations even if not provided.")
+    unrelated_doc = _sig("What's the best pasta recipe?")
+    q, c, u = f.encode_batch([query, citation_doc, unrelated_doc])
+    assert float(q @ c) > float(q @ u)
+    assert float(q @ c) > 0.15
+    assert float(q @ u) < 0.1
+
+
+def test_dim_must_be_power_of_two():
+    import pytest
+
+    with pytest.raises(ValueError):
+        HashedNGramFeaturizer(1000)
+
+
+def test_free_form_text_embeds():
+    f = HashedNGramFeaturizer(1024)
+    v1 = f.encode("the quick brown fox")
+    v2 = f.encode("the quick brown fox")
+    v3 = f.encode("totally different words entirely")
+    assert float(v1 @ v2) > 0.99
+    assert float(v1 @ v3) < 0.3

@@ -1,0 +1,66 @@
+"""Fingerprint semantics parity tests.
+
+The intent-tag vocabulary and signature layout must be stable — they are the
+primary similarity signal (reference: services/shared/fingerprint.py:22-66).
+"""
+
+from kakveda_tpu.core.fingerprint import (
+    detect_citation_markers,
+    fingerprint,
+    normalize_prompt,
+    prompt_intent_tags,
+    signature_text,
+)
+
+
+def test_normalize_prompt():
+    assert normalize_prompt("  Hello\t World \n") == "hello world"
+
+
+def test_intent_tags_citations_summarize():
+    tags = prompt_intent_tags("Summarize this document and include citations even if not provided.")
+    assert tags == [
+        "constraint:no_sources_provided",
+        "instruction:include_references",
+        "intent:citations_required",
+        "task:summarization",
+    ]
+
+
+def test_intent_tags_explanation_references():
+    tags = prompt_intent_tags("Explain research paper and add references.")
+    assert tags == ["intent:citations_required", "task:explanation"]
+
+
+def test_intent_tags_empty_for_unrelated():
+    assert prompt_intent_tags("What is the weather in Paris?") == []
+
+
+def test_signature_text_is_app_agnostic_and_stable():
+    s1 = signature_text("Summarize with citations", ["search"], {"os": "linux"})
+    s2 = signature_text("Summarize  with   CITATIONS", ["search"], {"os": "linux"})
+    assert s1 == s2  # normalization collapses case/whitespace
+    assert "intent_tags:" in s1 and "prompt_hint:" in s1
+    assert "tools:search" in s1 and "env_keys:os" in s1
+
+
+def test_signature_sorts_tools_and_env_keys():
+    a = signature_text("hi", ["b", "a", "a"], {"z": 1, "a": 2})
+    assert "tools:a,b" in a
+    assert "env_keys:a,z" in a
+
+
+def test_fingerprint_is_16_hex():
+    fp = fingerprint("Summarize with citations", [], {})
+    assert len(fp) == 16
+    int(fp, 16)  # parses as hex
+
+
+def test_citation_markers():
+    assert detect_citation_markers("See [1] for details").has_citation_markers
+    assert detect_citation_markers("(Smith, 2020) argued...").has_citation_markers
+    assert detect_citation_markers("doi: 10.1000/xyz").has_citation_markers
+    assert detect_citation_markers("References:\n[stuff]").has_citation_markers
+    assert detect_citation_markers("A Bibliography section").has_citation_markers
+    assert not detect_citation_markers("Just a plain answer").has_citation_markers
+    assert not detect_citation_markers("").has_citation_markers

@@ -1,0 +1,156 @@
+"""GFKB behavior tests: versioned upsert, match semantics, patterns,
+replay-from-log (reference behaviors: services/gfkb/app.py:79-198)."""
+
+import numpy as np
+
+from kakveda_tpu.core.fingerprint import signature_text
+from kakveda_tpu.core.schemas import Severity
+from kakveda_tpu.index.gfkb import GFKB
+
+
+def _sig(prompt):
+    return signature_text(prompt, [], {"os": "linux"})
+
+
+def _mk(tmp_path, **kw):
+    kw.setdefault("capacity", 64)
+    kw.setdefault("dim", 1024)
+    return GFKB(data_dir=tmp_path / "data", **kw)
+
+
+def test_upsert_creates_then_versions(tmp_path):
+    kb = _mk(tmp_path)
+    sig = _sig("Summarize with citations")
+    rec, created = kb.upsert_failure(
+        failure_type="HALLUCINATION_CITATION",
+        signature_text=sig,
+        app_id="app-A",
+        impact_severity=Severity.medium,
+        root_cause="rc",
+        resolution="fix",
+    )
+    assert created and rec.failure_id == "F-0001" and rec.version == 1
+
+    rec2, created2 = kb.upsert_failure(
+        failure_type="HALLUCINATION_CITATION",
+        signature_text=sig,
+        app_id="app-B",
+        impact_severity=Severity.medium,
+    )
+    assert not created2
+    assert rec2.failure_id == "F-0001"
+    assert rec2.version == 2
+    assert rec2.occurrences == 2
+    assert rec2.affected_apps == ["app-A", "app-B"]
+    assert rec2.root_cause == "rc"  # evolving knowledge: old value kept
+
+
+def test_match_empty_index(tmp_path):
+    kb = _mk(tmp_path)
+    assert kb.match(_sig("anything")) == []
+
+
+def test_match_ranks_similar_first(tmp_path):
+    kb = _mk(tmp_path)
+    kb.upsert_failure(
+        failure_type="HALLUCINATION_CITATION",
+        signature_text=_sig("Summarize this document and include citations even if not provided."),
+        app_id="app-A",
+        impact_severity=Severity.medium,
+        resolution="say no sources",
+    )
+    kb.upsert_failure(
+        failure_type="TIMEOUT",
+        signature_text=_sig("Transcode this video file to mp4 format please"),
+        app_id="app-C",
+        impact_severity=Severity.low,
+    )
+    matches = kb.match(_sig("Explain research paper and add references."))
+    assert matches
+    assert matches[0].failure_type == "HALLUCINATION_CITATION"
+    assert matches[0].suggested_mitigation == "say no sources"
+    assert matches[0].score > 0.1
+
+
+def test_match_type_post_filter(tmp_path):
+    kb = _mk(tmp_path)
+    kb.upsert_failure(
+        failure_type="HALLUCINATION_CITATION",
+        signature_text=_sig("Summarize with citations"),
+        app_id="a",
+        impact_severity=Severity.medium,
+    )
+    assert kb.match(_sig("Summarize with citations"), failure_type="OTHER") == []
+
+
+def test_batch_upsert_and_batch_match(tmp_path):
+    kb = _mk(tmp_path)
+    items = [
+        dict(
+            failure_type="HALLUCINATION_CITATION",
+            signature_text=_sig(f"Summarize doc {i} with citations"),
+            app_id=f"app-{i % 3}",
+            impact_severity="medium",
+        )
+        for i in range(20)
+    ]
+    out = kb.upsert_failures_batch(items)
+    assert sum(1 for _, c in out if c) == 20
+    assert kb.count == 20
+
+    results = kb.match_batch([_sig("Summarize doc 5 with citations"), _sig("unrelated pasta recipe")])
+    assert len(results) == 2
+    assert results[0][0].score > results[1][0].score if results[1] else True
+
+
+def test_replay_from_jsonl(tmp_path):
+    data = tmp_path / "data"
+    kb = GFKB(data_dir=data, capacity=64, dim=1024)
+    sig = _sig("Summarize with citations")
+    kb.upsert_failure(
+        failure_type="HALLUCINATION_CITATION",
+        signature_text=sig,
+        app_id="app-A",
+        impact_severity=Severity.medium,
+    )
+    kb.upsert_failure(
+        failure_type="HALLUCINATION_CITATION",
+        signature_text=sig,
+        app_id="app-B",
+        impact_severity=Severity.medium,
+    )
+    kb.upsert_pattern(name="P", failure_ids=["F-0001"], affected_apps=["app-A", "app-B"])
+
+    kb2 = GFKB(data_dir=data, capacity=64, dim=1024)
+    assert kb2.count == 1
+    rec = kb2.list_failures()[0]
+    assert rec.version == 2 and rec.occurrences == 2
+    assert len(kb2.list_patterns()) == 1
+    m = kb2.match(sig)
+    assert m and m[0].failure_id == "F-0001" and m[0].score > 0.99
+
+
+def test_capacity_growth(tmp_path):
+    kb = GFKB(data_dir=tmp_path / "data", capacity=8, dim=256)
+    for i in range(30):
+        kb.upsert_failure(
+            failure_type="T",
+            signature_text=_sig(f"unique prompt number {i} about topic {i * 7}"),
+            app_id="a",
+            impact_severity=Severity.low,
+        )
+    assert kb.count == 30
+    m = kb.match(_sig("unique prompt number 17 about topic 119"))
+    assert m and m[0].score > 0.9
+
+
+def test_pattern_upsert_merges(tmp_path):
+    kb = _mk(tmp_path)
+    p1, created = kb.upsert_pattern(name="N", failure_ids=["F-2", "F-1"], affected_apps=["b"])
+    assert created and p1.pattern_id == "FP-0001"
+    assert p1.failure_ids == ["F-1", "F-2"]
+    p2, created2 = kb.upsert_pattern(name="N", failure_ids=["F-3"], affected_apps=["a"], description="d")
+    assert not created2
+    assert p2.failure_ids == ["F-1", "F-2", "F-3"]
+    assert p2.affected_apps == ["a", "b"]
+    assert p2.description == "d"

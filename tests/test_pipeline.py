@@ -1,0 +1,158 @@
+"""Pipeline unit tests: classifier rules, health math, event bus, clustering."""
+
+import asyncio
+from datetime import datetime, timezone
+
+import numpy as np
+import pytest
+
+from kakveda_tpu.core.schemas import FailureSignal, Severity, TracePayload
+from kakveda_tpu.events.bus import EventBus
+from kakveda_tpu.models.runtime import STUB_RESPONSE, StubRuntime
+from kakveda_tpu.ops.clustering import cluster_embeddings
+from kakveda_tpu.pipeline.classifier import HALLUCINATION_CITATION, classify_trace
+from kakveda_tpu.pipeline.health_score import HealthScorer
+
+
+def _trace(prompt, response, app_id="app-A", trace_id="t1"):
+    return TracePayload(
+        trace_id=trace_id,
+        ts=datetime.now(timezone.utc),
+        app_id=app_id,
+        agent_id="agent-1",
+        prompt=prompt,
+        response=response,
+        model="stub",
+        tools=[],
+        env={"os": "linux"},
+    )
+
+
+def _failure(app_id="app-A", ftype=HALLUCINATION_CITATION, sev=Severity.medium):
+    return FailureSignal(
+        trace_id="t",
+        ts=datetime.now(timezone.utc),
+        app_id=app_id,
+        failure_type=ftype,
+        severity=sev,
+        context_signature={},
+    )
+
+
+class TestClassifier:
+    def test_detects_citation_hallucination(self):
+        t = _trace("Summarize this and include citations", STUB_RESPONSE)
+        sig = classify_trace(t)
+        assert sig is not None
+        assert sig.failure_type == HALLUCINATION_CITATION
+        assert sig.severity == Severity.medium
+        assert sig.app_id == "app-A"
+        assert sig.context_signature["prompt_shape"].startswith("Summarize")
+
+    def test_no_failure_without_citation_request(self):
+        assert classify_trace(_trace("What's 2+2?", STUB_RESPONSE)) is None
+
+    def test_no_failure_without_markers(self):
+        assert classify_trace(_trace("Summarize with citations", "I have no sources available.")) is None
+
+
+class TestHealthScorer:
+    def test_first_failure_score(self, tmp_path):
+        hs = HealthScorer(tmp_path, persist=True)
+        p = hs.on_failure(_failure())
+        # base 100 − 3·5 (one medium) − 0 recurrence = 85
+        assert p.score == 85.0
+        assert p.failure_rate == 0.1
+        assert p.recurrent_penalty == 0.0
+        assert p.notes["window_failures"] == 1
+
+    def test_recurrence_penalty(self, tmp_path):
+        hs = HealthScorer(tmp_path, persist=False)
+        hs.on_failure(_failure())
+        p = hs.on_failure(_failure())
+        # 2 mediums: 100 − 2·3·5 − 1·2.5 = 67.5
+        assert p.score == 67.5
+        assert p.recurrent_penalty == 2.5
+        assert p.avg_recovery_time_sec == 30.0 + 25.0
+
+    def test_score_floor_zero(self, tmp_path):
+        hs = HealthScorer(tmp_path, persist=False)
+        for _ in range(20):
+            p = hs.on_failure(_failure(sev=Severity.high))
+        assert p.score == 0.0
+
+    def test_history_persisted(self, tmp_path):
+        hs = HealthScorer(tmp_path, persist=True)
+        hs.on_failure(_failure(app_id="a1"))
+        hs.on_failure(_failure(app_id="a2"))
+        hs.on_failure(_failure(app_id="a1"))
+        pts = hs.history("a1")
+        assert len(pts) == 2
+        assert all(p["app_id"] == "a1" for p in pts)
+
+
+class TestEventBus:
+    def test_local_fanout_and_counts(self):
+        bus = EventBus()
+        got = []
+
+        async def h1(e):
+            got.append(("h1", e))
+
+        def h2(e):
+            got.append(("h2", e))
+
+        bus.subscribe("t", h1)
+        bus.subscribe("t", h2)
+        bus.subscribe("t", h2)  # dedupe
+        assert bus.topics() == {"t": 2}
+        delivered = asyncio.run(bus.publish("t", {"x": 1}))
+        assert delivered == 2
+        assert len(got) == 2
+
+    def test_publish_no_subscribers(self):
+        assert asyncio.run(EventBus().publish("nope", {})) == 0
+
+    def test_failing_subscriber_does_not_break_fanout(self):
+        bus = EventBus()
+        got = []
+
+        def bad(e):
+            raise RuntimeError("boom")
+
+        bus.subscribe("t", bad)
+        bus.subscribe("t", lambda e: got.append(e))
+        delivered = asyncio.run(bus.publish("t", {"x": 1}))
+        assert delivered == 1
+        assert got == [{"x": 1}]
+
+
+class TestClustering:
+    def test_two_clear_clusters(self):
+        rng = np.random.default_rng(0)
+        a = rng.standard_normal(64)
+        b = rng.standard_normal(64)
+        a /= np.linalg.norm(a)
+        b /= np.linalg.norm(b)
+
+        def jitter(v):
+            w = v + 0.05 * rng.standard_normal(64)
+            return w / np.linalg.norm(w)
+
+        vecs = np.stack([jitter(a), jitter(a), jitter(a), jitter(b), jitter(b)]).astype(np.float32)
+        labels = cluster_embeddings(vecs, threshold=0.8)
+        assert labels[0] == labels[1] == labels[2]
+        assert labels[3] == labels[4]
+        assert labels[0] != labels[3]
+
+    def test_isolated_points_get_own_labels(self):
+        vecs = np.eye(8, dtype=np.float32)[:4]
+        labels = cluster_embeddings(vecs, threshold=0.5)
+        assert len(set(labels.tolist())) == 4
+
+
+def test_stub_runtime_matches_reference_text():
+    res = StubRuntime().generate("anything")
+    assert res.text == STUB_RESPONSE
+    assert res.meta["provider"] == "stub"
+    assert "[1]" in res.text  # trips the citation-marker detector
