@@ -1,0 +1,127 @@
+"""Sampling loop + the `tpu` model runtime over the in-tree Llama.
+
+The decode loop drives ``decode_step`` (KV-cache incremental forward) with
+fixed [B, 1] token shapes, so after the first call everything is a warm
+compiled program. Greedy or temperature sampling.
+
+``LlamaRuntime`` is the drop-in ``runtime=tpu`` backend
+(kakveda_tpu.models.runtime.get_runtime): same GenerateResult meta shape as
+the stub/ollama tiers. Without a checkpoint it runs a deterministic
+randomly-initialized model — useful for latency/meta plumbing and tests;
+load real weights via KAKVEDA_LLAMA_CKPT (orbax checkpoint of the param
+pytree).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from kakveda_tpu.models.llama import (
+    LlamaConfig,
+    Params,
+    decode_step,
+    init_cache,
+    init_params,
+)
+from kakveda_tpu.models.runtime import GenerateResult
+from kakveda_tpu.models.tokenizer import ByteTokenizer
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def _decode_jit(params, cfg: LlamaConfig, tokens, cache):
+    return decode_step(params, cfg, tokens, cache)
+
+
+def generate_tokens(
+    params: Params,
+    cfg: LlamaConfig,
+    prompt_ids: list[int],
+    *,
+    max_new_tokens: int = 64,
+    temperature: float = 0.0,
+    rng: Optional[jax.Array] = None,
+    eos_id: Optional[int] = None,
+    max_len: Optional[int] = None,
+) -> list[int]:
+    """Autoregressive decode; returns only the newly generated ids."""
+    ml = max_len or min(cfg.max_seq_len, len(prompt_ids) + max_new_tokens + 1)
+    cache = init_cache(cfg, batch=1, max_len=ml)
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+
+    prompt = jnp.asarray([prompt_ids], jnp.int32)
+    logits, cache = _decode_jit(params, cfg, prompt, cache)
+    last = logits[:, -1, :]
+
+    out: list[int] = []
+    for _ in range(max_new_tokens):
+        if temperature > 0.0:
+            rng, sub = jax.random.split(rng)
+            nxt = jax.random.categorical(sub, last / temperature, axis=-1)
+        else:
+            nxt = jnp.argmax(last, axis=-1)
+        tok = int(nxt[0])
+        if eos_id is not None and tok == eos_id:
+            break
+        out.append(tok)
+        if len(prompt_ids) + len(out) >= ml:
+            break
+        logits, cache = _decode_jit(params, cfg, nxt[:, None].astype(jnp.int32), cache)
+        last = logits[:, -1, :]
+    return out
+
+
+class LlamaRuntime:
+    """`runtime=tpu`: on-device Llama generation with the shared meta shape."""
+
+    name = "tpu"
+
+    def __init__(self, cfg: Optional[LlamaConfig] = None, params: Optional[Params] = None, seed: int = 0):
+        self.cfg = cfg or LlamaConfig.tiny()
+        self.tokenizer = ByteTokenizer()
+        if self.cfg.vocab_size < self.tokenizer.vocab_size:
+            raise ValueError("model vocab smaller than tokenizer vocab")
+        self.params = params if params is not None else init_params(jax.random.PRNGKey(seed), self.cfg)
+
+    @classmethod
+    def from_env(cls) -> "LlamaRuntime":
+        preset = os.environ.get("KAKVEDA_LLAMA_PRESET", "tiny").lower()
+        cfg = LlamaConfig.llama3_8b() if preset in ("8b", "llama3-8b") else LlamaConfig.tiny()
+        rt = cls(cfg=cfg)
+        ckpt = os.environ.get("KAKVEDA_LLAMA_CKPT")
+        if ckpt:
+            rt.load_checkpoint(ckpt)
+        return rt
+
+    def load_checkpoint(self, path: str) -> None:
+        import orbax.checkpoint as ocp
+
+        ckptr = ocp.StandardCheckpointer()
+        self.params = ckptr.restore(path, self.params)
+
+    def generate(self, prompt: str, *, model: Optional[str] = None, max_tokens: int = 64) -> GenerateResult:
+        started = time.perf_counter()
+        ids = self.tokenizer.encode(prompt)[-self.cfg.max_seq_len // 2 :]
+        new_ids = generate_tokens(
+            self.params,
+            self.cfg,
+            ids,
+            max_new_tokens=max_tokens,
+            eos_id=self.tokenizer.EOS,
+        )
+        text = self.tokenizer.decode(new_ids)
+        return GenerateResult(
+            text=text,
+            meta={
+                "provider": "tpu",
+                "model": model or f"llama-{self.cfg.n_layers}L-{self.cfg.d_model}d",
+                "latency_ms": int((time.perf_counter() - started) * 1000),
+                "tokens_generated": len(new_ids),
+            },
+        )

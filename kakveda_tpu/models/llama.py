@@ -1,0 +1,389 @@
+"""In-tree JAX Llama — the framework's on-pod model runtime.
+
+Replaces the reference's HTTP hop to an external Ollama daemon
+(reference: services/dashboard/app.py:1182-1258) with a Llama-family
+transformer that lives on the same TPU mesh as the GFKB index, so the
+scenario runner, playground and LLM failure-classifier share the pod.
+
+Design is TPU-first, pure functional JAX (no framework classes):
+
+  * params are a plain pytree with a parallel tree of ``PartitionSpec``s —
+    tensor parallelism shards attention heads and FFN width over the ``tp``
+    mesh axis (Megatron layout: column-parallel qkv/gate/up, row-parallel
+    o/down; XLA inserts the all-reduces from the sharding constraints);
+  * batch is data-parallel over ``dp``; the sequence axis is context-
+    parallel over ``cp`` with **ring attention** (shard_map + ppermute with
+    an online-softmax accumulator), so long contexts scale across devices
+    while weights stay put — see ``ring_attention``;
+  * everything jits with static shapes: fixed seq len per call, KV-cache
+    decode for generation.
+
+GQA, RoPE, RMSNorm, SwiGLU — Llama-3 architecture; ``LlamaConfig.llama3_8b``
+matches the released 8B shapes, tiny configs drive tests and the hermetic
+runtime.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+Params = Dict[str, Any]
+
+_NEG_INF = -1e30
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 264  # ByteTokenizer's 259, padded to a tp-friendly multiple of 8
+    d_model: int = 256
+    n_layers: int = 2
+    n_heads: int = 8
+    n_kv_heads: int = 4
+    d_ff: int = 1024
+    max_seq_len: int = 2048
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @classmethod
+    def tiny(cls, **kw) -> "LlamaConfig":
+        return cls(**kw)
+
+    @classmethod
+    def llama3_8b(cls, vocab_size: int = 128256) -> "LlamaConfig":
+        return cls(
+            vocab_size=vocab_size,
+            d_model=4096,
+            n_layers=32,
+            n_heads=32,
+            n_kv_heads=8,
+            d_ff=14336,
+            max_seq_len=8192,
+            rope_theta=500000.0,
+        )
+
+
+# ---------------------------------------------------------------------------
+# parameters
+# ---------------------------------------------------------------------------
+
+
+def init_params(rng: jax.Array, cfg: LlamaConfig) -> Params:
+    """He-ish init; params stored in f32, compute in cfg.dtype."""
+    keys = jax.random.split(rng, cfg.n_layers + 2)
+
+    def dense(key, fan_in, shape):
+        return (jax.random.normal(key, shape, jnp.float32) / math.sqrt(fan_in)).astype(jnp.float32)
+
+    hd = cfg.head_dim
+    layers = []
+    for i in range(cfg.n_layers):
+        k = jax.random.split(keys[i], 7)
+        layers.append(
+            {
+                "attn_norm": jnp.ones((cfg.d_model,), jnp.float32),
+                "wq": dense(k[0], cfg.d_model, (cfg.d_model, cfg.n_heads * hd)),
+                "wk": dense(k[1], cfg.d_model, (cfg.d_model, cfg.n_kv_heads * hd)),
+                "wv": dense(k[2], cfg.d_model, (cfg.d_model, cfg.n_kv_heads * hd)),
+                "wo": dense(k[3], cfg.n_heads * hd, (cfg.n_heads * hd, cfg.d_model)),
+                "mlp_norm": jnp.ones((cfg.d_model,), jnp.float32),
+                "w_gate": dense(k[4], cfg.d_model, (cfg.d_model, cfg.d_ff)),
+                "w_up": dense(k[5], cfg.d_model, (cfg.d_model, cfg.d_ff)),
+                "w_down": dense(k[6], cfg.d_ff, (cfg.d_ff, cfg.d_model)),
+            }
+        )
+    return {
+        "embed": dense(keys[-2], cfg.d_model, (cfg.vocab_size, cfg.d_model)),
+        "layers": layers,
+        "final_norm": jnp.ones((cfg.d_model,), jnp.float32),
+        "lm_head": dense(keys[-1], cfg.d_model, (cfg.d_model, cfg.vocab_size)),
+    }
+
+
+def param_specs(cfg: LlamaConfig) -> Params:
+    """PartitionSpec tree: Megatron TP layout over the ``tp`` axis."""
+    layer = {
+        "attn_norm": P(),
+        "wq": P(None, "tp"),
+        "wk": P(None, "tp"),
+        "wv": P(None, "tp"),
+        "wo": P("tp", None),
+        "mlp_norm": P(),
+        "w_gate": P(None, "tp"),
+        "w_up": P(None, "tp"),
+        "w_down": P("tp", None),
+    }
+    return {
+        "embed": P("tp", None),  # vocab-sharded table
+        "layers": [dict(layer) for _ in range(cfg.n_layers)],
+        "final_norm": P(),
+        "lm_head": P(None, "tp"),
+    }
+
+
+# ---------------------------------------------------------------------------
+# building blocks
+# ---------------------------------------------------------------------------
+
+
+def rms_norm(x: jax.Array, w: jax.Array, eps: float) -> jax.Array:
+    x32 = x.astype(jnp.float32)
+    scale = jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+    return (x32 * scale).astype(x.dtype) * w.astype(x.dtype)
+
+
+def _rope_freqs(cfg: LlamaConfig, positions: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """cos/sin tables [..., head_dim/2] for given positions."""
+    half = cfg.head_dim // 2
+    inv = 1.0 / (cfg.rope_theta ** (jnp.arange(half, dtype=jnp.float32) / half))
+    ang = positions[..., None].astype(jnp.float32) * inv  # [..., half]
+    return jnp.cos(ang), jnp.sin(ang)
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """x: [B, S, H, D]; cos/sin: [B?, S, D/2] broadcast over heads."""
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    c = cos[..., None, :].astype(x.dtype)  # [B, S, 1, half]
+    s = sin[..., None, :].astype(x.dtype)
+    return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+
+
+def _repeat_kv(x: jax.Array, n_rep: int) -> jax.Array:
+    """[B, S, KV, D] -> [B, S, KV*n_rep, D] (GQA broadcast)."""
+    if n_rep == 1:
+        return x
+    b, s, kv, d = x.shape
+    return jnp.broadcast_to(x[:, :, :, None, :], (b, s, kv, n_rep, d)).reshape(b, s, kv * n_rep, d)
+
+
+def causal_attention(q: jax.Array, k: jax.Array, v: jax.Array, q_off: jax.Array | int = 0) -> jax.Array:
+    """Plain causal attention. q: [B,Sq,H,D], k/v: [B,Sk,H,D] (already
+    GQA-repeated). ``q_off`` is the global position of q[0] relative to k[0]
+    (for cached decode). Returns [B,Sq,H,D]."""
+    scale = q.shape[-1] ** -0.5
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    q_pos = jnp.arange(q.shape[1]) + q_off
+    k_pos = jnp.arange(k.shape[1])
+    mask = q_pos[:, None] >= k_pos[None, :]
+    scores = jnp.where(mask[None, None], scores, _NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def ring_attention_local(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    n_chunks: int,
+) -> jax.Array:
+    """Ring attention body — runs *inside* shard_map, sequence sharded over
+    ``axis_name``. Each step attends the local queries against the currently
+    held K/V chunk with the right global causal mask, folds the result into
+    an online-softmax accumulator, then rotates K/V one hop around the ring
+    (ppermute over ICI). FLOP-pattern equivalent to blockwise flash
+    attention across devices; no device ever holds the full sequence.
+
+    q/k/v: [B, S_local, H_local, D] (kv already GQA-repeated).
+    """
+    b, s_l, h, d = q.shape
+    scale = d**-0.5
+    me = jax.lax.axis_index(axis_name)
+
+    q_pos = me * s_l + jnp.arange(s_l)  # global positions of local queries
+    m = jnp.full((b, h, s_l), _NEG_INF, jnp.float32)
+    l = jnp.zeros((b, h, s_l), jnp.float32)
+    acc = jnp.zeros((b, h, s_l, d), jnp.float32)
+
+    perm = [(j, (j + 1) % n_chunks) for j in range(n_chunks)]
+    k_cur, v_cur = k, v
+    for i in range(n_chunks):  # static unroll: n_chunks is a mesh constant
+        src = (me - i) % n_chunks  # whose chunk we hold this step
+        k_pos = src * s_l + jnp.arange(s_l)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_cur).astype(jnp.float32) * scale
+        mask = (q_pos[:, None] >= k_pos[None, :])[None, None]
+        scores = jnp.where(mask, scores, _NEG_INF)
+
+        chunk_max = jnp.max(scores, axis=-1)
+        m_new = jnp.maximum(m, chunk_max)
+        # Re-mask after the exp: if every score in this chunk is masked the
+        # subtraction would give exp(0)=1 on the first (all-masked) step.
+        p = jnp.where(mask, jnp.exp(scores - m_new[..., None]), 0.0)
+        corr = jnp.exp(m - m_new)
+        l = l * corr + jnp.sum(p, axis=-1)
+        acc = acc * corr[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd", p.astype(v_cur.dtype), v_cur
+        ).astype(jnp.float32)
+        m = m_new
+
+        if i < n_chunks - 1:
+            k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
+            v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+
+    out = acc / jnp.maximum(l[..., None], 1e-20)
+    return jnp.einsum("bhqd->bqhd", out).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+
+def _attention_block(
+    x: jax.Array,
+    layer: Params,
+    cfg: LlamaConfig,
+    cos: jax.Array,
+    sin: jax.Array,
+    mesh: Optional[Mesh],
+    cp_axis: Optional[str],
+) -> jax.Array:
+    b, s, _ = x.shape
+    hd = cfg.head_dim
+    dt = x.dtype
+
+    q = (x @ layer["wq"].astype(dt)).reshape(b, s, cfg.n_heads, hd)
+    k = (x @ layer["wk"].astype(dt)).reshape(b, s, cfg.n_kv_heads, hd)
+    v = (x @ layer["wv"].astype(dt)).reshape(b, s, cfg.n_kv_heads, hd)
+
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+
+    n_rep = cfg.n_heads // cfg.n_kv_heads
+    k = _repeat_kv(k, n_rep)
+    v = _repeat_kv(v, n_rep)
+
+    if mesh is not None and cp_axis is not None and mesh.shape[cp_axis] > 1:
+        n_cp = mesh.shape[cp_axis]
+        tp = "tp" if "tp" in mesh.axis_names else None
+        spec = P("dp", cp_axis, tp, None)
+        attn = jax.shard_map(
+            partial(ring_attention_local, axis_name=cp_axis, n_chunks=n_cp),
+            mesh=mesh,
+            in_specs=(spec, spec, spec),
+            out_specs=spec,
+            check_vma=False,
+        )(q, k, v)
+    else:
+        attn = causal_attention(q, k, v)
+
+    return attn.reshape(b, s, cfg.n_heads * hd) @ layer["wo"].astype(dt)
+
+
+def _mlp_block(x: jax.Array, layer: Params) -> jax.Array:
+    dt = x.dtype
+    gate = jax.nn.silu(x @ layer["w_gate"].astype(dt))
+    up = x @ layer["w_up"].astype(dt)
+    return (gate * up) @ layer["w_down"].astype(dt)
+
+
+def forward(
+    params: Params,
+    cfg: LlamaConfig,
+    tokens: jax.Array,
+    *,
+    mesh: Optional[Mesh] = None,
+    cp_axis: Optional[str] = None,
+    positions: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Full-sequence forward: tokens [B, S] -> logits [B, S, vocab].
+
+    With ``mesh``+``cp_axis`` the sequence axis is context-parallel and
+    attention runs as a ring over that axis; RoPE positions are the *global*
+    positions, threaded in by the caller via ``positions`` when the local
+    shard doesn't start at 0 (handled automatically under jit because the
+    whole [B, S] array is logically global).
+    """
+    b, s = tokens.shape
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(s), (b, s))
+    cos, sin = _rope_freqs(cfg, positions)
+
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    for layer in params["layers"]:
+        h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+        x = x + _attention_block(h, layer, cfg, cos, sin, mesh, cp_axis)
+        h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+        x = x + _mlp_block(h, layer)
+
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    return (x @ params["lm_head"].astype(cfg.dtype)).astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# KV-cache decode
+# ---------------------------------------------------------------------------
+
+
+def init_cache(cfg: LlamaConfig, batch: int, max_len: Optional[int] = None) -> Params:
+    ml = max_len or cfg.max_seq_len
+    hd = cfg.head_dim
+    return {
+        "pos": jnp.zeros((), jnp.int32),
+        "k": jnp.zeros((cfg.n_layers, batch, ml, cfg.n_kv_heads, hd), cfg.dtype),
+        "v": jnp.zeros((cfg.n_layers, batch, ml, cfg.n_kv_heads, hd), cfg.dtype),
+    }
+
+
+def decode_step(
+    params: Params,
+    cfg: LlamaConfig,
+    tokens: jax.Array,  # [B, S] — prompt chunk or single sampled token
+    cache: Params,
+) -> Tuple[jax.Array, Params]:
+    """Incremental forward with KV cache; returns (logits [B, S, V], cache)."""
+    b, s = tokens.shape
+    pos0 = cache["pos"]
+    positions = jnp.broadcast_to(jnp.arange(s) + pos0, (b, s))
+    cos, sin = _rope_freqs(cfg, positions)
+    hd = cfg.head_dim
+    n_rep = cfg.n_heads // cfg.n_kv_heads
+    max_len = cache["k"].shape[2]
+
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    new_k, new_v = [], []
+    for li, layer in enumerate(params["layers"]):
+        h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+        dt = h.dtype
+        q = (h @ layer["wq"].astype(dt)).reshape(b, s, cfg.n_heads, hd)
+        k = (h @ layer["wk"].astype(dt)).reshape(b, s, cfg.n_kv_heads, hd)
+        v = (h @ layer["wv"].astype(dt)).reshape(b, s, cfg.n_kv_heads, hd)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+
+        k_all = jax.lax.dynamic_update_slice(cache["k"][li], k.astype(cfg.dtype), (0, pos0, 0, 0))
+        v_all = jax.lax.dynamic_update_slice(cache["v"][li], v.astype(cfg.dtype), (0, pos0, 0, 0))
+        new_k.append(k_all)
+        new_v.append(v_all)
+
+        kr = _repeat_kv(k_all, n_rep)
+        vr = _repeat_kv(v_all, n_rep)
+        scale = hd**-0.5
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, kr).astype(jnp.float32) * scale
+        q_pos = pos0 + jnp.arange(s)
+        k_pos = jnp.arange(max_len)
+        mask = q_pos[:, None] >= k_pos[None, :]  # causal + excludes unwritten slots
+        scores = jnp.where(mask[None, None], scores, _NEG_INF)
+        probs = jax.nn.softmax(scores, axis=-1).astype(dt)
+        attn = jnp.einsum("bhqk,bkhd->bqhd", probs, vr)
+        x = x + attn.reshape(b, s, cfg.n_heads * hd) @ layer["wo"].astype(dt)
+
+        h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+        x = x + _mlp_block(h, layer)
+
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = (x @ params["lm_head"].astype(cfg.dtype)).astype(jnp.float32)
+    new_cache = {"pos": pos0 + s, "k": jnp.stack(new_k), "v": jnp.stack(new_v)}
+    return logits, new_cache

@@ -122,12 +122,8 @@ def get_runtime(name: Optional[str] = None) -> ModelRuntime:
     elif name == "ollama":
         rt = OllamaRuntime()
     elif name == "tpu":
-        try:
-            from kakveda_tpu.models.llama import LlamaRuntime
-        except ImportError as e:
-            raise NotImplementedError(
-                "the tpu model runtime requires kakveda_tpu.models.llama"
-            ) from e
+        from kakveda_tpu.models.generate import LlamaRuntime
+
         rt = LlamaRuntime.from_env()
     else:
         raise ValueError(f"unknown model runtime: {name!r}")

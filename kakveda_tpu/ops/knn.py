@@ -77,17 +77,35 @@ class ShardedKnn:
             store_dtype = jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32
         self.store_dtype = store_dtype
 
-        self._emb_sharding = NamedSharding(mesh, P(shard_axis, None))
-        self._valid_sharding = NamedSharding(mesh, P(shard_axis))
-        self._repl = NamedSharding(mesh, P())
-
+        # Single-device meshes take a plain-jit path: identical math, no
+        # shard_map / NamedSharding. Besides being the natural degenerate
+        # case, this sidesteps a pathology of the remote-TPU (axon) runtime
+        # where dispatches of mesh-sharded programs degrade to ~70 ms after
+        # the first host fetch of a NamedSharding-backed output.
+        if capacity > (1 << 24):
+            raise ValueError(
+                f"capacity {capacity} exceeds 2^24: packed f32 row indices "
+                "would lose precision (widen _pack before raising this limit)"
+            )
+        self.single_device = mesh.devices.size == 1
+        if self.single_device:
+            self._device = mesh.devices.flat[0]
+            sharding = jax.sharding.SingleDeviceSharding(self._device)
+            self._emb_sharding = sharding
+            self._valid_sharding = sharding
+            self._repl = sharding
+            self._topk = jax.jit(self._topk_single_impl)
+        else:
+            self._emb_sharding = NamedSharding(mesh, P(shard_axis, None))
+            self._valid_sharding = NamedSharding(mesh, P(shard_axis))
+            self._repl = NamedSharding(mesh, P())
+            self._topk = jax.jit(self._topk_impl)
         self._insert = jax.jit(self._insert_impl, donate_argnums=(0, 1))
-        self._topk = jax.jit(self._topk_impl)
 
     # --- allocation ------------------------------------------------------
 
     def alloc(self) -> Tuple[jax.Array, jax.Array]:
-        """Fresh (embeddings, valid) buffers, sharded, zeroed."""
+        """Fresh (embeddings, valid) buffers on the mesh, zeroed."""
         emb = jax.device_put(
             jnp.zeros((self.capacity, self.dim), dtype=self.store_dtype),
             self._emb_sharding,
@@ -118,6 +136,29 @@ class ShardedKnn:
 
     # --- match -----------------------------------------------------------
 
+    @staticmethod
+    def _pack(vals: jax.Array, phys: jax.Array) -> jax.Array:
+        """Fuse (scores, rows) into one [B, 2k] f32 buffer.
+
+        One output buffer means one device→host fetch per match call — on
+        remote-attached TPUs each fetch pays a fixed wire RTT, so halving
+        fetches halves the latency floor. Row indices are exact in f32 up to
+        2^24 (capacities beyond 16M rows would need a wider packing).
+        """
+        return jnp.concatenate([vals, phys.astype(jnp.float32)], axis=1)
+
+    def _topk_single_impl(self, emb, valid, q):
+        """Degenerate one-shard path: one matmul + one top_k, plain jit."""
+        scores = jax.lax.dot_general(
+            q.astype(emb.dtype),
+            emb,
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        scores = jnp.where(valid[None, :], scores, _NEG)
+        vals, idx = jax.lax.top_k(scores, min(self.k, emb.shape[0]))
+        return self._pack(vals, idx)
+
     def _topk_impl(self, emb, valid, q):
         k = self.k
 
@@ -143,7 +184,7 @@ class ShardedKnn:
             flat_phys = jnp.transpose(all_phys, (1, 0, 2)).reshape(B, n * kk)
             mvals, midx = jax.lax.top_k(flat_vals, min(k, n * kk))
             mphys = jnp.take_along_axis(flat_phys, midx, axis=1)
-            return mvals, mphys
+            return self._pack(mvals, mphys)
 
         # check_vma=False: after the all_gather every shard computes the
         # identical merged top-k, so the outputs are replicated by
@@ -152,17 +193,32 @@ class ShardedKnn:
             local,
             mesh=self.mesh,
             in_specs=(P(self.axis, None), P(self.axis), P()),
-            out_specs=(P(), P()),
+            out_specs=P(),
             check_vma=False,
         )(emb, valid, q)
 
+    def topk_async(self, emb: jax.Array, valid: jax.Array, q: np.ndarray) -> jax.Array:
+        """Dispatch a match and start the host copy; returns the packed
+        [B, 2k] device buffer. Pair with ``topk_result`` — lets a serving
+        loop pipeline batch i's compute with batch i-1's fetch."""
+        qd = jax.device_put(jnp.asarray(q, dtype=jnp.float32), self._repl)
+        packed = self._topk(emb, valid, qd)
+        packed.copy_to_host_async()
+        return packed
+
+    def topk_result(self, packed: jax.Array) -> Tuple[np.ndarray, np.ndarray]:
+        """(scores, logical slots) from a ``topk_async`` buffer."""
+        host = np.asarray(packed)
+        kk = host.shape[1] // 2
+        vals = host[:, :kk]
+        phys = host[:, kk:].astype(np.int64)
+        if self.single_device:
+            return vals, phys  # physical row == logical slot on one shard
+        return vals, physical_to_slot(phys, self.n_shards, self.rows_per_shard)
+
     def topk(self, emb: jax.Array, valid: jax.Array, q: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
         """Top-k (scores, logical slots) for a [B, dim] query batch."""
-        qd = jax.device_put(jnp.asarray(q, dtype=jnp.float32), self._repl)
-        vals, phys = self._topk(emb, valid, qd)
-        vals = np.asarray(vals)
-        slots = physical_to_slot(np.asarray(phys), self.n_shards, self.rows_per_shard)
-        return vals, slots
+        return self.topk_result(self.topk_async(emb, valid, q))
 
 
 @functools.lru_cache(maxsize=8)
