@@ -80,7 +80,9 @@ def _measure_ours(n: int, dim: int, n_queries: int) -> float:
 
     feat = HashedNGramFeaturizer(dim=dim)
     B = int(os.environ.get("KAKVEDA_BENCH_BATCH", 64))  # μ-batch of concurrent pre-flights
-    n_batches = max(4, n_queries // B)
+    depth = int(os.environ.get("KAKVEDA_BENCH_PIPELINE", 4))
+    # Need enough batches to fill the pipeline and still record ≥8 periods.
+    n_batches = max(depth + 8, n_queries // B)
     sig_batches = [
         [
             signature_text(
@@ -110,7 +112,6 @@ def _measure_ours(n: int, dim: int, n_queries: int) -> float:
     # steady-state pipeline period / B.
     from collections import deque
 
-    depth = int(os.environ.get("KAKVEDA_BENCH_PIPELINE", 4))
     periods = []
     inflight: deque = deque()
     t_prev = time.perf_counter()

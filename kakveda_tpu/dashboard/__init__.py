@@ -1,0 +1,11 @@
+"""Dashboard application layer.
+
+Capability parity with the reference dashboard
+(reference: services/dashboard/ — app.py, db.py, auth.py, rbac.py,
+templates/): auth + RBAC, scenario runner, runs explorer with span
+waterfalls, warnings analytics, per-app health, datasets/evaluations,
+prompt library, experiments, playground, external-agent registry, projects
+with API keys and budgets, admin. Built on aiohttp + stdlib sqlite3 +
+jinja2 (this image has no FastAPI/SQLAlchemy/passlib; auth crypto is
+stdlib hashlib/hmac).
+"""

@@ -1,0 +1,77 @@
+"""Dashboard app factory.
+
+Assembles the aiohttp application: DB init + demo-user bootstrap + prod
+secret guardrail (reference: services/dashboard/app.py:1261-1329),
+middlewares (user resolution, security headers, request logging), and all
+route modules.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Optional
+
+from aiohttp import web
+
+from kakveda_tpu.core.runtime import get_runtime_config
+from kakveda_tpu.dashboard.core import (
+    CTX_KEY,
+    DashboardContext,
+    security_headers_middleware,
+    user_middleware,
+)
+from kakveda_tpu.dashboard.db import Database
+from kakveda_tpu.models.runtime import ModelRuntime, get_runtime
+from kakveda_tpu.platform import Platform
+from kakveda_tpu.service.app import request_context_middleware
+
+
+def make_dashboard_app(
+    platform: Optional[Platform] = None,
+    db_path: str | Path | None = None,
+    model: Optional[ModelRuntime] = None,
+    demo_users: bool = True,
+    **platform_kw,
+) -> web.Application:
+    cfg = get_runtime_config(service_name="dashboard")
+    if cfg.env == "production" and cfg.dashboard_jwt_secret == "dev-secret-change-me":
+        raise RuntimeError(
+            "refusing to start in production with the default JWT secret "
+            "(set DASHBOARD_JWT_SECRET)"
+        )
+
+    plat = platform or Platform(**platform_kw)
+    db = Database(db_path or (Path(cfg.data_dir) / "dashboard.db"))
+    db.bootstrap(demo_users=demo_users)
+
+    ctx = DashboardContext(
+        platform=plat,
+        db=db,
+        model=model or get_runtime(cfg.model_runtime),
+        jwt_secret=cfg.dashboard_jwt_secret,
+    )
+
+    app = web.Application(
+        middlewares=[request_context_middleware, user_middleware, security_headers_middleware]
+    )
+    app[CTX_KEY] = ctx
+
+    from kakveda_tpu.dashboard import routes_admin, routes_auth, routes_data, routes_main
+
+    routes_auth.setup(app)
+    routes_main.setup(app)
+    routes_data.setup(app)
+    routes_admin.setup(app)
+
+    async def healthz(request):
+        return web.json_response({"ok": True})
+
+    async def readyz(request):
+        try:
+            db.one("SELECT 1 AS one")
+            return web.json_response({"ok": True})
+        except Exception as e:  # noqa: BLE001
+            return web.json_response({"ok": False, "error": str(e)}, status=503)
+
+    app.add_routes([web.get("/healthz", healthz), web.get("/readyz", readyz)])
+    return app

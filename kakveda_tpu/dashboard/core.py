@@ -1,0 +1,175 @@
+"""Dashboard app context: jinja env, auth/session helpers, middleware."""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Dict, List, Optional
+
+import jinja2
+from aiohttp import web
+
+from kakveda_tpu.core.runtime import get_runtime_config
+from kakveda_tpu.dashboard import auth as auth_lib
+from kakveda_tpu.dashboard import rbac
+from kakveda_tpu.dashboard.db import Database
+from kakveda_tpu.models.runtime import ModelRuntime
+from kakveda_tpu.platform import Platform
+
+log = logging.getLogger("kakveda.dashboard")
+
+COOKIE_NAME = "kakveda_token"
+VIEW_AS_COOKIE = "kakveda_view_as"
+PROJECT_COOKIE = "kakveda_project"
+
+TEMPLATES_DIR = Path(__file__).parent / "templates"
+
+
+@dataclass
+class DashboardContext:
+    platform: Platform
+    db: Database
+    model: ModelRuntime
+    jwt_secret: str
+    jinja: jinja2.Environment = field(init=False)
+
+    def __post_init__(self):
+        self.jinja = jinja2.Environment(
+            loader=jinja2.FileSystemLoader(str(TEMPLATES_DIR)),
+            autoescape=True,
+        )
+
+    def render(self, request: web.Request, template: str, **ctx: Any) -> web.Response:
+        user = request.get("user")
+        html = self.jinja.get_template(template).render(user=user, request=request, **ctx)
+        return web.Response(text=html, content_type="text/html")
+
+
+CTX_KEY: web.AppKey[DashboardContext] = web.AppKey("dashboard_ctx", DashboardContext)
+
+
+# --- user resolution -------------------------------------------------------
+
+
+@dataclass
+class CurrentUser:
+    email: str
+    display_name: str
+    roles: List[str]
+    user_id: int
+    impersonated_by: Optional[str] = None
+
+    @property
+    def is_admin(self) -> bool:
+        return rbac.has_role(self.roles, rbac.ADMIN)
+
+
+def resolve_user(request: web.Request) -> Optional[CurrentUser]:
+    """Cookie JWT → DB-truth user (roles come from the DB, not the token —
+    reference: services/dashboard/app.py:681-720 — with admin 'view-as'
+    impersonation via a second cookie)."""
+    ctx = request.app[CTX_KEY]
+    token = request.cookies.get(COOKIE_NAME)
+    if not token:
+        return None
+    claims = auth_lib.decode_token(token, secret=ctx.jwt_secret)
+    if not claims:
+        return None
+    row = ctx.db.user_by_email(claims.get("sub", ""))
+    if row is None or not row["is_active"]:
+        return None
+    roles = ctx.db.user_roles(row["id"])
+    user = CurrentUser(
+        email=row["email"],
+        display_name=row["display_name"] or row["email"],
+        roles=roles,
+        user_id=row["id"],
+    )
+    view_as = request.cookies.get(VIEW_AS_COOKIE)
+    if view_as and user.is_admin:
+        target = ctx.db.user_by_email(view_as)
+        if target is not None:
+            return CurrentUser(
+                email=target["email"],
+                display_name=target["display_name"] or target["email"],
+                roles=ctx.db.user_roles(target["id"]),
+                user_id=target["id"],
+                impersonated_by=user.email,
+            )
+    return user
+
+
+def require_login(handler):
+    async def wrapped(request: web.Request):
+        if request.get("user") is None:
+            raise web.HTTPFound(f"/login?next={request.path}")
+        return await handler(request)
+
+    return wrapped
+
+
+def require_roles(*allowed: str):
+    def deco(handler):
+        async def wrapped(request: web.Request):
+            user: Optional[CurrentUser] = request.get("user")
+            if user is None:
+                raise web.HTTPFound(f"/login?next={request.path}")
+            if not rbac.require_any(user.roles, allowed):
+                raise web.HTTPForbidden(text="insufficient role")
+            return await handler(request)
+
+        return wrapped
+
+    return deco
+
+
+# --- middleware ------------------------------------------------------------
+
+
+@web.middleware
+async def user_middleware(request: web.Request, handler):
+    request["user"] = resolve_user(request)
+    return await handler(request)
+
+
+@web.middleware
+async def security_headers_middleware(request: web.Request, handler):
+    """CSP/XFO/no-sniff on every response
+    (reference: services/dashboard/app.py:615-626)."""
+    response = await handler(request)
+    response.headers.setdefault(
+        "Content-Security-Policy",
+        "default-src 'self'; style-src 'self' 'unsafe-inline'",
+    )
+    response.headers.setdefault("X-Frame-Options", "DENY")
+    response.headers.setdefault("X-Content-Type-Options", "nosniff")
+    if get_runtime_config(service_name="dashboard").env == "production":
+        response.headers.setdefault(
+            "Strict-Transport-Security", "max-age=31536000; includeSubDomains"
+        )
+    return response
+
+
+# --- shared rate limiter ---------------------------------------------------
+
+
+class RateLimiter:
+    """Fixed-window in-memory limiter
+    (reference: services/shared/redis_helpers.py:62-84, in-memory tier)."""
+
+    def __init__(self):
+        self._hits: Dict[str, tuple[float, int]] = {}
+
+    def allow(self, key: str, limit: int, window_s: float = 60.0) -> bool:
+        now = time.time()
+        start, count = self._hits.get(key, (now, 0))
+        if now - start >= window_s:
+            start, count = now, 0
+        count += 1
+        self._hits[key] = (start, count)
+        return count <= limit
+
+
+RATE_LIMITER = RateLimiter()

@@ -1,0 +1,382 @@
+"""Admin, agent registry, projects, public ingest API
+(reference: services/dashboard/app.py:811-1179, 1436-1605, 2675-2763,
+3651-3694)."""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import secrets
+import shutil
+import time
+from datetime import datetime, timezone
+from typing import Optional
+
+from aiohttp import web
+
+from kakveda_tpu.core.schemas import TracePayload
+from kakveda_tpu.dashboard.core import (
+    CTX_KEY,
+    PROJECT_COOKIE,
+    VIEW_AS_COOKIE,
+    require_login,
+    require_roles,
+)
+from kakveda_tpu.dashboard.routes_main import estimate_cost_micro_usd, estimate_tokens
+
+
+def _hash_api_key(key: str) -> str:
+    return hashlib.sha256(key.encode("utf-8")).hexdigest()
+
+
+def setup(app: web.Application) -> None:
+    ctx = app[CTX_KEY]
+    plat = ctx.platform
+
+    # ------------------------------------------------------------------
+    # admin: users, audit, impersonation, purge
+    # ------------------------------------------------------------------
+
+    @require_roles("admin")
+    async def admin_users(request):
+        users = ctx.db.query("SELECT * FROM users ORDER BY email")
+        for u in users:
+            u["roles"] = ctx.db.user_roles(u["id"])
+        return ctx.render(request, "admin_users.html", users=users)
+
+    @require_roles("admin")
+    async def admin_set_role(request):
+        form = await request.post()
+        uid = int(form.get("user_id", 0))
+        role = str(form.get("role") or "")
+        rid_row = ctx.db.one("SELECT id FROM roles WHERE name=?", (role,))
+        if rid_row is None:
+            raise web.HTTPBadRequest(text="unknown role")
+        ctx.db.execute("DELETE FROM user_roles WHERE user_id=?", (uid,))
+        ctx.db.execute(
+            "INSERT INTO user_roles (user_id, role_id) VALUES (?,?)", (uid, rid_row["id"])
+        )
+        ctx.db.audit(request["user"].email, "admin.set_role", {"user_id": uid, "role": role})
+        raise web.HTTPFound("/admin/users")
+
+    @require_roles("admin")
+    async def admin_toggle_active(request):
+        form = await request.post()
+        uid = int(form.get("user_id", 0))
+        ctx.db.execute("UPDATE users SET is_active = 1 - is_active WHERE id=?", (uid,))
+        ctx.db.audit(request["user"].email, "admin.toggle_active", {"user_id": uid})
+        raise web.HTTPFound("/admin/users")
+
+    @require_roles("admin")
+    async def admin_impersonate(request):
+        """'View as' — second cookie, honored only for admins
+        (reference: services/dashboard/app.py:2730-2763)."""
+        form = await request.post()
+        email = str(form.get("email") or "")
+        resp = web.HTTPFound("/")
+        if email:
+            resp.set_cookie(VIEW_AS_COOKIE, email, httponly=True, samesite="Lax")
+            ctx.db.audit(request["user"].email, "admin.impersonate", {"as": email})
+        else:
+            resp.del_cookie(VIEW_AS_COOKIE)
+            ctx.db.audit(request["user"].email, "admin.impersonate.clear")
+        raise resp
+
+    @require_roles("admin")
+    async def admin_audit(request):
+        events = ctx.db.query("SELECT * FROM audit_events ORDER BY ts DESC LIMIT 200")
+        return ctx.render(request, "admin_audit.html", events=events)
+
+    @require_roles("admin")
+    async def admin_purge_demo(request):
+        """Backup then purge demo apps app-A/app-B from JSONL + DB
+        (reference: services/dashboard/app.py:811-867)."""
+        demo_apps = {"app-A", "app-B"}
+        stamp = datetime.now(timezone.utc).strftime("%Y%m%d-%H%M%S")
+        data_dir = plat.gfkb.data_dir
+        for name in ("failures.jsonl", "patterns.jsonl", "health.jsonl"):
+            p = data_dir / name
+            if p.exists():
+                shutil.copy2(p, p.with_suffix(f".jsonl.bak-{stamp}"))
+        # JSONL purge: rewrite without demo-app rows
+        fpath = plat.gfkb.failures_path
+        if fpath.exists():
+            kept = []
+            for line in fpath.read_text(encoding="utf-8").splitlines():
+                if not line.strip():
+                    continue
+                row = json.loads(line)
+                apps = set(row.get("affected_apps", []))
+                if apps and apps <= demo_apps:
+                    continue
+                kept.append(line)
+            fpath.write_text("\n".join(kept) + ("\n" if kept else ""), encoding="utf-8")
+        for app_id in demo_apps:
+            ctx.db.execute("DELETE FROM trace_runs WHERE app_id=?", (app_id,))
+            ctx.db.execute("DELETE FROM warning_events WHERE app_id=?", (app_id,))
+            ctx.db.execute("DELETE FROM scenario_runs WHERE app_id=?", (app_id,))
+        # The device index and host metadata were built from the pre-purge
+        # log — replay the rewritten files so queries and id minting agree.
+        plat.gfkb.reload()
+        ctx.db.audit(request["user"].email, "admin.purge_demo", {"apps": sorted(demo_apps)})
+        raise web.HTTPFound("/")
+
+    # ------------------------------------------------------------------
+    # agent registry
+    # ------------------------------------------------------------------
+
+    @require_login
+    async def agents_page(request):
+        agents = ctx.db.query("SELECT * FROM agent_registry ORDER BY name")
+        return ctx.render(request, "agents.html", agents=agents, test_result=None)
+
+    @require_roles("admin")
+    async def agent_register(request):
+        form = await request.post()
+        name = str(form.get("name") or "").strip()
+        base_url = str(form.get("base_url") or "").strip()
+        if not name or not base_url:
+            raise web.HTTPBadRequest(text="name and base_url required")
+        ctx.db.execute(
+            "INSERT OR REPLACE INTO agent_registry (name, base_url, auth_kind, auth_secret_env,"
+            " enabled, created_at) VALUES (?,?,?,?,1,?)",
+            (
+                name,
+                base_url,
+                str(form.get("auth_kind") or "none"),
+                # env-var *name*, never the secret itself
+                str(form.get("auth_secret_env") or "") or None,
+                time.time(),
+            ),
+        )
+        ctx.db.audit(request["user"].email, "agent.register", {"name": name})
+        raise web.HTTPFound("/agents")
+
+    @require_roles("admin")
+    async def agent_toggle(request):
+        form = await request.post()
+        name = str(form.get("name") or "")
+        ctx.db.execute("UPDATE agent_registry SET enabled = 1 - enabled WHERE name=?", (name,))
+        raise web.HTTPFound("/agents")
+
+    @require_login
+    async def agent_test(request):
+        """Health-check an agent (reference: app.py:874-946)."""
+        name = request.match_info["name"]
+        agent = ctx.db.one("SELECT * FROM agent_registry WHERE name=?", (name,))
+        if agent is None:
+            raise web.HTTPNotFound(text="agent not found")
+        import httpx
+
+        from kakveda_tpu.dashboard.routes_main import off_loop
+
+        try:
+            r = await off_loop(httpx.get, f"{agent['base_url']}/health", timeout=5.0)
+            result = {"status": r.status_code, "body": r.json()}
+        except Exception as e:  # noqa: BLE001
+            result = {"status": 0, "body": {"error": f"{type(e).__name__}: {e}"}}
+        agents = ctx.db.query("SELECT * FROM agent_registry ORDER BY name")
+        return ctx.render(
+            request, "agents.html", agents=agents, test_result={"name": name, **result}
+        )
+
+    async def agent_self_register(request):
+        """External agents may self-register (reference: app.py:1105-1160)."""
+        body = await request.json()
+        name = str(body.get("name") or "").strip()
+        base_url = str(body.get("base_url") or "").strip()
+        if not name or not base_url:
+            return web.json_response({"ok": False, "error": "name and base_url required"}, status=422)
+        ctx.db.execute(
+            "INSERT OR REPLACE INTO agent_registry (name, base_url, auth_kind, enabled,"
+            " capabilities_json, created_at) VALUES (?,?,'none',1,?,?)",
+            (name, base_url, json.dumps(body.get("capabilities", [])), time.time()),
+        )
+        return web.json_response({"ok": True, "name": name})
+
+    async def agent_heartbeat(request):
+        name = request.match_info["name"]
+        n = ctx.db.execute("UPDATE agent_registry SET last_heartbeat=? WHERE name=?", (time.time(), name))
+        if not n:
+            return web.json_response({"ok": False, "error": "unknown agent"}, status=404)
+        return web.json_response({"ok": True})
+
+    async def api_agents(request):
+        agents = ctx.db.query("SELECT name, base_url, enabled, last_heartbeat FROM agent_registry")
+        return web.json_response({"agents": agents})
+
+    # ------------------------------------------------------------------
+    # projects + API keys + budgets
+    # ------------------------------------------------------------------
+
+    @require_login
+    async def projects_page(request):
+        projects = ctx.db.query(
+            "SELECT p.*, b.monthly_budget_micro_usd, b.spent_micro_usd FROM projects p"
+            " LEFT JOIN project_budgets b ON b.project_id=p.id ORDER BY p.name"
+        )
+        return ctx.render(request, "projects.html", projects=projects, new_key=None)
+
+    @require_roles("admin", "operator")
+    async def project_create(request):
+        form = await request.post()
+        name = str(form.get("name") or "").strip()
+        if not name:
+            raise web.HTTPBadRequest(text="name required")
+        ctx.db.execute(
+            "INSERT OR IGNORE INTO projects (name, created_at) VALUES (?,?)", (name, time.time())
+        )
+        # Re-read the id: an ignored duplicate insert returns no usable
+        # lastrowid, and re-submitting an existing project must still be
+        # able to set its budget.
+        pid = ctx.db.one("SELECT id FROM projects WHERE name=?", (name,))["id"]
+        budget = int(form.get("monthly_budget_micro_usd") or 0)
+        if pid and budget:
+            ctx.db.execute(
+                "INSERT OR REPLACE INTO project_budgets (project_id, monthly_budget_micro_usd,"
+                " spent_micro_usd) VALUES (?,?,COALESCE((SELECT spent_micro_usd FROM"
+                " project_budgets WHERE project_id=?),0))",
+                (pid, budget, pid),
+            )
+        ctx.db.audit(request["user"].email, "project.create", {"name": name})
+        raise web.HTTPFound("/projects")
+
+    @require_login
+    async def project_select(request):
+        form = await request.post()
+        pid = str(form.get("project_id") or "")
+        resp = web.HTTPFound("/projects")
+        if pid:
+            resp.set_cookie(PROJECT_COOKIE, pid, httponly=True, samesite="Lax")
+        else:
+            resp.del_cookie(PROJECT_COOKIE)
+        raise resp
+
+    @require_roles("admin", "operator")
+    async def project_api_key(request):
+        """Mint an API key: shown once, stored as sha256
+        (reference: app.py:1489-1510)."""
+        form = await request.post()
+        pid = int(form.get("project_id", 0))
+        key = f"kk-{secrets.token_urlsafe(24)}"
+        ctx.db.execute(
+            "INSERT INTO project_api_keys (project_id, key_hash, label, created_at)"
+            " VALUES (?,?,?,?)",
+            (pid, _hash_api_key(key), str(form.get("label") or ""), time.time()),
+        )
+        ctx.db.audit(request["user"].email, "project.api_key.create", {"project_id": pid})
+        projects = ctx.db.query(
+            "SELECT p.*, b.monthly_budget_micro_usd, b.spent_micro_usd FROM projects p"
+            " LEFT JOIN project_budgets b ON b.project_id=p.id ORDER BY p.name"
+        )
+        return ctx.render(request, "projects.html", projects=projects, new_key=key)
+
+    # ------------------------------------------------------------------
+    # public ingest API (X-API-Key) with budget enforcement
+    # ------------------------------------------------------------------
+
+    async def api_ingest_run(request):
+        """Programmatic run ingestion (reference: app.py:1512-1605)."""
+        api_key = request.headers.get("X-API-Key", "")
+        if not api_key:
+            return web.json_response({"ok": False, "error": "X-API-Key required"}, status=401)
+        row = ctx.db.one(
+            "SELECT * FROM project_api_keys WHERE key_hash=? AND revoked=0",
+            (_hash_api_key(api_key),),
+        )
+        if row is None:
+            return web.json_response({"ok": False, "error": "invalid API key"}, status=403)
+        project_id = row["project_id"]
+
+        try:
+            body = await request.json()
+            prompt = str(body.get("prompt") or "")
+            response_text = str(body.get("response") or "")
+            app_id = str(body.get("app_id") or "api-app")
+        except Exception:  # noqa: BLE001
+            return web.json_response({"ok": False, "error": "bad json"}, status=422)
+
+        tokens_in = estimate_tokens(prompt)
+        tokens_out = estimate_tokens(response_text)
+        cost = estimate_cost_micro_usd(tokens_in, tokens_out)
+
+        status = "ok"
+        error: Optional[str] = None
+        budget = ctx.db.one("SELECT * FROM project_budgets WHERE project_id=?", (project_id,))
+        if budget and budget["monthly_budget_micro_usd"] > 0:
+            if budget["spent_micro_usd"] + cost > budget["monthly_budget_micro_usd"]:
+                status, error = "error", "budget exceeded"
+        if status == "ok" and budget:
+            ctx.db.execute(
+                "UPDATE project_budgets SET spent_micro_usd = spent_micro_usd + ? WHERE project_id=?",
+                (cost, project_id),
+            )
+
+        from kakveda_tpu.dashboard.db import new_trace_id
+
+        trace_id = str(body.get("trace_id") or new_trace_id())
+        ctx.db.execute(
+            "INSERT OR IGNORE INTO trace_runs (trace_id, ts, app_id, agent_id, project_id, prompt,"
+            " response, provider, model, latency_ms, tokens_in, tokens_out, cost_micro_usd,"
+            " status, error, tags_json) VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?)",
+            (
+                trace_id,
+                time.time(),
+                app_id,
+                str(body.get("agent_id") or "api"),
+                project_id,
+                prompt,
+                response_text,
+                str(body.get("provider") or "api"),
+                body.get("model"),
+                body.get("latency_ms"),
+                tokens_in,
+                tokens_out,
+                cost,
+                status,
+                error,
+                json.dumps(body.get("tags", [])),
+            ),
+        )
+        if status == "ok":
+            await plat.ingest(
+                TracePayload(
+                    trace_id=trace_id,
+                    ts=datetime.now(timezone.utc),
+                    app_id=app_id,
+                    agent_id=str(body.get("agent_id") or "api"),
+                    prompt=prompt,
+                    response=response_text,
+                    model=body.get("model"),
+                    tools=list(body.get("tools", [])),
+                    env=dict(body.get("env", {})),
+                )
+            )
+        code = 200 if status == "ok" else 402
+        return web.json_response(
+            {"ok": status == "ok", "trace_id": trace_id, "cost_micro_usd": cost, "error": error},
+            status=code,
+        )
+
+    app.add_routes(
+        [
+            web.get("/admin/users", admin_users),
+            web.post("/admin/users/role", admin_set_role),
+            web.post("/admin/users/toggle", admin_toggle_active),
+            web.post("/admin/impersonate", admin_impersonate),
+            web.get("/admin/audit", admin_audit),
+            web.post("/admin/purge-demo", admin_purge_demo),
+            web.get("/agents", agents_page),
+            web.post("/agents/register", agent_register),
+            web.post("/agents/toggle", agent_toggle),
+            web.get("/agents/{name}/test", agent_test),
+            web.post("/api/agents/register", agent_self_register),
+            web.post("/api/agents/{name}/heartbeat", agent_heartbeat),
+            web.get("/api/agents", api_agents),
+            web.get("/projects", projects_page),
+            web.post("/projects/create", project_create),
+            web.post("/projects/select", project_select),
+            web.post("/projects/api-key", project_api_key),
+            web.post("/api/ingest/run", api_ingest_run),
+        ]
+    )

@@ -1,0 +1,490 @@
+"""Core dashboard pages: home, health, failures, scenarios, warnings, runs,
+playground (reference: services/dashboard/app.py §2.1-2.8 areas)."""
+
+from __future__ import annotations
+
+import json
+import time
+from collections import defaultdict
+from datetime import datetime, timezone
+from typing import Any, Dict, List, Optional
+
+from aiohttp import web
+
+import asyncio
+
+from kakveda_tpu.core.schemas import TracePayload, WarningRequest
+from kakveda_tpu.dashboard.core import CTX_KEY, require_login, require_roles
+from kakveda_tpu.dashboard.db import new_trace_id
+
+
+async def off_loop(fn, *args, **kwargs):
+    """Run a blocking call (model generate, sync HTTP) in the executor so it
+    can't stall the shared event loop serving /warn and /healthz."""
+    loop = asyncio.get_running_loop()
+    return await loop.run_in_executor(None, lambda: fn(*args, **kwargs))
+
+TOKEN_PRICE_MICRO_USD_IN = 15  # per 1k tokens — env-tunable in the runtime config
+TOKEN_PRICE_MICRO_USD_OUT = 75
+
+
+def estimate_tokens(text: str) -> int:
+    """len/4 heuristic (reference: services/dashboard/app.py:139-147)."""
+    return max(1, len(text or "") // 4)
+
+
+def estimate_cost_micro_usd(tokens_in: int, tokens_out: int) -> int:
+    return (tokens_in * TOKEN_PRICE_MICRO_USD_IN + tokens_out * TOKEN_PRICE_MICRO_USD_OUT) // 1000
+
+
+def parse_advanced_query(q: str) -> Dict[str, Any]:
+    """Runs-explorer mini query language: free text plus ``provider:x``,
+    ``model:x``, ``tag:x``, ``label:x``, ``thumb:up``, ``latency_ms>N``,
+    ``has:error`` (reference: services/dashboard/app.py:173-221)."""
+    out: Dict[str, Any] = {"text": [], "filters": {}}
+    for tok in (q or "").split():
+        if tok.startswith(("provider:", "model:", "tag:", "label:", "thumb:")):
+            k, _, v = tok.partition(":")
+            out["filters"][k] = v
+        elif tok.startswith("latency_ms>"):
+            try:
+                out["filters"]["latency_gt"] = int(tok.split(">", 1)[1])
+            except ValueError:
+                pass
+        elif tok == "has:error":
+            out["filters"]["has_error"] = True
+        else:
+            out["text"].append(tok)
+    out["text"] = " ".join(out["text"])
+    return out
+
+
+def setup(app: web.Application) -> None:
+    ctx = app[CTX_KEY]
+    plat = ctx.platform
+
+    # ------------------------------------------------------------------
+    # home
+    # ------------------------------------------------------------------
+
+    @require_login
+    async def home(request):
+        failures = plat.failures()
+        patterns = plat.patterns_list()
+        apps = sorted({a for f in failures for a in f.affected_apps})
+        health = {a: plat.health_history(a, limit=1) for a in apps}
+        recent_warnings = ctx.db.query(
+            "SELECT * FROM warning_events ORDER BY ts DESC LIMIT 10"
+        )
+        return ctx.render(
+            request,
+            "home.html",
+            failures=failures,
+            patterns=patterns,
+            health={a: (pts[-1] if pts else None) for a, pts in health.items()},
+            recent_warnings=recent_warnings,
+            gfkb_count=plat.gfkb.count,
+        )
+
+    # ------------------------------------------------------------------
+    # health + failure detail
+    # ------------------------------------------------------------------
+
+    @require_login
+    async def health_page(request):
+        app_id = request.query.get("app_id", "")
+        apps = sorted({a for f in plat.failures() for a in f.affected_apps})
+        points = plat.health_history(app_id, limit=100) if app_id else []
+        return ctx.render(request, "health.html", apps=apps, app_id=app_id, points=points)
+
+    @require_roles("admin")
+    async def health_test(request):
+        """Admin fault injection: publish a synthetic failure.detected
+        (reference: services/dashboard/app.py:1762-1819)."""
+        form = await request.post()
+        app_id = str(form.get("app_id") or "test-app")
+        severity = str(form.get("severity") or "medium")
+        ftype = str(form.get("failure_type") or "SYNTHETIC_TEST")
+        event = {
+            "trace_id": new_trace_id(),
+            "ts": datetime.now(timezone.utc).isoformat(),
+            "app_id": app_id,
+            "failure_type": ftype,
+            "severity": severity,
+            "context_signature": {"injected": True},
+        }
+        await plat.bus.publish("failure.detected", event)
+        ctx.db.audit(request["user"].email, "health.test", event)
+        raise web.HTTPFound(f"/health-page?app_id={app_id}")
+
+    @require_login
+    async def failure_detail(request):
+        fid = request.match_info["failure_id"]
+        # Version-aware lookup: F-0001v3 pins a version, plain id = latest
+        # (reference: services/dashboard/app.py:1822-1909).
+        want_version = None
+        if "v" in fid[2:]:
+            base, _, v = fid.rpartition("v")
+            if v.isdigit():
+                fid, want_version = base, int(v)
+        rec = next((f for f in plat.failures() if f.failure_id == fid), None)
+        if rec is None:
+            raise web.HTTPNotFound(text=f"failure {fid} not found")
+        history = []
+        if plat.gfkb.failures_path.exists():
+            for line in plat.gfkb.failures_path.read_text(encoding="utf-8").splitlines():
+                if not line.strip():
+                    continue
+                row = json.loads(line)
+                if row.get("failure_id") == fid:
+                    history.append(row)
+        shown = rec.model_dump(mode="json")
+        if want_version is not None:
+            pinned = next((h for h in history if h.get("version") == want_version), None)
+            if pinned:
+                shown = pinned
+        return ctx.render(
+            request, "failure_detail.html", failure=shown, history=history, latest=rec
+        )
+
+    # ------------------------------------------------------------------
+    # scenario runner
+    # ------------------------------------------------------------------
+
+    @require_login
+    async def scenarios_page(request):
+        recent = ctx.db.query("SELECT * FROM scenario_runs ORDER BY ts DESC LIMIT 20")
+        return ctx.render(request, "scenarios.html", recent=recent)
+
+    @require_roles("admin", "operator")
+    async def run_scenario(request):
+        """The canonical end-to-end path: warn → generate → ingest, with
+        span capture (reference: services/dashboard/app.py:2094-2226)."""
+        form = await request.post()
+        app_id = str(form.get("app_id") or "app-A")
+        prompt = str(form.get("prompt") or "")
+        if not prompt:
+            raise web.HTTPBadRequest(text="prompt required")
+        user = request["user"]
+        trace_id = new_trace_id()
+        t_start = time.time()
+
+        w_t0 = time.time()
+        warning = await off_loop(
+            plat.warn,
+            WarningRequest(app_id=app_id, agent_id="dashboard", prompt=prompt, tools=[], env={}),
+        )
+        w_t1 = time.time()
+
+        g_t0 = time.time()
+        gen = await off_loop(ctx.model.generate, prompt)
+        g_t1 = time.time()
+
+        i_t0 = time.time()
+        trace = TracePayload(
+            trace_id=trace_id,
+            ts=datetime.now(timezone.utc),
+            app_id=app_id,
+            agent_id="dashboard",
+            prompt=prompt,
+            response=gen.text,
+            model=gen.meta.get("model"),
+            tools=[],
+            env={},
+        )
+        await plat.ingest(trace)
+        i_t1 = time.time()
+
+        tokens_in = estimate_tokens(prompt)
+        tokens_out = estimate_tokens(gen.text)
+        ctx.db.execute(
+            "INSERT OR IGNORE INTO trace_runs (trace_id, ts, app_id, agent_id, prompt, response,"
+            " provider, model, latency_ms, tokens_in, tokens_out, cost_micro_usd, status)"
+            " VALUES (?,?,?,?,?,?,?,?,?,?,?,?,'ok')",
+            (
+                trace_id,
+                t_start,
+                app_id,
+                "dashboard",
+                prompt,
+                gen.text,
+                gen.meta.get("provider"),
+                gen.meta.get("model"),
+                gen.meta.get("latency_ms"),
+                tokens_in,
+                tokens_out,
+                estimate_cost_micro_usd(tokens_in, tokens_out),
+            ),
+        )
+        ctx.db.execute(
+            "INSERT INTO scenario_runs (ts, user_email, app_id, prompt, response, warning_action,"
+            " warning_confidence, provider, model, latency_ms, trace_id) VALUES (?,?,?,?,?,?,?,?,?,?,?)",
+            (
+                t_start,
+                user.email,
+                app_id,
+                prompt,
+                gen.text,
+                warning.action,
+                warning.confidence,
+                gen.meta.get("provider"),
+                gen.meta.get("model"),
+                gen.meta.get("latency_ms"),
+                trace_id,
+            ),
+        )
+        best = warning.references[0] if warning.references else None
+        wid = ctx.db.execute(
+            "INSERT INTO warning_events (ts, app_id, action, confidence, pattern_id, failure_id,"
+            " failure_type, message, source) VALUES (?,?,?,?,?,?,?,?, 'scenario')",
+            (
+                t_start,
+                app_id,
+                warning.action,
+                warning.confidence,
+                warning.pattern_id,
+                best.failure_id if best else None,
+                best.failure_type if best else None,
+                warning.message,
+            ),
+        )
+        parent = ctx.db.add_span(trace_id, "scenario.run", t_start, i_t1)
+        ctx.db.add_span(trace_id, "warn_policy.call", w_t0, w_t1, parent, {"action": warning.action})
+        ctx.db.add_span(trace_id, "model.generate", g_t0, g_t1, parent, gen.meta)
+        ctx.db.add_span(trace_id, "ingestion.ingest", i_t0, i_t1, parent)
+        ctx.db.audit(user.email, "scenario.run", {"app_id": app_id, "trace_id": trace_id})
+        raise web.HTTPFound(f"/warnings#w-{wid}")
+
+    # ------------------------------------------------------------------
+    # warnings + analytics
+    # ------------------------------------------------------------------
+
+    @require_login
+    async def warnings_page(request):
+        """Warning list + 30d analytics: by day, by app, by pattern, cost by
+        app (reference: services/dashboard/app.py:1912-2041)."""
+        now = time.time()
+        d30 = now - 30 * 86400
+        events = ctx.db.query(
+            "SELECT * FROM warning_events WHERE ts>? ORDER BY ts DESC LIMIT 500", (now - 90 * 86400,)
+        )
+        by_day: Dict[str, int] = defaultdict(int)
+        by_app: Dict[str, int] = defaultdict(int)
+        by_pattern: Dict[str, int] = defaultdict(int)
+        for e in events:
+            if e["ts"] >= d30:
+                day = datetime.fromtimestamp(e["ts"], tz=timezone.utc).strftime("%Y-%m-%d")
+                by_day[day] += 1
+                by_app[e["app_id"]] += 1
+                if e["pattern_id"]:
+                    by_pattern[e["pattern_id"]] += 1
+        cost_rows = ctx.db.query(
+            "SELECT app_id, SUM(cost_micro_usd) AS cost FROM trace_runs WHERE ts>? GROUP BY app_id",
+            (d30,),
+        )
+        return ctx.render(
+            request,
+            "warnings.html",
+            events=events,
+            by_day=sorted(by_day.items()),
+            by_app=sorted(by_app.items(), key=lambda kv: -kv[1]),
+            by_pattern=sorted(by_pattern.items(), key=lambda kv: -kv[1]),
+            cost_by_app=cost_rows,
+        )
+
+    # ------------------------------------------------------------------
+    # runs explorer + detail + feedback
+    # ------------------------------------------------------------------
+
+    @require_login
+    async def runs_page(request):
+        q = request.query.get("q", "")
+        parsed = parse_advanced_query(q)
+        sql = "SELECT * FROM trace_runs"
+        clauses: List[str] = []
+        params: List[Any] = []
+        f = parsed["filters"]
+        if f.get("provider"):
+            clauses.append("provider=?")
+            params.append(f["provider"])
+        if f.get("model"):
+            clauses.append("model=?")
+            params.append(f["model"])
+        if f.get("latency_gt") is not None:
+            clauses.append("latency_ms>?")
+            params.append(f["latency_gt"])
+        if f.get("has_error"):
+            clauses.append("(status='error' OR error IS NOT NULL)")
+        if f.get("tag"):
+            clauses.append("tags_json LIKE ?")
+            params.append(f"%{f['tag']}%")
+        if parsed["text"]:
+            clauses.append("(prompt LIKE ? OR response LIKE ? OR app_id LIKE ?)")
+            like = f"%{parsed['text']}%"
+            params.extend([like, like, like])
+        if f.get("thumb") or f.get("label"):
+            sub = "SELECT trace_id FROM run_feedback WHERE 1=1"
+            if f.get("thumb"):
+                sub += " AND thumb=?"
+                params.append(f["thumb"])
+            if f.get("label"):
+                sub += " AND label=?"
+                params.append(f["label"])
+            clauses.append(f"trace_id IN ({sub})")
+        if clauses:
+            sql += " WHERE " + " AND ".join(clauses)
+        sql += " ORDER BY ts DESC LIMIT 100"
+        runs = ctx.db.query(sql, params)
+        return ctx.render(request, "runs.html", runs=runs, q=q)
+
+    @require_login
+    async def run_detail(request):
+        trace_id = request.match_info["trace_id"]
+        run = ctx.db.one("SELECT * FROM trace_runs WHERE trace_id=?", (trace_id,))
+        if run is None:
+            raise web.HTTPNotFound(text="run not found")
+        spans = ctx.db.query(
+            "SELECT * FROM trace_spans WHERE trace_id=? ORDER BY start_ts", (trace_id,)
+        )
+        # Waterfall layout: pct offsets relative to the full window
+        # (reference: services/dashboard/app.py:2927-2970).
+        if spans:
+            t0 = min(s["start_ts"] for s in spans)
+            t1 = max(s["end_ts"] for s in spans)
+            total = max(t1 - t0, 1e-6)
+            for s in spans:
+                s["pct_left"] = 100.0 * (s["start_ts"] - t0) / total
+                s["pct_width"] = max(0.5, 100.0 * (s["end_ts"] - s["start_ts"]) / total)
+                s["duration_ms"] = int((s["end_ts"] - s["start_ts"]) * 1000)
+                s["meta"] = json.loads(s["meta_json"] or "{}")
+        feedback = ctx.db.query("SELECT * FROM run_feedback WHERE trace_id=?", (trace_id,))
+        return ctx.render(request, "run_detail.html", run=run, spans=spans, feedback=feedback)
+
+    @require_login
+    async def run_feedback(request):
+        trace_id = request.match_info["trace_id"]
+        form = await request.post()
+        thumb = str(form.get("thumb") or "")
+        label = str(form.get("label") or "") or None
+        note = str(form.get("note") or "") or None
+        if thumb not in ("up", "down"):
+            raise web.HTTPBadRequest(text="thumb must be up|down")
+        ctx.db.execute(
+            "INSERT OR IGNORE INTO run_feedback (trace_id, user_email, thumb, label, note, ts)"
+            " VALUES (?,?,?,?,?,?)",
+            (trace_id, request["user"].email, thumb, label, note, time.time()),
+        )
+        raise web.HTTPFound(f"/runs/{trace_id}")
+
+    # ------------------------------------------------------------------
+    # playground
+    # ------------------------------------------------------------------
+
+    @require_login
+    async def playground_page(request):
+        agents = ctx.db.query("SELECT * FROM agent_registry WHERE enabled=1")
+        prompts = ctx.db.query(
+            "SELECT p.name, v.text, v.version FROM prompt_library p JOIN prompt_versions v"
+            " ON v.prompt_id=p.id ORDER BY p.name, v.version DESC"
+        )
+        experiments = ctx.db.query("SELECT * FROM experiments ORDER BY created_at DESC")
+        return ctx.render(
+            request,
+            "playground.html",
+            agents=agents,
+            prompts=prompts,
+            experiments=experiments,
+            result=None,
+        )
+
+    @require_roles("admin", "operator")
+    async def playground_run(request):
+        """Direct model or external-agent invocation with span + cost capture
+        (reference: services/dashboard/app.py:3127-3299)."""
+        form = await request.post()
+        prompt = str(form.get("prompt") or "")
+        target = str(form.get("target") or "model")
+        experiment = str(form.get("experiment") or "")
+        if not prompt:
+            raise web.HTTPBadRequest(text="prompt required")
+        trace_id = new_trace_id()
+        t0 = time.time()
+        if target.startswith("agent:"):
+            name = target.split(":", 1)[1]
+            agent = ctx.db.one("SELECT * FROM agent_registry WHERE name=? AND enabled=1", (name,))
+            if agent is None:
+                raise web.HTTPBadRequest(text=f"unknown agent {name}")
+            import httpx
+
+            try:
+                r = await off_loop(
+                    httpx.post,
+                    f"{agent['base_url']}/invoke",
+                    json={"event_type": "ask", "payload": {"prompt": prompt}},
+                    timeout=10.0,
+                )
+                r.raise_for_status()
+                body = r.json()
+                text = json.dumps(body.get("events", []), indent=1)
+                meta = {"provider": f"agent:{name}", "model": name}
+            except Exception as e:  # noqa: BLE001 — surface agent errors in UI
+                text = f"agent error: {type(e).__name__}: {e}"
+                meta = {"provider": f"agent:{name}", "model": name, "error": str(e)}
+        else:
+            gen = await off_loop(ctx.model.generate, prompt)
+            text, meta = gen.text, gen.meta
+        t1 = time.time()
+        tokens_in, tokens_out = estimate_tokens(prompt), estimate_tokens(text)
+        ctx.db.execute(
+            "INSERT OR IGNORE INTO trace_runs (trace_id, ts, app_id, agent_id, prompt, response,"
+            " provider, model, latency_ms, tokens_in, tokens_out, cost_micro_usd, status)"
+            " VALUES (?,?,?,?,?,?,?,?,?,?,?,?,'ok')",
+            (
+                trace_id,
+                t0,
+                "playground",
+                meta.get("provider"),
+                prompt,
+                text,
+                meta.get("provider"),
+                meta.get("model"),
+                meta.get("latency_ms", int((t1 - t0) * 1000)),
+                tokens_in,
+                tokens_out,
+                estimate_cost_micro_usd(tokens_in, tokens_out),
+            ),
+        )
+        ctx.db.add_span(trace_id, "playground.run", t0, t1, meta=meta)
+        if experiment:
+            exp = ctx.db.one("SELECT id FROM experiments WHERE name=?", (experiment,))
+            if exp:
+                ctx.db.execute(
+                    "INSERT OR IGNORE INTO experiment_runs (experiment_id, trace_id) VALUES (?,?)",
+                    (exp["id"], trace_id),
+                )
+        agents = ctx.db.query("SELECT * FROM agent_registry WHERE enabled=1")
+        return ctx.render(
+            request,
+            "playground.html",
+            agents=agents,
+            prompts=[],
+            experiments=ctx.db.query("SELECT * FROM experiments"),
+            result={"text": text, "meta": meta, "trace_id": trace_id},
+        )
+
+    app.add_routes(
+        [
+            web.get("/", home),
+            web.get("/health-page", health_page),
+            web.post("/health/test", health_test),
+            web.get("/failures/{failure_id}", failure_detail),
+            web.get("/scenarios", scenarios_page),
+            web.post("/scenarios/run", run_scenario),
+            web.get("/warnings", warnings_page),
+            web.get("/runs", runs_page),
+            web.get("/runs/{trace_id}", run_detail),
+            web.post("/runs/{trace_id}/feedback", run_feedback),
+            web.get("/playground", playground_page),
+            web.post("/playground/run", playground_run),
+        ]
+    )

@@ -121,6 +121,21 @@ class GFKB:
                 p = PatternEntity.model_validate(json.loads(line))
                 self._patterns[p.name] = p
 
+    def reload(self) -> None:
+        """Drop all in-memory/device state and replay the append logs.
+
+        Required after any external rewrite of the JSONL files (e.g. the
+        dashboard's purge-demo flow) so the device index, id minting and
+        host metadata stay consistent with the log.
+        """
+        with self._lock:
+            self._emb, self._valid = self._knn.alloc()
+            self._records = []
+            self._slot_by_key = {}
+            self._patterns = {}
+            if self.persist:
+                self._replay()
+
     # ------------------------------------------------------------------
     # failures
     # ------------------------------------------------------------------

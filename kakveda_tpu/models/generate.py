@@ -50,7 +50,17 @@ def generate_tokens(
     max_len: Optional[int] = None,
 ) -> list[int]:
     """Autoregressive decode; returns only the newly generated ids."""
-    ml = max_len or min(cfg.max_seq_len, len(prompt_ids) + max_new_tokens + 1)
+    if max_len is None:
+        # Bucket the cache length to a power of two: the cache shape is part
+        # of the compiled program signature, so an exact-fit length would
+        # recompile prefill+decode for every distinct prompt length.
+        need = len(prompt_ids) + max_new_tokens + 1
+        ml = 64
+        while ml < need:
+            ml <<= 1
+        ml = min(ml, cfg.max_seq_len)
+    else:
+        ml = max_len
     cache = init_cache(cfg, batch=1, max_len=ml)
     if rng is None:
         rng = jax.random.PRNGKey(0)

@@ -96,21 +96,27 @@ def make_sharded_train_step(
         opt_state = opt.init(params)
         return params, opt_state
 
-    # Opt-state sharding mirrors the param tree inside adamw's mu/nu: leaves
-    # shaped like a param get that param's sharding; scalars replicate.
-    params_shape = jax.eval_shape(lambda r: init_params(r, cfg), jax.random.PRNGKey(0))
-    flat_param_shapes = {
-        tuple(p.shape): s
-        for p, s in zip(jax.tree.leaves(params_shape), jax.tree.leaves(param_shardings))
-    }
+    # Opt-state sharding mirrors the param tree inside adamw's mu/nu. Match
+    # by pytree-path suffix, not leaf shape: wq [d, d] and wo [d, d] share a
+    # shape but carry transposed PartitionSpecs, so a shape-keyed map would
+    # silently reshard one of them every step.
+    from jax.tree_util import keystr, tree_flatten_with_path, tree_map_with_path
 
-    def _sharding_for(leaf):
+    params_shape = jax.eval_shape(lambda r: init_params(r, cfg), jax.random.PRNGKey(0))
+    param_paths = [keystr(path) for path, _ in tree_flatten_with_path(params_shape)[0]]
+    path_to_sharding = dict(zip(param_paths, jax.tree.leaves(param_shardings)))
+
+    def _sharding_for(path, leaf):
         if leaf.ndim == 0:
             return repl
-        return flat_param_shapes.get(tuple(leaf.shape), repl)
+        ps = keystr(path)
+        for param_path, sharding in path_to_sharding.items():
+            if ps.endswith(param_path):
+                return sharding
+        return repl
 
     opt_state_shape = jax.eval_shape(lambda r: opt.init(init_params(r, cfg)), jax.random.PRNGKey(0))
-    opt_shardings = jax.tree.map(_sharding_for, opt_state_shape)
+    opt_shardings = tree_map_with_path(_sharding_for, opt_state_shape)
 
     init_state = jax.jit(_init, out_shardings=(param_shardings, opt_shardings))
 

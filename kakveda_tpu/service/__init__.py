@@ -1,0 +1,10 @@
+"""Host HTTP service layer (aiohttp) over the in-process platform.
+
+Keeps the reference's external REST contracts — ingest, warn, GFKB
+failures/patterns, health, event-bus pub/sub, agent echo — on one port
+instead of nine containers (reference: docker-compose.yml port map in
+SURVEY.md §1). The TPU intelligence core stays in-process; HTTP exists for
+operators, dashboards and external agents, not for the pipeline's own hops.
+"""
+
+from kakveda_tpu.service.app import make_app  # noqa: F401

@@ -1,0 +1,3 @@
+from kakveda_tpu.service.main import run_server
+
+run_server()

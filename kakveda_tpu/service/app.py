@@ -1,0 +1,260 @@
+"""The platform's HTTP surface — reference REST contracts on one port.
+
+Route map (reference originals in parentheses):
+
+  POST /ingest                  (ingestion:8102, services/ingestion/app.py:15)
+  POST /warn                    (warning-policy:8105, services/warning_policy/app.py:19)
+  GET  /failures                (gfkb:8101, services/gfkb/app.py:74)
+  POST /failures/match          (gfkb, services/gfkb/app.py:79)
+  POST /failures/upsert         (gfkb, services/gfkb/app.py:105)
+  GET  /patterns                (gfkb, services/gfkb/app.py:150)
+  POST /patterns/upsert         (gfkb, services/gfkb/app.py:168)
+  GET  /health/{app_id}         (health-scoring:8106, services/health_scoring/app.py:116)
+  POST /subscribe /publish, GET /topics
+                                (event-bus:8100, services/event_bus/app.py:28-59)
+  GET  /healthz /readyz         (liveness/readiness)
+
+The warn route drains through a MicroBatcher so concurrent pre-flight
+checks share one device call. External subscribers registered via
+/subscribe get HTTP callbacks exactly like the reference bus delivered.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Optional
+
+from aiohttp import web
+from pydantic import ValidationError
+
+from kakveda_tpu.core.runtime import ensure_request_id, get_runtime_config
+from kakveda_tpu.core.schemas import (
+    FailureMatchRequest,
+    IngestRequest,
+    Severity,
+    WarningRequest,
+)
+from kakveda_tpu.platform import Platform
+from kakveda_tpu.service.batcher import MicroBatcher
+
+log = logging.getLogger("kakveda.service")
+
+PLATFORM_KEY: web.AppKey[Platform] = web.AppKey("platform", Platform)
+WARN_BATCHER_KEY: web.AppKey[MicroBatcher] = web.AppKey("warn_batcher", MicroBatcher)
+
+
+def _json_error(status: int, message: str) -> web.Response:
+    return web.json_response({"ok": False, "error": message}, status=status)
+
+
+@web.middleware
+async def request_context_middleware(request: web.Request, handler):
+    """Request id + duration logging (reference: dashboard app.py:590-611)."""
+    cfg = get_runtime_config(service_name="kakveda-tpu")
+    rid = ensure_request_id(request.headers.get(cfg.request_id_header))
+    started = time.perf_counter()
+    try:
+        response = await handler(request)
+    except web.HTTPException as e:
+        e.headers[cfg.request_id_header] = rid
+        raise
+    duration_ms = int((time.perf_counter() - started) * 1000)
+    response.headers[cfg.request_id_header] = rid
+    log.info(
+        "request",
+        extra={
+            "request_id": rid,
+            "path": request.path,
+            "method": request.method,
+            "status_code": response.status,
+            "duration_ms": duration_ms,
+        },
+    )
+    return response
+
+
+def make_app(platform: Optional[Platform] = None, **platform_kw) -> web.Application:
+    plat = platform or Platform(**platform_kw)
+    app = web.Application(middlewares=[request_context_middleware])
+    app[PLATFORM_KEY] = plat
+
+    warn_batcher: MicroBatcher = MicroBatcher(plat.warn_batch, max_batch=64, deadline_s=0.002)
+    app[WARN_BATCHER_KEY] = warn_batcher
+
+    async def _on_startup(app):
+        warn_batcher.start()
+
+    async def _on_cleanup(app):
+        await warn_batcher.stop()
+
+    app.on_startup.append(_on_startup)
+    app.on_cleanup.append(_on_cleanup)
+
+    # --- liveness -------------------------------------------------------
+
+    async def healthz(request):
+        return web.json_response({"ok": True})
+
+    async def readyz(request):
+        return web.json_response({"ok": True, "gfkb_count": plat.gfkb.count})
+
+    # --- ingest ---------------------------------------------------------
+
+    async def ingest(request):
+        try:
+            req = IngestRequest.model_validate(await request.json())
+        except (ValidationError, ValueError) as e:
+            return _json_error(422, str(e))
+        await plat.ingest(req.trace)
+        return web.json_response({"ok": True, "trace_id": req.trace.trace_id})
+
+    # --- warn (micro-batched) -------------------------------------------
+
+    async def warn(request):
+        try:
+            req = WarningRequest.model_validate(await request.json())
+        except (ValidationError, ValueError) as e:
+            return _json_error(422, str(e))
+        res = await warn_batcher.submit(req)
+        return web.json_response(res.model_dump())
+
+    # --- GFKB -----------------------------------------------------------
+
+    async def list_failures(request):
+        return web.json_response(
+            {"failures": [f.model_dump(mode="json") for f in plat.failures()]}
+        )
+
+    async def match(request):
+        try:
+            req = FailureMatchRequest.model_validate(await request.json())
+        except (ValidationError, ValueError) as e:
+            return _json_error(422, str(e))
+        matches = plat.gfkb.match(req.signature_text, failure_type=req.failure_type)
+        return web.json_response({"matches": [m.model_dump() for m in matches]})
+
+    async def upsert_failure(request):
+        try:
+            body = await request.json()
+            rec, created = plat.gfkb.upsert_failure(
+                failure_type=body["failure_type"],
+                signature_text=body["signature_text"],
+                app_id=body["app_id"],
+                impact_severity=Severity(body["impact_severity"]),
+                context_signature=body.get("context_signature"),
+                root_cause=body.get("root_cause"),
+                resolution=body.get("resolution"),
+            )
+        except (KeyError, ValueError, ValidationError) as e:
+            return _json_error(422, str(e))
+        return web.json_response(
+            {"ok": True, "created": created, "failure": rec.model_dump(mode="json")}
+        )
+
+    async def list_patterns(request):
+        return web.json_response(
+            {"patterns": [p.model_dump(mode="json") for p in plat.patterns_list()]}
+        )
+
+    async def upsert_pattern(request):
+        try:
+            body = await request.json()
+            p, created = plat.gfkb.upsert_pattern(
+                name=body["name"],
+                failure_ids=body.get("failure_ids", []),
+                affected_apps=body.get("affected_apps", []),
+                description=body.get("description"),
+            )
+        except (KeyError, ValueError, ValidationError) as e:
+            return _json_error(422, str(e))
+        return web.json_response(
+            {"ok": True, "created": created, "pattern": p.model_dump(mode="json")}
+        )
+
+    # --- health timeline ------------------------------------------------
+
+    async def app_health(request):
+        app_id = request.match_info["app_id"]
+        limit = min(max(int(request.query.get("limit", 50)), 1), 500)
+        return web.json_response({"app_id": app_id, "points": plat.health_history(app_id, limit)})
+
+    # --- event bus (external pub/sub contract) --------------------------
+
+    async def subscribe(request):
+        body = await request.json()
+        topic, cb = body.get("topic"), body.get("callback_url")
+        if not topic or not cb:
+            return _json_error(422, "topic and callback_url required")
+        n = plat.bus.subscribe(topic, cb)
+        return web.json_response({"ok": True, "topic": topic, "subscribers": n})
+
+    async def publish(request):
+        body = await request.json()
+        topic, event = body.get("topic"), body.get("event")
+        if not topic or event is None:
+            return _json_error(422, "topic and event required")
+        delivered = await plat.bus.publish(topic, event)
+        return web.json_response({"ok": True, "delivered": delivered})
+
+    async def topics(request):
+        return web.json_response({"topics": plat.bus.topics()})
+
+    app.add_routes(
+        [
+            web.get("/healthz", healthz),
+            web.get("/readyz", readyz),
+            web.post("/ingest", ingest),
+            web.post("/warn", warn),
+            web.get("/failures", list_failures),
+            web.post("/failures/match", match),
+            web.post("/failures/upsert", upsert_failure),
+            web.get("/patterns", list_patterns),
+            web.post("/patterns/upsert", upsert_pattern),
+            web.get("/health/{app_id}", app_health),
+            web.post("/subscribe", subscribe),
+            web.post("/publish", publish),
+            web.get("/topics", topics),
+        ]
+    )
+    return app
+
+
+def make_agent_echo_app(agent_name: str = "agent-echo") -> web.Application:
+    """Reference external-agent contract (reference: services/agent_echo/app.py):
+    /health, /capabilities, /invoke echoing events back."""
+    app = web.Application()
+
+    async def health(request):
+        return web.json_response({"ok": True, "service": agent_name, "status": "healthy"})
+
+    async def capabilities(request):
+        return web.json_response(
+            {
+                "name": agent_name,
+                "capabilities": ["echo"],
+                "events_in": ["*"],
+                "events_out": ["echo"],
+            }
+        )
+
+    async def invoke(request):
+        body = await request.json()
+        out = {
+            "event_type": "echo",
+            "payload": {
+                "received_event_type": str(body.get("event_type") or "unknown"),
+                "received_payload": body.get("payload"),
+                "agent": agent_name,
+            },
+        }
+        return web.json_response({"status": "ok", "events": [out]})
+
+    app.add_routes(
+        [
+            web.get("/health", health),
+            web.get("/capabilities", capabilities),
+            web.post("/invoke", invoke),
+        ]
+    )
+    return app

@@ -1,0 +1,85 @@
+"""Micro-batching request queue for the pre-flight warn hot path.
+
+The reference answers each /warn with its own full TF-IDF pass
+(reference: services/warning_policy/app.py:19-72). Here concurrent warn
+requests coalesce into one device call: requests enqueue, a drain loop
+collects up to ``max_batch`` of them (waiting at most ``deadline_s`` for
+stragglers once the first arrives), runs the batch through
+``WarningPolicy.warn_batch`` — one compiled matmul+top-k — and resolves
+every waiter. Under load the batch fills instantly and per-request cost is
+batch_time/B (see bench.py); when idle a lone request pays only the
+deadline (default 2 ms) on top of its own match.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Awaitable, Callable, Generic, List, Sequence, Tuple, TypeVar
+
+TReq = TypeVar("TReq")
+TRes = TypeVar("TRes")
+
+
+class MicroBatcher(Generic[TReq, TRes]):
+    def __init__(
+        self,
+        run_batch: Callable[[Sequence[TReq]], List[TRes]],
+        *,
+        max_batch: int = 64,
+        deadline_s: float = 0.002,
+    ):
+        self._run_batch = run_batch
+        self.max_batch = max_batch
+        self.deadline_s = deadline_s
+        self._queue: asyncio.Queue[Tuple[TReq, asyncio.Future]] = asyncio.Queue()
+        self._task: asyncio.Task | None = None
+
+    def start(self) -> None:
+        if self._task is None or self._task.done():
+            self._task = asyncio.get_running_loop().create_task(self._drain())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+    async def submit(self, req: TReq) -> TRes:
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        await self._queue.put((req, fut))
+        return await fut
+
+    async def _collect(self) -> List[Tuple[TReq, asyncio.Future]]:
+        first = await self._queue.get()
+        batch = [first]
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + self.deadline_s
+        while len(batch) < self.max_batch:
+            timeout = deadline - loop.time()
+            if timeout <= 0:
+                break
+            try:
+                batch.append(await asyncio.wait_for(self._queue.get(), timeout))
+            except asyncio.TimeoutError:
+                break
+        return batch
+
+    async def _drain(self) -> None:
+        loop = asyncio.get_running_loop()
+        while True:
+            batch = await self._collect()
+            reqs = [r for r, _ in batch]
+            try:
+                # The device call is sync; run it off-loop so new requests
+                # keep enqueueing while the match executes.
+                results = await loop.run_in_executor(None, self._run_batch, reqs)
+                for (_, fut), res in zip(batch, results):
+                    if not fut.done():
+                        fut.set_result(res)
+            except Exception as e:  # noqa: BLE001 — propagate to all waiters
+                for _, fut in batch:
+                    if not fut.done():
+                        fut.set_exception(e)

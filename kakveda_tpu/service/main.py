@@ -1,0 +1,64 @@
+"""Server entry point: `kakveda-tpu up` / `python -m kakveda_tpu.service`.
+
+Runs the platform API (reference port 8100-8106 contracts) and the
+dashboard (reference port 8110) from one process over one shared
+intelligence core — two listeners, zero HTTP hops between pipeline stages.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from aiohttp import web
+
+from kakveda_tpu.core.runtime import get_runtime_config, setup_logging
+from kakveda_tpu.platform import Platform
+from kakveda_tpu.service.app import make_app
+
+log = logging.getLogger("kakveda.service")
+
+
+async def _serve(
+    plat: Platform, host: str, port: int, dashboard_port: int | None
+) -> None:
+    api_app = make_app(plat)
+    api_runner = web.AppRunner(api_app)
+    await api_runner.setup()
+    await web.TCPSite(api_runner, host, port).start()
+    log.info("platform API on http://%s:%d (gfkb entries: %d)", host, port, plat.gfkb.count)
+
+    if dashboard_port:
+        from kakveda_tpu.dashboard.app import make_dashboard_app
+
+        dash_app = make_dashboard_app(platform=plat)
+        dash_runner = web.AppRunner(dash_app)
+        await dash_runner.setup()
+        await web.TCPSite(dash_runner, host, dashboard_port).start()
+        log.info("dashboard on http://%s:%d", host, dashboard_port)
+
+    try:
+        while True:
+            await asyncio.sleep(3600)
+    finally:
+        await api_runner.cleanup()
+
+
+def run_server(
+    host: str = "127.0.0.1",
+    port: int = 8100,
+    data_dir: str | None = None,
+    dashboard_port: int | None = 8110,
+) -> int:
+    setup_logging(service_name="kakveda-tpu")
+    cfg = get_runtime_config(service_name="kakveda-tpu")
+    plat = Platform(data_dir=data_dir or cfg.data_dir, capacity=cfg.index_capacity)
+    try:
+        asyncio.run(_serve(plat, host, port, dashboard_port))
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    run_server()

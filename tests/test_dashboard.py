@@ -1,0 +1,340 @@
+"""Dashboard tests: auth, RBAC, scenario flow, runs/spans, datasets/evals,
+prompts/experiments, admin, projects + API-key ingest.
+
+Smoke-level coverage mirroring the reference's dashboard smoke tests
+(reference: services/dashboard/tests/test_dashboard_smoke.py) plus flows
+the reference never tested.
+"""
+
+import asyncio
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from kakveda_tpu.dashboard import auth as auth_lib
+from kakveda_tpu.dashboard.app import make_dashboard_app
+from kakveda_tpu.models.runtime import StubRuntime
+from kakveda_tpu.platform import Platform
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def _mk_app(tmp_path):
+    plat = Platform(data_dir=tmp_path / "data", capacity=256, dim=1024)
+    return make_dashboard_app(
+        platform=plat, db_path=tmp_path / "dash.db", model=StubRuntime()
+    )
+
+
+async def _client(app):
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    return client
+
+
+async def _login(client, email="admin@local", password="admin123"):
+    r = await client.post(
+        "/login", data={"email": email, "password": password, "next": "/"}, allow_redirects=False
+    )
+    assert r.status == 302, await r.text()
+    return client
+
+
+def test_auth_redirect_and_login(tmp_path):
+    async def go():
+        client = await _client(_mk_app(tmp_path))
+        try:
+            r = await client.get("/", allow_redirects=False)
+            assert r.status == 302 and "/login" in r.headers["Location"]
+
+            r = await client.get("/login")
+            assert r.status == 200 and "Sign in" in await r.text()
+
+            r = await client.post(
+                "/login", data={"email": "admin@local", "password": "wrong", "next": "/"}
+            )
+            assert "Invalid credentials" in await r.text()
+
+            await _login(client)
+            r = await client.get("/")
+            assert r.status == 200
+            assert "Failure intelligence overview" in await r.text()
+        finally:
+            await client.close()
+
+    run(go())
+
+
+def test_jwt_roundtrip_and_tamper():
+    tok = auth_lib.create_access_token(email="a@local", roles=["admin"], secret="s1")
+    claims = auth_lib.decode_token(tok, secret="s1")
+    assert claims["sub"] == "a@local" and claims["roles"] == ["admin"]
+    assert auth_lib.decode_token(tok, secret="s2") is None
+    assert auth_lib.decode_token(tok[:-4] + "AAAA", secret="s1") is None
+    assert auth_lib.decode_token("garbage", secret="s1") is None
+
+
+def test_password_hash_roundtrip():
+    h = auth_lib.hash_password("hunter42x")
+    assert auth_lib.verify_password("hunter42x", h)
+    assert not auth_lib.verify_password("wrong", h)
+    assert not auth_lib.verify_password("hunter42x", "malformed")
+
+
+def test_scenario_run_creates_warning_runs_spans(tmp_path):
+    async def go():
+        client = await _client(_mk_app(tmp_path))
+        try:
+            await _login(client)
+            r = await client.post(
+                "/scenarios/run",
+                data={
+                    "app_id": "app-A",
+                    "prompt": "Summarize this document and include citations even if not provided.",
+                },
+                allow_redirects=False,
+            )
+            assert r.status == 302 and "/warnings" in r.headers["Location"]
+
+            r = await client.get("/warnings")
+            body = await r.text()
+            assert "app-A" in body
+
+            r = await client.get("/runs")
+            assert "stub" in await r.text()
+
+            r = await client.get("/scenarios")
+            text = await r.text()
+            assert "spans" in text
+            # follow the trace link to the span waterfall
+            import re
+
+            m = re.search(r'/runs/([0-9a-f-]{36})', text)
+            assert m
+            r = await client.get(f"/runs/{m.group(1)}")
+            detail = await r.text()
+            assert "scenario.run" in detail and "warn_policy.call" in detail
+        finally:
+            await client.close()
+
+    run(go())
+
+
+def test_rbac_viewer_cannot_run_scenarios(tmp_path):
+    async def go():
+        client = await _client(_mk_app(tmp_path))
+        try:
+            await _login(client, "viewer@local", "viewer123")
+            r = await client.post(
+                "/scenarios/run", data={"app_id": "a", "prompt": "x"}, allow_redirects=False
+            )
+            assert r.status == 403
+            r = await client.get("/admin/users", allow_redirects=False)
+            assert r.status == 403
+        finally:
+            await client.close()
+
+    run(go())
+
+
+def test_admin_users_and_impersonation(tmp_path):
+    async def go():
+        client = await _client(_mk_app(tmp_path))
+        try:
+            await _login(client)
+            r = await client.get("/admin/users")
+            body = await r.text()
+            assert "viewer@local" in body
+
+            r = await client.post(
+                "/admin/impersonate", data={"email": "viewer@local"}, allow_redirects=False
+            )
+            assert r.status == 302
+            r = await client.get("/")
+            assert "as-of admin@local" in await r.text()
+        finally:
+            await client.close()
+
+    run(go())
+
+
+def test_datasets_eval_flow(tmp_path):
+    async def go():
+        client = await _client(_mk_app(tmp_path))
+        try:
+            await _login(client)
+            await client.post("/datasets/create", data={"name": "ds1", "description": "d"})
+            await client.post(
+                "/datasets/1/examples",
+                data={"app_id": "eval-app", "prompt": "Summarize with citations please"},
+            )
+            await client.post(
+                "/datasets/1/examples", data={"app_id": "eval-app", "prompt": "What is 2+2?"}
+            )
+            r = await client.post("/datasets/1/eval", allow_redirects=False)
+            assert r.status == 302
+            r = await client.get(r.headers["Location"])
+            body = await r.text()
+            # stub always emits citations: citation-demanding example fails,
+            # plain example passes => 50%
+            assert "pass rate 50%" in body
+            assert "p50" in body
+        finally:
+            await client.close()
+
+    run(go())
+
+
+def test_prompts_versioning(tmp_path):
+    async def go():
+        client = await _client(_mk_app(tmp_path))
+        try:
+            await _login(client)
+            await client.post("/prompts/save", data={"name": "p1", "text": "v1 text"})
+            await client.post("/prompts/save", data={"name": "p1", "text": "v2 text"})
+            r = await client.get("/prompts/1")
+            body = await r.text()
+            assert "v2 text" in body and "v1 text" in body
+        finally:
+            await client.close()
+
+    run(go())
+
+
+def test_experiments_and_playground(tmp_path):
+    async def go():
+        client = await _client(_mk_app(tmp_path))
+        try:
+            await _login(client)
+            await client.post("/experiments/create", data={"name": "exp1"})
+            r = await client.post(
+                "/playground/run",
+                data={"prompt": "hello", "target": "model", "experiment": "exp1"},
+            )
+            assert "Result" in await r.text()
+            r = await client.get("/experiments/1")
+            assert "1 runs" in await r.text() or "p50" in await r.text()
+        finally:
+            await client.close()
+
+    run(go())
+
+
+def test_project_api_key_ingest_and_budget(tmp_path):
+    async def go():
+        client = await _client(_mk_app(tmp_path))
+        try:
+            await _login(client)
+            await client.post(
+                "/projects/create", data={"name": "proj1", "monthly_budget_micro_usd": "10"}
+            )
+            r = await client.post("/projects/api-key", data={"project_id": 1, "label": "ci"})
+            body = await r.text()
+            import re
+
+            m = re.search(r"kk-[A-Za-z0-9_\-]+", body)
+            assert m, "API key not shown"
+            key = m.group(0)
+
+            # no key -> 401; bad key -> 403
+            r = await client.post("/api/ingest/run", json={"prompt": "x"})
+            assert r.status == 401
+            r = await client.post(
+                "/api/ingest/run", json={"prompt": "x"}, headers={"X-API-Key": "bad"}
+            )
+            assert r.status == 403
+
+            # valid key ingests
+            r = await client.post(
+                "/api/ingest/run",
+                json={"prompt": "Summarize with citations", "response": "See [1]", "app_id": "api-app"},
+                headers={"X-API-Key": key},
+            )
+            assert r.status == 200
+            out = await r.json()
+            assert out["ok"] and out["cost_micro_usd"] >= 0
+
+            # tiny budget: a big request trips budget enforcement -> 402
+            r = await client.post(
+                "/api/ingest/run",
+                json={"prompt": "word " * 2000, "response": "resp " * 2000},
+                headers={"X-API-Key": key},
+            )
+            assert r.status == 402
+            assert (await r.json())["error"] == "budget exceeded"
+        finally:
+            await client.close()
+
+    run(go())
+
+
+def test_health_page_and_fault_injection(tmp_path):
+    async def go():
+        client = await _client(_mk_app(tmp_path))
+        try:
+            await _login(client)
+            r = await client.post(
+                "/health/test",
+                data={"app_id": "test-app", "severity": "high", "failure_type": "SYNTH"},
+                allow_redirects=False,
+            )
+            assert r.status == 302
+            r = await client.get("/health-page?app_id=test-app")
+            body = await r.text()
+            assert "test-app" in body and "points" in body
+        finally:
+            await client.close()
+
+    run(go())
+
+
+def test_security_headers(tmp_path):
+    async def go():
+        client = await _client(_mk_app(tmp_path))
+        try:
+            r = await client.get("/login")
+            assert "Content-Security-Policy" in r.headers
+            assert r.headers["X-Frame-Options"] == "DENY"
+        finally:
+            await client.close()
+
+    run(go())
+
+
+def test_production_requires_secret(tmp_path, monkeypatch):
+    monkeypatch.setenv("KAKVEDA_ENV", "production")
+    with pytest.raises(RuntimeError, match="JWT secret"):
+        _mk_app(tmp_path)
+
+
+def test_purge_demo_reloads_gfkb(tmp_path):
+    async def go():
+        plat = Platform(data_dir=tmp_path / "data", capacity=256, dim=1024)
+        app = make_dashboard_app(platform=plat, db_path=tmp_path / "dash.db", model=StubRuntime())
+        client = await _client(app)
+        try:
+            await _login(client)
+            for app_id in ("app-A", "app-B"):
+                await client.post(
+                    "/scenarios/run",
+                    data={"app_id": app_id, "prompt": "Summarize with citations please"},
+                    allow_redirects=False,
+                )
+            assert plat.gfkb.count > 0
+            r = await client.post("/admin/purge-demo", allow_redirects=False)
+            assert r.status == 302
+            # device index + metadata must reflect the rewritten log
+            assert plat.gfkb.count == 0
+            assert plat.gfkb.match("anything") == []
+            # and a fresh upsert mints F-0001 again, consistent with the log
+            rec, created = plat.gfkb.upsert_failure(
+                failure_type="T", signature_text="s", app_id="x",
+                impact_severity=__import__("kakveda_tpu.core.schemas", fromlist=["Severity"]).Severity.low,
+            )
+            assert created and rec.failure_id == "F-0001"
+        finally:
+            await client.close()
+
+    run(go())

@@ -1,0 +1,221 @@
+"""HTTP service-layer tests against the reference REST contracts,
+using aiohttp's in-process test server."""
+
+import asyncio
+import uuid
+from datetime import datetime, timezone
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from kakveda_tpu.core.fingerprint import signature_text
+from kakveda_tpu.models.runtime import STUB_RESPONSE
+from kakveda_tpu.platform import Platform
+from kakveda_tpu.service.app import make_agent_echo_app, make_app
+
+
+def _trace(app_id, prompt, response=STUB_RESPONSE):
+    return {
+        "trace_id": str(uuid.uuid4()),
+        "ts": datetime.now(timezone.utc).isoformat(),
+        "app_id": app_id,
+        "agent_id": "agent-1",
+        "prompt": prompt,
+        "response": response,
+        "model": "stub",
+        "temperature": 0.2,
+        "tools": [],
+        "env": {"os": "linux"},
+    }
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def _with_client(app, fn):
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    try:
+        return await fn(client)
+    finally:
+        await client.close()
+
+
+@pytest.fixture()
+def app(tmp_path):
+    plat = Platform(data_dir=tmp_path / "data", capacity=256, dim=1024)
+    return make_app(plat)
+
+
+def test_healthz_readyz(app):
+    async def go(client):
+        r = await client.get("/healthz")
+        assert r.status == 200 and (await r.json())["ok"]
+        r = await client.get("/readyz")
+        body = await r.json()
+        assert body["ok"] and body["gfkb_count"] == 0
+
+    run(_with_client(app, go))
+
+
+def test_ingest_then_views(app):
+    async def go(client):
+        prompt = "Summarize this document and include citations even if not provided."
+        r = await client.post("/ingest", json={"trace": _trace("app-A", prompt)})
+        assert r.status == 200 and (await r.json())["ok"]
+        await client.post("/ingest", json={"trace": _trace("app-B", "Explain paper and add references.")})
+
+        r = await client.get("/failures")
+        failures = (await r.json())["failures"]
+        assert len(failures) == 2
+        assert failures[0]["failure_id"] == "F-0001"
+
+        r = await client.get("/patterns")
+        patterns = (await r.json())["patterns"]
+        assert len(patterns) == 1
+        assert patterns[0]["affected_apps"] == ["app-A", "app-B"]
+
+        r = await client.get("/health/app-A")
+        pts = (await r.json())["points"]
+        assert len(pts) == 1 and pts[0]["score"] == 85.0
+
+    run(_with_client(app, go))
+
+
+def test_warn_contract(app):
+    async def go(client):
+        prompt = "Summarize this document and include citations even if not provided."
+        await client.post("/ingest", json={"trace": _trace("app-A", prompt)})
+        r = await client.post(
+            "/warn",
+            json={"app_id": "app-C", "prompt": prompt, "tools": [], "env": {"os": "linux"}},
+        )
+        body = await r.json()
+        assert r.status == 200
+        assert body["action"] == "warn"
+        assert body["confidence"] > 0.9
+        assert body["references"][0]["failure_id"] == "F-0001"
+
+    run(_with_client(app, go))
+
+
+def test_warn_concurrent_batching(app):
+    async def go(client):
+        await client.post(
+            "/ingest",
+            json={"trace": _trace("app-A", "Summarize with citations please")},
+        )
+        reqs = [
+            client.post(
+                "/warn",
+                json={"app_id": f"a{i}", "prompt": f"Summarize doc {i} with citations", "tools": [], "env": {}},
+            )
+            for i in range(32)
+        ]
+        responses = await asyncio.gather(*reqs)
+        bodies = [await r.json() for r in responses]
+        assert all(r.status == 200 for r in responses)
+        assert all(b["action"] in ("warn", "block", "silent") for b in bodies)
+
+    run(_with_client(app, go))
+
+
+def test_match_and_upsert_endpoints(app):
+    async def go(client):
+        sig = signature_text("Summarize with citations", [], {"os": "linux"})
+        r = await client.post(
+            "/failures/upsert",
+            json={
+                "failure_type": "HALLUCINATION_CITATION",
+                "signature_text": sig,
+                "app_id": "x",
+                "impact_severity": "medium",
+                "resolution": "say no sources",
+            },
+        )
+        body = await r.json()
+        assert body["created"] and body["failure"]["failure_id"] == "F-0001"
+
+        r = await client.post("/failures/match", json={"signature_text": sig})
+        matches = (await r.json())["matches"]
+        assert matches and matches[0]["score"] > 0.99
+
+        r = await client.post(
+            "/patterns/upsert",
+            json={"name": "N", "failure_ids": ["F-0001"], "affected_apps": ["x", "y"]},
+        )
+        assert (await r.json())["pattern"]["pattern_id"] == "FP-0001"
+
+    run(_with_client(app, go))
+
+
+def test_validation_errors(app):
+    async def go(client):
+        r = await client.post("/ingest", json={"trace": {"bad": "shape"}})
+        assert r.status == 422
+        r = await client.post("/warn", json={"nope": 1})
+        assert r.status == 422
+        r = await client.post("/failures/upsert", json={"failure_type": "X"})
+        assert r.status == 422
+        r = await client.post("/subscribe", json={"topic": "t"})
+        assert r.status == 422
+
+    run(_with_client(app, go))
+
+
+def test_pubsub_roundtrip(app, tmp_path):
+    """External subscriber gets HTTP callbacks — the reference bus contract."""
+    received = []
+    echo = make_agent_echo_app()
+
+    async def collector(request):
+        received.append(await request.json())
+        from aiohttp import web
+
+        return web.json_response({"ok": True})
+
+    echo.router.add_post("/collect", collector)
+
+    async def go(client):
+        echo_client = TestClient(TestServer(echo))
+        await echo_client.start_server()
+        try:
+            cb = str(echo_client.make_url("/collect"))
+            r = await client.post("/subscribe", json={"topic": "custom.topic", "callback_url": cb})
+            assert (await r.json())["subscribers"] == 1
+
+            r = await client.post("/publish", json={"topic": "custom.topic", "event": {"x": 1}})
+            assert (await r.json())["delivered"] == 1
+            assert received == [{"x": 1}]
+
+            r = await client.get("/topics")
+            assert (await r.json())["topics"]["custom.topic"] == 1
+        finally:
+            await echo_client.close()
+
+    run(_with_client(app, go))
+
+
+def test_agent_echo_contract():
+    async def go(client):
+        r = await client.get("/health")
+        assert (await r.json())["status"] == "healthy"
+        r = await client.get("/capabilities")
+        assert "echo" in (await r.json())["capabilities"]
+        r = await client.post("/invoke", json={"event_type": "ping", "payload": {"a": 1}})
+        body = await r.json()
+        assert body["status"] == "ok"
+        assert body["events"][0]["payload"]["received_event_type"] == "ping"
+
+    run(_with_client(make_agent_echo_app(), go))
+
+
+def test_request_id_header(app):
+    async def go(client):
+        r = await client.get("/healthz", headers={"x-request-id": "rid-123"})
+        assert r.headers["x-request-id"] == "rid-123"
+        r = await client.get("/healthz")
+        assert len(r.headers["x-request-id"]) == 32
+
+    run(_with_client(app, go))
