@@ -19,12 +19,15 @@ batch sizes by the caller, so the hot path never retraces.
 from __future__ import annotations
 
 import functools
+import os
 from typing import Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kakveda_tpu.ops import pallas_knn
 
 # Sentinel below any reachable cosine score (valid range [-1, 1]).
 _NEG = -2.0
@@ -61,6 +64,7 @@ class ShardedKnn:
         k: int = 5,
         store_dtype: jnp.dtype | None = None,
         shard_axis: str = "data",
+        use_pallas: bool | None = None,
     ):
         if shard_axis not in mesh.axis_names:
             raise ValueError(f"mesh has no axis {shard_axis!r}: {mesh.axis_names}")
@@ -69,10 +73,37 @@ class ShardedKnn:
         self.n_shards = mesh.shape[shard_axis]
         if capacity % self.n_shards != 0:
             capacity += self.n_shards - capacity % self.n_shards
-        self.capacity = capacity
-        self.rows_per_shard = capacity // self.n_shards
         self.dim = dim
         self.k = k
+
+        # Fused Pallas match kernel (ops/pallas_knn.py): on by default on TPU
+        # when the layout qualifies; KAKVEDA_PALLAS=0|1|interpret overrides
+        # ("interpret" runs the kernel through the Pallas interpreter so the
+        # CPU test suite exercises the exact kernel logic).
+        env = os.environ.get("KAKVEDA_PALLAS", "auto").lower()
+        self._pallas_interpret = env == "interpret"
+        if use_pallas is None:
+            if env == "auto":
+                use_pallas = jax.default_backend() == "tpu"
+            else:
+                use_pallas = env not in ("0", "false", "off")
+        rows = capacity // self.n_shards
+        tile = pallas_knn.DEFAULT_ROW_TILE
+        if (
+            use_pallas
+            and dim % 128 == 0
+            and capacity >= tile * self.n_shards
+            and k <= pallas_knn._KPAD
+        ):
+            rows = -(-rows // tile) * tile  # per-shard rows to a tile multiple
+            capacity = rows * self.n_shards
+            self.use_pallas = True
+            self._pallas_tile = tile
+        else:
+            self.use_pallas = False
+            self._pallas_tile = tile
+        self.capacity = capacity
+        self.rows_per_shard = rows
         if store_dtype is None:
             store_dtype = jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32
         self.store_dtype = store_dtype
@@ -147,8 +178,14 @@ class ShardedKnn:
         """
         return jnp.concatenate([vals, phys.astype(jnp.float32)], axis=1)
 
-    def _topk_single_impl(self, emb, valid, q):
-        """Degenerate one-shard path: one matmul + one top_k, plain jit."""
+    def _local_topk(self, emb, valid, q):
+        """Per-shard (scores, rows): fused Pallas kernel when enabled, else
+        matmul + lax.top_k. Identical results either way (same tie-break)."""
+        if self.use_pallas:
+            return pallas_knn.fused_topk(
+                emb, valid, q, k=self.k,
+                row_tile=self._pallas_tile, interpret=self._pallas_interpret,
+            )
         scores = jax.lax.dot_general(
             q.astype(emb.dtype),
             emb,
@@ -156,23 +193,20 @@ class ShardedKnn:
             preferred_element_type=jnp.float32,
         )
         scores = jnp.where(valid[None, :], scores, _NEG)
-        vals, idx = jax.lax.top_k(scores, min(self.k, emb.shape[0]))
+        return jax.lax.top_k(scores, min(self.k, emb.shape[0]))
+
+    def _topk_single_impl(self, emb, valid, q):
+        """Degenerate one-shard path: one local top-k, plain jit."""
+        vals, idx = self._local_topk(emb, valid, q)
         return self._pack(vals, idx)
 
     def _topk_impl(self, emb, valid, q):
         k = self.k
 
         def local(emb_l, valid_l, q_l):
-            # [B, rows_local] cosine scores on this shard's rows.
-            scores = jax.lax.dot_general(
-                q_l.astype(emb_l.dtype),
-                emb_l,
-                (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )
-            scores = jnp.where(valid_l[None, :], scores, _NEG)
-            kk = min(k, emb_l.shape[0])
-            vals, idx = jax.lax.top_k(scores, kk)  # [B, kk]
+            # [B, kk] local candidates from this shard's rows.
+            vals, idx = self._local_topk(emb_l, valid_l, q_l)
+            kk = vals.shape[1]
             shard = jax.lax.axis_index(self.axis)
             phys = idx + shard * emb_l.shape[0]
             # Gather every shard's candidates, merge with a second top-k.

