@@ -42,6 +42,13 @@ def make_dashboard_app(
 
     plat = platform or Platform(**platform_kw)
     db = Database(db_path or (Path(cfg.data_dir) / "dashboard.db"))
+    # Demo accounts carry published credentials and self-repair to them on
+    # every start — never in production (KAKVEDA_DEMO_USERS=1 overrides for
+    # an explicit opt-in).
+    import os
+
+    if cfg.env == "production" and os.environ.get("KAKVEDA_DEMO_USERS") != "1":
+        demo_users = False
     db.bootstrap(demo_users=demo_users)
 
     ctx = DashboardContext(

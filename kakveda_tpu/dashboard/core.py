@@ -134,11 +134,7 @@ async def user_middleware(request: web.Request, handler):
     return await handler(request)
 
 
-@web.middleware
-async def security_headers_middleware(request: web.Request, handler):
-    """CSP/XFO/no-sniff on every response
-    (reference: services/dashboard/app.py:615-626)."""
-    response = await handler(request)
+def _stamp_security_headers(response) -> None:
     response.headers.setdefault(
         "Content-Security-Policy",
         "default-src 'self'; style-src 'self' 'unsafe-inline'",
@@ -149,6 +145,20 @@ async def security_headers_middleware(request: web.Request, handler):
         response.headers.setdefault(
             "Strict-Transport-Security", "max-age=31536000; includeSubDomains"
         )
+
+
+@web.middleware
+async def security_headers_middleware(request: web.Request, handler):
+    """CSP/XFO/no-sniff on every response
+    (reference: services/dashboard/app.py:615-626). Redirects and error
+    pages are raised as HTTPException by most handlers, so the raised path
+    must be stamped too."""
+    try:
+        response = await handler(request)
+    except web.HTTPException as exc:
+        _stamp_security_headers(exc)
+        raise
+    _stamp_security_headers(response)
     return response
 
 
@@ -159,11 +169,21 @@ class RateLimiter:
     """Fixed-window in-memory limiter
     (reference: services/shared/redis_helpers.py:62-84, in-memory tier)."""
 
+    # Keys include client IPs on unauthenticated routes, so expired windows
+    # must actually be evicted or a scan from many IPs leaks memory.
+    _SWEEP_EVERY = 1024
+
     def __init__(self):
         self._hits: Dict[str, tuple[float, int]] = {}
+        self._calls = 0
 
     def allow(self, key: str, limit: int, window_s: float = 60.0) -> bool:
         now = time.time()
+        self._calls += 1
+        if self._calls % self._SWEEP_EVERY == 0:
+            self._hits = {
+                k: v for k, v in self._hits.items() if now - v[0] < window_s
+            }
         start, count = self._hits.get(key, (now, 0))
         if now - start >= window_s:
             start, count = now, 0

@@ -259,11 +259,25 @@ class Database:
     # --- tiny DAO helpers ------------------------------------------------
 
     def execute(self, sql: str, params: Iterable[Any] = ()) -> int:
+        """Run a statement; returns the inserted rowid (INSERTs only —
+        sqlite keeps ``lastrowid`` stale across non-INSERT statements on a
+        shared connection, so use :meth:`execute_rowcount` when the caller
+        needs matched-row semantics)."""
         conn = self.connect()
         try:
             cur = conn.execute(sql, tuple(params))
             conn.commit()
-            return cur.lastrowid or cur.rowcount
+            return cur.lastrowid or 0
+        finally:
+            self._close(conn)
+
+    def execute_rowcount(self, sql: str, params: Iterable[Any] = ()) -> int:
+        """Run a statement; returns the number of matched/affected rows."""
+        conn = self.connect()
+        try:
+            cur = conn.execute(sql, tuple(params))
+            conn.commit()
+            return cur.rowcount
         finally:
             self._close(conn)
 

@@ -196,7 +196,9 @@ def setup(app: web.Application) -> None:
 
     async def agent_heartbeat(request):
         name = request.match_info["name"]
-        n = ctx.db.execute("UPDATE agent_registry SET last_heartbeat=? WHERE name=?", (time.time(), name))
+        n = ctx.db.execute_rowcount(
+            "UPDATE agent_registry SET last_heartbeat=? WHERE name=?", (time.time(), name)
+        )
         if not n:
             return web.json_response({"ok": False, "error": "unknown agent"}, status=404)
         return web.json_response({"ok": True})
@@ -306,16 +308,11 @@ def setup(app: web.Application) -> None:
         if budget and budget["monthly_budget_micro_usd"] > 0:
             if budget["spent_micro_usd"] + cost > budget["monthly_budget_micro_usd"]:
                 status, error = "error", "budget exceeded"
-        if status == "ok" and budget:
-            ctx.db.execute(
-                "UPDATE project_budgets SET spent_micro_usd = spent_micro_usd + ? WHERE project_id=?",
-                (cost, project_id),
-            )
 
         from kakveda_tpu.dashboard.db import new_trace_id
 
         trace_id = str(body.get("trace_id") or new_trace_id())
-        ctx.db.execute(
+        inserted = ctx.db.execute_rowcount(
             "INSERT OR IGNORE INTO trace_runs (trace_id, ts, app_id, agent_id, project_id, prompt,"
             " response, provider, model, latency_ms, tokens_in, tokens_out, cost_micro_usd,"
             " status, error, tags_json) VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?)",
@@ -338,6 +335,17 @@ def setup(app: web.Application) -> None:
                 json.dumps(body.get("tags", [])),
             ),
         )
+        # A duplicate trace_id is a retry: acknowledge idempotently without
+        # charging the budget or re-running the pipeline.
+        if inserted == 0:
+            return web.json_response(
+                {"ok": True, "trace_id": trace_id, "cost_micro_usd": 0, "duplicate": True}
+            )
+        if status == "ok" and budget:
+            ctx.db.execute(
+                "UPDATE project_budgets SET spent_micro_usd = spent_micro_usd + ? WHERE project_id=?",
+                (cost, project_id),
+            )
         if status == "ok":
             await plat.ingest(
                 TracePayload(

@@ -8,8 +8,10 @@ import time
 
 from aiohttp import web
 
+from kakveda_tpu.core.runtime import get_runtime_config
 from kakveda_tpu.dashboard import auth as auth_lib
 from kakveda_tpu.dashboard.core import COOKIE_NAME, CTX_KEY, RATE_LIMITER, VIEW_AS_COOKIE
+from kakveda_tpu.dashboard.routes_main import off_loop
 
 _EMAIL_RE = re.compile(r"^[^@\s]+@[^@\s]+\.[^@\s]+$|^[^@\s]+@local$")
 
@@ -37,15 +39,21 @@ def setup(app: web.Application) -> None:
         email = str(form.get("email", "")).strip().lower()
         password = str(form.get("password", ""))
         row = ctx.db.user_by_email(email)
-        if row is None or not row["is_active"] or not auth_lib.verify_password(password, row["password_hash"]):
+        # pbkdf2 is ~100 ms of CPU; keep it off the event loop that serves
+        # the /warn micro-batcher.
+        pw_ok = row is not None and await off_loop(
+            auth_lib.verify_password, password, row["password_hash"]
+        )
+        if row is None or not row["is_active"] or not pw_ok:
             ctx.db.audit(email, "login.failed")
             return ctx.render(request, "login.html", error="Invalid credentials", next=form.get("next", "/"))
         roles = ctx.db.user_roles(row["id"])
         token = auth_lib.create_access_token(email=email, roles=roles, secret=ctx.jwt_secret)
         nxt = str(form.get("next") or "/")
-        # local-path redirects only: "//evil.com" is protocol-relative and
-        # would be an open redirect
-        if not nxt.startswith("/") or nxt.startswith("//"):
+        # Local-path redirects only: "//evil.com" is protocol-relative and
+        # "/\evil.com" gets browser-normalized to it, so backslashes are
+        # rejected outright.
+        if not nxt.startswith("/") or nxt.startswith("//") or "\\" in nxt:
             nxt = "/"
         resp = web.HTTPFound(nxt)
         resp.set_cookie(COOKIE_NAME, token, httponly=True, samesite="Lax")
@@ -79,10 +87,11 @@ def setup(app: web.Application) -> None:
             )
         if ctx.db.user_by_email(email) is not None:
             return ctx.render(request, "register.html", error="Account already exists")
+        pw_hash = await off_loop(auth_lib.hash_password, password)
         uid = ctx.db.execute(
             "INSERT INTO users (email, password_hash, display_name, is_active, created_at)"
             " VALUES (?,?,?,1,?)",
-            (email, auth_lib.hash_password(password), name, time.time()),
+            (email, pw_hash, name, time.time()),
         )
         rid = ctx.db.one("SELECT id FROM roles WHERE name='viewer'")["id"]
         ctx.db.execute("INSERT OR IGNORE INTO user_roles (user_id, role_id) VALUES (?,?)", (uid, rid))
@@ -105,9 +114,12 @@ def setup(app: web.Application) -> None:
                 "INSERT INTO password_reset_tokens (token, user_id, expires_at) VALUES (?,?,?)",
                 (token, row["id"], time.time() + 3600),
             )
-            # Demo mode shows the link inline; SMTP delivery plugs in here
-            # (reference: services/dashboard/app.py:2585-2642).
-            reset_link = f"/reset?token={token}"
+            # Demo mode shows the link inline; in production that would hand
+            # any account's reset token to an anonymous requester, so the
+            # link is only disclosed outside production (SMTP delivery plugs
+            # in here — reference: services/dashboard/app.py:2585-2642).
+            if get_runtime_config(service_name="dashboard").env != "production":
+                reset_link = f"/reset?token={token}"
             ctx.db.audit(email, "forgot.requested")
         return ctx.render(request, "forgot.html", sent=True, reset_link=reset_link)
 
@@ -128,9 +140,10 @@ def setup(app: web.Application) -> None:
             return ctx.render(
                 request, "reset.html", token=token, error="Password needs ≥8 chars with letters and digits"
             )
+        pw_hash = await off_loop(auth_lib.hash_password, password)
         ctx.db.execute(
             "UPDATE users SET password_hash=? WHERE id=?",
-            (auth_lib.hash_password(password), row["user_id"]),
+            (pw_hash, row["user_id"]),
         )
         ctx.db.execute("UPDATE password_reset_tokens SET used=1 WHERE token=?", (token,))
         ctx.db.audit(None, "password.reset", {"user_id": row["user_id"]})

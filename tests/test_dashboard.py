@@ -338,3 +338,101 @@ def test_purge_demo_reloads_gfkb(tmp_path):
             await client.close()
 
     run(go())
+
+
+def test_login_rejects_backslash_redirect(tmp_path):
+    async def go():
+        client = await _client(_mk_app(tmp_path))
+        try:
+            r = await client.post(
+                "/login",
+                data={"email": "admin@local", "password": "admin123", "next": "/\\evil.com"},
+                allow_redirects=False,
+            )
+            assert r.status == 302 and r.headers["Location"] == "/"
+        finally:
+            await client.close()
+
+    run(go())
+
+
+def test_security_headers_on_redirects(tmp_path):
+    # Most mutating handlers raise HTTPFound; headers must ride those too.
+    async def go():
+        client = await _client(_mk_app(tmp_path))
+        try:
+            r = await client.get("/", allow_redirects=False)
+            assert r.status == 302
+            assert "Content-Security-Policy" in r.headers
+            assert r.headers["X-Frame-Options"] == "DENY"
+        finally:
+            await client.close()
+
+    run(go())
+
+
+def test_api_ingest_duplicate_trace_is_idempotent(tmp_path):
+    async def go():
+        client = await _client(_mk_app(tmp_path))
+        try:
+            await _login(client)
+            await client.post(
+                "/projects/create", data={"name": "proj1", "monthly_budget_micro_usd": "1000000"}
+            )
+            r = await client.post("/projects/api-key", data={"project_id": 1, "label": "ci"})
+            import re
+
+            key = re.search(r"kk-[A-Za-z0-9_\-]+", await r.text()).group(0)
+            payload = {"prompt": "hello world", "response": "resp", "trace_id": "t-dup-1"}
+            r1 = await client.post("/api/ingest/run", json=payload, headers={"X-API-Key": key})
+            out1 = await r1.json()
+            assert r1.status == 200 and out1["ok"] and not out1.get("duplicate")
+            db = client.server.app[_ctx_key()].db
+            spent1 = (db.one(
+                "SELECT spent_micro_usd FROM project_budgets WHERE project_id=1"
+            ) or {}).get("spent_micro_usd")
+
+            r2 = await client.post("/api/ingest/run", json=payload, headers={"X-API-Key": key})
+            out2 = await r2.json()
+            assert r2.status == 200 and out2.get("duplicate") is True
+            spent2 = (db.one(
+                "SELECT spent_micro_usd FROM project_budgets WHERE project_id=1"
+            ) or {}).get("spent_micro_usd")
+            assert spent1 == spent2, "retry must not double-charge the budget"
+        finally:
+            await client.close()
+
+    run(go())
+
+
+def _ctx_key():
+    from kakveda_tpu.dashboard.core import CTX_KEY
+
+    return CTX_KEY
+
+
+def test_production_skips_demo_users(tmp_path, monkeypatch):
+    monkeypatch.setenv("KAKVEDA_ENV", "production")
+    monkeypatch.setenv("DASHBOARD_JWT_SECRET", "prod-secret-123456")
+    app = make_dashboard_app(
+        platform=Platform(data_dir=tmp_path / "data", capacity=256, dim=1024),
+        db_path=tmp_path / "dash.db",
+        model=StubRuntime(),
+    )
+    db = app[_ctx_key()].db
+    assert db.user_by_email("admin@local") is None
+
+
+def test_forgot_hides_reset_link_in_production(tmp_path, monkeypatch):
+    async def go():
+        monkeypatch.setenv("KAKVEDA_ENV", "production")
+        monkeypatch.setenv("DASHBOARD_JWT_SECRET", "prod-secret-123456")
+        monkeypatch.setenv("KAKVEDA_DEMO_USERS", "1")
+        client = await _client(_mk_app(tmp_path))
+        try:
+            r = await client.post("/forgot", data={"email": "admin@local"})
+            assert "token=" not in await r.text()
+        finally:
+            await client.close()
+
+    run(go())
