@@ -36,6 +36,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 from jax.sharding import Mesh
 
+from kakveda_tpu import native
 from kakveda_tpu.core.schemas import (
     CanonicalFailureRecord,
     FailureMatch,
@@ -79,6 +80,11 @@ class GFKB:
         self._slot_by_key: Dict[Tuple[str, str], int] = {}
         self._patterns: Dict[str, PatternEntity] = {}  # name -> latest
         self._lock = threading.Lock()
+        # Group-commit append logs (C++ writer when available): records are
+        # buffered and flushed after each upsert batch instead of paying an
+        # open+write+close per record (the reference's pattern,
+        # services/gfkb/app.py:49-51).
+        self._logs: Dict[Path, "native.AppendLog"] = {}
 
         if persist:
             self._replay()
@@ -88,10 +94,27 @@ class GFKB:
     # ------------------------------------------------------------------
 
     def _append_jsonl(self, path: Path, obj: dict) -> None:
+        """Buffer one record; callers group-commit with :meth:`_flush_logs`
+        at the end of each public mutation (read-your-writes for external
+        readers of the JSONL files, one syscall per batch instead of an
+        open+write+close per record)."""
         if not self.persist:
             return
-        with path.open("a", encoding="utf-8") as f:
-            f.write(json.dumps(obj, ensure_ascii=False) + "\n")
+        log = self._logs.get(path)
+        if log is None:
+            log = self._logs[path] = native.AppendLog(path)
+        line = json.dumps(obj, ensure_ascii=False) + "\n"
+        log.append(line.encode("utf-8"))
+
+    def _flush_logs(self) -> None:
+        for log in self._logs.values():
+            log.flush()
+
+    def close(self) -> None:
+        """Flush and close the append logs (safe to call repeatedly)."""
+        for log in self._logs.values():
+            log.close()
+        self._logs.clear()
 
     def _replay(self) -> None:
         """Rebuild host metadata + device index from the append logs."""
@@ -129,6 +152,9 @@ class GFKB:
         host metadata stay consistent with the log.
         """
         with self._lock:
+            # Reopen the append logs: an external rewrite may have replaced
+            # the files (new inode), and a held fd would append to the old one.
+            self.close()
             self._emb, self._valid = self._knn.alloc()
             self._records = []
             self._slot_by_key = {}
@@ -225,6 +251,7 @@ class GFKB:
                 self._records[slot] = rec
                 # Same signature text => identical embedding; no device write.
             self._append_jsonl(self.failures_path, rec.model_dump(mode="json"))
+            self._flush_logs()
             return rec, created
 
     def upsert_failures_batch(self, items: Sequence[dict]) -> List[Tuple[CanonicalFailureRecord, bool]]:
@@ -276,6 +303,7 @@ class GFKB:
                     self._records[slot] = rec
                     out.append((rec, False))
                 self._append_jsonl(self.failures_path, rec.model_dump(mode="json"))
+            self._flush_logs()
             if new_slots:
                 self._ensure_capacity(len(self._records))
                 vecs = self.featurizer.encode_batch(new_texts)
@@ -381,4 +409,5 @@ class GFKB:
                 created = False
             self._patterns[name] = p
             self._append_jsonl(self.patterns_path, p.model_dump(mode="json"))
+            self._flush_logs()
             return p, created

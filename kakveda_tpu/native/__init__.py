@@ -1,0 +1,149 @@
+"""ctypes bindings for the C++ native host tier (src/native.cc).
+
+The native library accelerates the two host-side hot loops around the TPU
+core: signature-text featurization (the per-trace CPU cost of the
+10k traces/sec ingest path) and the GFKB's append-only persistence
+(group-commit writer vs the reference's open+write+close per record,
+reference: services/gfkb/app.py:49-51).
+
+Everything here is optional: ``load()`` returns None when the library is
+absent and cannot be built, and every consumer falls back to the pure
+Python implementation. Set ``KAKVEDA_NATIVE=0`` to force the fallback,
+``KAKVEDA_NATIVE=require`` to fail loudly instead of falling back.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+from pathlib import Path
+from typing import Optional
+
+log = logging.getLogger("kakveda.native")
+
+_DIR = Path(__file__).parent
+_LIB_PATH = _DIR / "build" / "libkakveda_native.so"
+
+_lib: Optional[ctypes.CDLL] = None
+_load_attempted = False
+
+
+def _build() -> bool:
+    """Compile the library in-tree (g++ is part of the supported toolchain)."""
+    try:
+        subprocess.run(
+            ["make", "-s"],
+            cwd=_DIR,
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        return _LIB_PATH.exists()
+    except (subprocess.SubprocessError, OSError) as e:  # noqa: PERF203
+        log.debug("native build failed: %s", e)
+        return False
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """The shared library, building it on first use; None if unavailable."""
+    global _lib, _load_attempted
+    if _lib is not None:
+        return _lib
+    if _load_attempted:
+        return None
+    _load_attempted = True
+    env = os.environ.get("KAKVEDA_NATIVE", "auto").lower()
+    if env in ("0", "false", "off"):
+        return None
+    if not _LIB_PATH.exists() and not _build():
+        if env == "require":
+            raise RuntimeError("KAKVEDA_NATIVE=require but the native library cannot be built")
+        return None
+    try:
+        lib = ctypes.CDLL(str(_LIB_PATH))
+    except OSError as e:
+        if env == "require":
+            raise
+        log.debug("native load failed: %s", e)
+        return None
+
+    lib.kkv_crc32.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    lib.kkv_crc32.restype = ctypes.c_uint32
+    lib.kkv_encode_batch.argtypes = [
+        ctypes.POINTER(ctypes.c_char_p),
+        ctypes.c_int,
+        ctypes.c_int,
+        ctypes.POINTER(ctypes.c_float),
+        ctypes.c_char_p,
+    ]
+    lib.kkv_encode_batch.restype = ctypes.c_int
+    lib.kkv_log_open.argtypes = [ctypes.c_char_p, ctypes.c_long]
+    lib.kkv_log_open.restype = ctypes.c_void_p
+    lib.kkv_log_append.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_long]
+    lib.kkv_log_append.restype = ctypes.c_int
+    lib.kkv_log_flush.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.kkv_log_flush.restype = ctypes.c_int
+    lib.kkv_log_close.argtypes = [ctypes.c_void_p]
+    lib.kkv_log_close.restype = None
+    _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+class AppendLog:
+    """Buffered append-only log with explicit group-commit flush.
+
+    Pure-Python fallback when the native library is absent — same API, one
+    ``open`` file object with Python-side buffering.
+    """
+
+    def __init__(self, path: str | os.PathLike, flush_bytes: int = 1 << 20):
+        self._path = str(path)
+        self._lib = load()
+        self._h = None
+        self._f = None
+        if self._lib is not None:
+            self._h = self._lib.kkv_log_open(self._path.encode(), flush_bytes)
+        if self._h is None:
+            self._lib = None
+            self._f = open(self._path, "ab", buffering=flush_bytes)
+
+    @property
+    def native(self) -> bool:
+        return self._h is not None
+
+    def append(self, record: bytes) -> None:
+        """Append one record (caller includes the trailing newline)."""
+        if self._h is not None:
+            if self._lib.kkv_log_append(self._h, record, len(record)) != 0:
+                raise OSError(f"native append failed: {self._path}")
+        else:
+            self._f.write(record)
+
+    def flush(self, fsync: bool = False) -> None:
+        if self._h is not None:
+            if self._lib.kkv_log_flush(self._h, 1 if fsync else 0) != 0:
+                raise OSError(f"native flush failed: {self._path}")
+        else:
+            self._f.flush()
+            if fsync:
+                os.fsync(self._f.fileno())
+
+    def close(self) -> None:
+        if self._h is not None:
+            self._lib.kkv_log_close(self._h)
+            self._h = None
+        elif self._f is not None:
+            self._f.close()
+            self._f = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -117,12 +117,49 @@ class HashedNGramFeaturizer:
                 feats.extend((t, weight) for t in _terms(rest))
         return feats
 
+    def _native_spec(self) -> str:
+        """Field specs serialized for the C++ encoder ("name,weight,atomic;…")."""
+        return ";".join(
+            f"{name},{weight!r},{1 if atomic else 0}"
+            for name, (weight, atomic) in self.field_specs.items()
+        )
+
     def encode(self, text: str) -> np.ndarray:
         """One L2-normalized float32 vector of shape [dim]."""
         return self.encode_batch([text])[0]
 
     def encode_batch(self, texts: Sequence[str]) -> np.ndarray:
-        """[B, dim] float32, rows L2-normalized (zero row for empty text)."""
+        """[B, dim] float32, rows L2-normalized (zero row for empty text).
+
+        ASCII batches take the C++ path (kakveda_tpu/native) when the
+        library is available — same features, same crc32 buckets; non-ASCII
+        strings fall back here because unicode lowercasing is
+        Python-defined.
+        """
+        from kakveda_tpu import native
+
+        lib = native.load()
+        if lib is not None and all(isinstance(t, str) and t.isascii() for t in texts):
+            return self._encode_batch_native(lib, texts)
+        return self._encode_batch_py(texts)
+
+    def _encode_batch_native(self, lib, texts: Sequence[str]) -> np.ndarray:
+        import ctypes
+
+        out = np.zeros((len(texts), self.dim), dtype=np.float32)
+        arr = (ctypes.c_char_p * len(texts))(*[t.encode("ascii") for t in texts])
+        rc = lib.kkv_encode_batch(
+            arr,
+            len(texts),
+            self.dim,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            self._native_spec().encode("ascii"),
+        )
+        if rc != 0:
+            return self._encode_batch_py(texts)
+        return out
+
+    def _encode_batch_py(self, texts: Sequence[str]) -> np.ndarray:
         out = np.zeros((len(texts), self.dim), dtype=np.float32)
         for i, text in enumerate(texts):
             row = out[i]

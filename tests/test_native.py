@@ -1,0 +1,118 @@
+"""C++ native tier: crc32/featurizer parity vs the Python reference
+implementation, and the append-log writer."""
+
+import random
+import string
+import zlib
+
+import numpy as np
+import pytest
+
+from kakveda_tpu import native
+from kakveda_tpu.core.fingerprint import signature_text
+from kakveda_tpu.ops.featurizer import HashedNGramFeaturizer
+
+lib = native.load()
+needs_native = pytest.mark.skipif(lib is None, reason="native library unavailable")
+
+
+@needs_native
+def test_crc32_parity():
+    rng = random.Random(0)
+    cases = [b"", b"a", b"hello world", bytes(range(256))]
+    cases += [
+        "".join(rng.choices(string.printable, k=rng.randint(1, 200))).encode()
+        for _ in range(50)
+    ]
+    for c in cases:
+        assert lib.kkv_crc32(c, len(c)) == zlib.crc32(c)
+
+
+@needs_native
+def test_featurizer_parity_structured_and_random():
+    f = HashedNGramFeaturizer(dim=1024)
+    rng = random.Random(1)
+    alphabet = string.ascii_letters + string.digits + " _:,|.!?-"
+    texts = [
+        signature_text(
+            "Summarize this document and include citations even if not provided.",
+            [],
+            {"os": "linux"},
+        ),
+        signature_text("Explain with references.", ["search", "browse"], {"a": 1, "b": 2}),
+        "free form text with no fields",
+        "intent_tags: a, b , c | prompt_hint: Hello World_9 | tools:  | env_keys: os",
+        "",
+        " | ",
+        "UNKNOWN_Field: Stuff Here | intent_tags: x",
+        "trailing field sep | ",
+    ] + ["".join(rng.choices(alphabet, k=rng.randint(0, 300))) for _ in range(100)]
+    a = f._encode_batch_py(texts)
+    b = f._encode_batch_native(lib, texts)
+    assert ((a != 0) == (b != 0)).all(), "bucket support must match exactly"
+    np.testing.assert_allclose(a, b, atol=2e-7)
+
+
+@needs_native
+def test_featurizer_nonascii_falls_back():
+    f = HashedNGramFeaturizer(dim=256)
+    texts = ["prompt_hint: café résumé", "plain ascii"]
+    out = f.encode_batch(texts)  # must not crash; routes through Python
+    ref = f._encode_batch_py(texts)
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_featurizer_env_disable(monkeypatch):
+    monkeypatch.setattr(native, "_lib", None)
+    monkeypatch.setattr(native, "_load_attempted", False)
+    monkeypatch.setenv("KAKVEDA_NATIVE", "0")
+    assert native.load() is None
+    f = HashedNGramFeaturizer(dim=256)
+    v = f.encode_batch(["still works via python"])
+    assert v.shape == (1, 256) and np.isclose(np.linalg.norm(v[0]), 1.0)
+    monkeypatch.setattr(native, "_load_attempted", False)
+
+
+def test_append_log_roundtrip(tmp_path):
+    p = tmp_path / "log.jsonl"
+    with native.AppendLog(p) as log:
+        for i in range(100):
+            log.append(f'{{"i": {i}}}\n'.encode())
+        log.flush(fsync=True)
+        lines = p.read_text().splitlines()
+        assert len(lines) == 100 and lines[42] == '{"i": 42}'
+    # append mode: reopening continues the log
+    with native.AppendLog(p) as log:
+        log.append(b'{"i": 100}\n')
+        log.flush()
+        assert len(p.read_text().splitlines()) == 101
+
+
+def test_append_log_python_fallback(tmp_path, monkeypatch):
+    monkeypatch.setattr(native, "_lib", None)
+    monkeypatch.setattr(native, "_load_attempted", False)
+    monkeypatch.setenv("KAKVEDA_NATIVE", "0")
+    p = tmp_path / "log.jsonl"
+    with native.AppendLog(p) as log:
+        assert not log.native
+        log.append(b"x\n")
+        log.flush(fsync=True)
+    assert p.read_text() == "x\n"
+    monkeypatch.setattr(native, "_load_attempted", False)
+
+
+@needs_native
+def test_gfkb_appends_visible_after_upsert(tmp_path):
+    """Group-commit must still give read-your-writes after each public op."""
+    from kakveda_tpu.index.gfkb import GFKB
+
+    idx = GFKB(data_dir=tmp_path, capacity=64, dim=256)
+    idx.upsert_failure(
+        failure_type="HALLUCINATION_CITATION",
+        signature_text="intent_tags: intent:citations_required | prompt_hint: x",
+        app_id="app-A",
+        impact_severity="medium",
+    )
+    text = (tmp_path / "failures.jsonl").read_text()
+    assert text.count("\n") == 1 and "F-0001" in text
+    idx.close()
