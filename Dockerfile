@@ -1,0 +1,38 @@
+# kakveda-tpu: single-image deployment.
+#
+# The reference ships 9 service containers wired over HTTP
+# (reference: docker-compose.yml:1-170); this framework collapses the
+# pipeline into one device-owning process, so one image serves the platform
+# API (8100, all reference REST contracts) and the dashboard (8110).
+#
+# Build arg BASE selects the runtime:
+#   - TPU hosts:  a jax[tpu] image (the default expects libtpu present on
+#     the host via the TPU VM runtime)
+#   - CPU/dev:    python:3.12-slim works; jax falls back to CPU.
+ARG BASE=python:3.12-slim
+FROM ${BASE}
+
+WORKDIR /app
+
+# Native toolchain for the in-tree C++ host tier (kakveda_tpu/native).
+RUN apt-get update \
+    && apt-get install -y --no-install-recommends g++ make \
+    && rm -rf /var/lib/apt/lists/*
+
+COPY pyproject.toml README.md ./
+COPY kakveda_tpu ./kakveda_tpu
+COPY config ./config
+COPY scripts ./scripts
+
+RUN pip install --no-cache-dir . \
+    && make -C kakveda_tpu/native
+
+ENV KAKVEDA_DATA_DIR=/app/data \
+    KAKVEDA_CONFIG_PATH=/app/config/config.yaml
+VOLUME /app/data
+
+EXPOSE 8100 8110
+HEALTHCHECK --interval=30s --timeout=5s \
+    CMD python -c "import urllib.request;urllib.request.urlopen('http://127.0.0.1:8100/healthz', timeout=3)"
+
+CMD ["python", "-m", "kakveda_tpu.service", "--host", "0.0.0.0"]

@@ -1,3 +1,22 @@
+"""`python -m kakveda_tpu.service` — start the platform API + dashboard."""
+
+import argparse
+
 from kakveda_tpu.service.main import run_server
 
-run_server()
+ap = argparse.ArgumentParser(prog="kakveda_tpu.service")
+ap.add_argument("--host", default="127.0.0.1")
+ap.add_argument("--port", type=int, default=8100)
+ap.add_argument("--dashboard-port", type=int, default=8110)
+ap.add_argument("--no-dashboard", action="store_true")
+ap.add_argument("--data-dir", default=None)
+args = ap.parse_args()
+
+raise SystemExit(
+    run_server(
+        host=args.host,
+        port=args.port,
+        data_dir=args.data_dir,
+        dashboard_port=None if args.no_dashboard else args.dashboard_port,
+    )
+)

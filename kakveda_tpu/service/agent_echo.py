@@ -1,0 +1,44 @@
+"""Standalone agent-echo server: `python -m kakveda_tpu.service.agent_echo`.
+
+Runs the reference external-agent contract (/health, /capabilities,
+/invoke — reference: services/agent_echo/app.py:13-47) as its own process,
+for exercising the agent registry and event plane over real HTTP.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+
+from aiohttp import web
+
+from kakveda_tpu.core.runtime import setup_logging
+from kakveda_tpu.service.app import make_agent_echo_app
+
+
+async def _serve(host: str, port: int) -> None:
+    runner = web.AppRunner(make_agent_echo_app())
+    await runner.setup()
+    await web.TCPSite(runner, host, port).start()
+    try:
+        while True:
+            await asyncio.sleep(3600)
+    finally:
+        await runner.cleanup()
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(prog="kakveda_tpu.service.agent_echo")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=8120)
+    args = ap.parse_args()
+    setup_logging(service_name="agent-echo")
+    try:
+        asyncio.run(_serve(args.host, args.port))
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,171 @@
+#!/usr/bin/env python3
+"""End-to-end scripted demo against the platform API.
+
+Mirrors the reference demo client (reference: scripts/demo_client.py:43-107):
+two citation-bait scenarios (app-A summarize, app-B explain) each run
+warn -> generate -> ingest, a follow-up warn that should now match the GFKB
+with high confidence and carry pattern FP-0001, then eight extra failing runs
+to degrade app-A's health, and finally a printout of the GFKB failures,
+patterns and health timeline.
+
+Usage:
+    python scripts/demo_client.py                     # against a running
+        server (kakveda-tpu up), default http://127.0.0.1:8100
+    python scripts/demo_client.py --base-url http://host:8100
+    python scripts/demo_client.py --in-process        # hermetic: spins the
+        aiohttp app up in-process (CPU jax), no server needed
+
+Generation uses the deterministic stub runtime — the same fake-citation
+responses the reference falls back to when Ollama is absent
+(reference: services/dashboard/app.py:1193-1199) — so the full failure
+pipeline fires with no model weights anywhere.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+import time
+import uuid
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent.parent))
+
+SCENARIOS = [
+    ("app-A", "Summarize this document and include citations even if not provided."),
+    ("app-B", "Explain the findings with references to published sources."),
+]
+
+
+async def run_demo(client, base: str) -> int:
+    from kakveda_tpu.models.runtime import StubRuntime
+
+    stub = StubRuntime()
+
+    async def post(path, payload):
+        async with client.post(base + path, json=payload) as r:
+            body = await r.json()
+            if r.status >= 400:
+                raise RuntimeError(f"POST {path} -> {r.status}: {body}")
+            return body
+
+    async def get(path):
+        async with client.get(base + path) as r:
+            return await r.json()
+
+    print("== scenarios (warn -> generate -> ingest) ==")
+    for app_id, prompt in SCENARIOS:
+        warn = await post(
+            "/warn", {"app_id": app_id, "prompt": prompt, "tools": [], "env": {"os": "linux"}}
+        )
+        print(f"[{app_id}] pre-flight: action={warn['action']} confidence={warn['confidence']:.2f}")
+        gen = stub.generate(prompt)
+        await post(
+            "/ingest",
+            {
+                "trace": {
+                    "trace_id": str(uuid.uuid4()),
+                    "ts": time.time(),
+                    "app_id": app_id,
+                    "prompt": prompt,
+                    "response": gen.text,
+                    "tools": [],
+                    "env": {"os": "linux"},
+                }
+            },
+        )
+    await asyncio.sleep(0.5)  # let the event pipeline drain
+
+    print("\n== follow-up pre-flight (should match the GFKB now) ==")
+    warn = await post(
+        "/warn",
+        {"app_id": "app-C", "prompt": SCENARIOS[0][1], "tools": [], "env": {"os": "linux"}},
+    )
+    print(
+        f"[app-C] action={warn['action']} confidence={warn['confidence']:.2f} "
+        f"pattern={warn.get('pattern_id')} refs={[m['failure_id'] for m in warn['references']]}"
+    )
+
+    print("\n== degrading app-A health with 8 more failing runs ==")
+    for i in range(8):
+        await post(
+            "/ingest",
+            {
+                "trace": {
+                    "trace_id": str(uuid.uuid4()),
+                    "ts": time.time(),
+                    "app_id": "app-A",
+                    "prompt": SCENARIOS[0][1] + f" (run {i})",
+                    "response": stub.generate(SCENARIOS[0][1]).text,
+                    "tools": [],
+                    "env": {"os": "linux"},
+                }
+            },
+        )
+    await asyncio.sleep(0.5)
+
+    failures = (await get("/failures"))["failures"]
+    patterns = (await get("/patterns"))["patterns"]
+    health = await get("/health/app-A")
+    print("\n== GFKB ==")
+    for f in failures:
+        print(
+            f"  {f['failure_id']}v{f['version']} {f['failure_type']} "
+            f"occurrences={f['occurrences']} apps={f['affected_apps']}"
+        )
+    print("== patterns ==")
+    for p in patterns:
+        print(f"  {p['pattern_id']} {p['name']} apps={p['affected_apps']}")
+    print("== health timeline (app-A) ==")
+    for pt in (health.get("points") or [])[-5:]:
+        print(f"  {pt['ts']} score={pt['score']} rate={pt['failure_rate']}")
+
+    ok = (
+        len(failures) >= 2
+        and any(p["pattern_id"] == "FP-0001" for p in patterns)
+        and warn["confidence"] > 0.8
+        and (health.get("points") or [])
+        and health["points"][-1]["score"] < 100
+    )
+    print(f"\ndemo {'PASSED' if ok else 'FAILED'}")
+    return 0 if ok else 1
+
+
+async def main_http(base_url: str) -> int:
+    import aiohttp
+
+    async with aiohttp.ClientSession() as client:
+        return await run_demo(client, base_url)
+
+
+async def main_in_process() -> int:
+    import tempfile
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from kakveda_tpu.platform import Platform
+    from kakveda_tpu.service.app import make_app
+
+    with tempfile.TemporaryDirectory() as td:
+        plat = Platform(data_dir=td, capacity=256, dim=1024)
+        client = TestClient(TestServer(make_app(platform=plat)))
+        await client.start_server()
+        try:
+            return await run_demo(client.session, str(client.make_url("")))
+        finally:
+            await client.close()
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--base-url", default="http://127.0.0.1:8100")
+    ap.add_argument("--in-process", action="store_true", help="run hermetically, no server")
+    args = ap.parse_args()
+    if args.in_process:
+        sys.exit(asyncio.run(main_in_process()))
+    sys.exit(asyncio.run(main_http(args.base_url)))

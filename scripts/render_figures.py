@@ -1,0 +1,44 @@
+#!/usr/bin/env python3
+"""Render docs/figures/*.svg to PNG.
+
+Mirrors the reference's figure renderer (reference:
+scripts/render_figures.py:22-49). cairosvg is not part of this image's
+baked dependency set, so the script degrades to a no-op with a clear
+message when it is absent (the SVGs render natively on GitHub either way).
+
+Usage: python scripts/render_figures.py [--scale 2.0]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+FIGURES_DIR = Path(__file__).parent.parent / "docs" / "figures"
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--scale", type=float, default=2.0, help="raster scale factor")
+    args = ap.parse_args()
+
+    try:
+        import cairosvg
+    except ImportError:
+        print("cairosvg not installed; SVG sources are the canonical figures — skipping")
+        return 0
+
+    svgs = sorted(FIGURES_DIR.glob("*.svg"))
+    if not svgs:
+        print(f"no figures under {FIGURES_DIR}")
+        return 1
+    for svg in svgs:
+        png = svg.with_suffix(".png")
+        cairosvg.svg2png(url=str(svg), write_to=str(png), scale=args.scale)
+        print(f"rendered {png} ({png.stat().st_size} bytes)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
