@@ -1,0 +1,104 @@
+"""Best-effort OpenTelemetry bootstrap.
+
+Capability parity with the reference's otel module (reference:
+services/shared/otel.py:6-59): an OTLP span exporter plus per-request
+server spans, enabled only when ``KAKVEDA_OTEL_ENABLED`` is truthy and
+degrading to a no-op when the SDK (or the exporter endpoint) is absent —
+observability must never take the service down.
+
+The reference instruments FastAPI; the server here is aiohttp, so
+instrumentation is an explicit middleware (``otel_middleware``) that opens
+one server span per request, records method/route/status, and marks 5xx as
+errors. TPU-side kernel profiling is separate (``jax.profiler`` — see
+kakveda_tpu/platform.py profiling hooks); OTel covers the host plane.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Optional
+
+from kakveda_tpu.core.runtime import get_runtime_config
+
+log = logging.getLogger("kakveda.otel")
+
+_tracer: Optional[Any] = None
+_setup_done = False
+
+
+def setup_otel(service_name: str) -> bool:
+    """Install a tracer provider with an OTLP exporter. Returns enabled?"""
+    global _tracer, _setup_done
+    if _setup_done:
+        return _tracer is not None
+    _setup_done = True
+    cfg = get_runtime_config(service_name=service_name)
+    if not cfg.otel_enabled:
+        return False
+    try:
+        from opentelemetry import trace
+        from opentelemetry.sdk.resources import Resource
+        from opentelemetry.sdk.trace import TracerProvider
+        from opentelemetry.sdk.trace.export import BatchSpanProcessor
+
+        provider = TracerProvider(
+            resource=Resource.create({"service.name": cfg.otel_service_name})
+        )
+        if cfg.otel_exporter_otlp_endpoint:
+            try:
+                from opentelemetry.exporter.otlp.proto.http.trace_exporter import (
+                    OTLPSpanExporter,
+                )
+
+                provider.add_span_processor(
+                    BatchSpanProcessor(
+                        OTLPSpanExporter(endpoint=cfg.otel_exporter_otlp_endpoint)
+                    )
+                )
+            except Exception as e:  # noqa: BLE001 — exporter is optional
+                log.warning("otel exporter unavailable: %s", e)
+        trace.set_tracer_provider(provider)
+        _tracer = trace.get_tracer("kakveda-tpu")
+        log.info("otel enabled (service=%s)", cfg.otel_service_name)
+        return True
+    except Exception as e:  # noqa: BLE001 — never block startup on otel
+        log.warning("otel disabled: %s", e)
+        return False
+
+
+def get_tracer() -> Optional[Any]:
+    return _tracer
+
+
+def otel_middleware():
+    """aiohttp middleware: one server span per request (no-op when off)."""
+    from aiohttp import web
+
+    @web.middleware
+    async def mw(request: web.Request, handler):
+        tracer = _tracer
+        if tracer is None:
+            return await handler(request)
+        from opentelemetry.trace import SpanKind, Status, StatusCode
+
+        with tracer.start_as_current_span(
+            f"{request.method} {request.path}", kind=SpanKind.SERVER
+        ) as span:
+            span.set_attribute("http.request.method", request.method)
+            span.set_attribute("url.path", request.path)
+            try:
+                response = await handler(request)
+            except web.HTTPException as exc:
+                span.set_attribute("http.response.status_code", exc.status)
+                if exc.status >= 500:
+                    span.set_status(Status(StatusCode.ERROR))
+                raise
+            except Exception as exc:
+                span.set_status(Status(StatusCode.ERROR, str(exc)))
+                raise
+            span.set_attribute("http.response.status_code", response.status)
+            if response.status >= 500:
+                span.set_status(Status(StatusCode.ERROR))
+            return response
+
+    return mw

@@ -1,0 +1,65 @@
+"""JWT revocation store — logout actually invalidates the token.
+
+Capability parity with the reference's RevocationStore (reference:
+services/shared/redis_helpers.py:26-59): revoked token ids (jti) are held
+until their natural expiry, backed by Redis when ``KAKVEDA_REDIS_URL`` is
+set and the client library is importable, else an in-memory TTL set (the
+reference's fallback tier; fine for the single-process deployment, which
+is the default topology here).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, Optional
+
+
+class RevocationStore:
+    def __init__(self, redis_url: Optional[str] = None):
+        self._mem: Dict[str, float] = {}  # jti -> expiry ts
+        self._lock = threading.Lock()
+        self._redis = None
+        url = redis_url or os.environ.get("KAKVEDA_REDIS_URL")
+        if url:
+            try:
+                import redis  # type: ignore[import-not-found]
+
+                self._redis = redis.Redis.from_url(url, socket_timeout=2)
+                self._redis.ping()
+            except Exception:  # noqa: BLE001 — fall back to memory
+                self._redis = None
+
+    def revoke(self, jti: str, expires_at: float) -> None:
+        """Remember ``jti`` as revoked until ``expires_at`` (unix ts)."""
+        ttl = max(1, int(expires_at - time.time()))
+        if self._redis is not None:
+            try:
+                self._redis.setex(f"kakveda:revoked:{jti}", ttl, b"1")
+                return
+            except Exception:  # noqa: BLE001
+                pass
+        with self._lock:
+            self._sweep_locked()
+            self._mem[jti] = expires_at
+
+    def is_revoked(self, jti: str) -> bool:
+        if self._redis is not None:
+            try:
+                return bool(self._redis.exists(f"kakveda:revoked:{jti}"))
+            except Exception:  # noqa: BLE001
+                pass
+        with self._lock:
+            exp = self._mem.get(jti)
+            if exp is None:
+                return False
+            if exp <= time.time():
+                del self._mem[jti]
+                return False
+            return True
+
+    def _sweep_locked(self) -> None:
+        if len(self._mem) > 4096:
+            now = time.time()
+            self._mem = {k: v for k, v in self._mem.items() if v > now}

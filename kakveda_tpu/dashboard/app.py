@@ -58,9 +58,12 @@ def make_dashboard_app(
         jwt_secret=cfg.dashboard_jwt_secret,
     )
 
-    app = web.Application(
-        middlewares=[request_context_middleware, user_middleware, security_headers_middleware]
-    )
+    from kakveda_tpu.core import otel
+
+    middlewares = [request_context_middleware, user_middleware, security_headers_middleware]
+    if otel.setup_otel("dashboard"):
+        middlewares.insert(0, otel.otel_middleware())
+    app = web.Application(middlewares=middlewares)
     app[CTX_KEY] = ctx
 
     from kakveda_tpu.dashboard import routes_admin, routes_auth, routes_data, routes_main

@@ -11,6 +11,7 @@ from typing import Any, Dict, List, Optional
 import jinja2
 from aiohttp import web
 
+from kakveda_tpu.core.revocation import RevocationStore
 from kakveda_tpu.core.runtime import get_runtime_config
 from kakveda_tpu.dashboard import auth as auth_lib
 from kakveda_tpu.dashboard import rbac
@@ -33,6 +34,7 @@ class DashboardContext:
     db: Database
     model: ModelRuntime
     jwt_secret: str
+    revocations: RevocationStore = field(default_factory=RevocationStore)
     jinja: jinja2.Environment = field(init=False)
 
     def __post_init__(self):
@@ -76,6 +78,8 @@ def resolve_user(request: web.Request) -> Optional[CurrentUser]:
         return None
     claims = auth_lib.decode_token(token, secret=ctx.jwt_secret)
     if not claims:
+        return None
+    if claims.get("jti") and ctx.revocations.is_revoked(claims["jti"]):
         return None
     row = ctx.db.user_by_email(claims.get("sub", ""))
     if row is None or not row["is_active"]:

@@ -62,6 +62,14 @@ def setup(app: web.Application) -> None:
 
     async def logout(request):
         user = request.get("user")
+        # Revoke the token itself (reference:
+        # services/dashboard/app.py:2507-2524 + redis_helpers.py:26-59) —
+        # deleting the cookie alone leaves a stolen copy valid until expiry.
+        token = request.cookies.get(COOKIE_NAME)
+        if token:
+            claims = auth_lib.decode_token(token, secret=ctx.jwt_secret)
+            if claims and claims.get("jti"):
+                ctx.revocations.revoke(claims["jti"], float(claims.get("exp", 0)))
         resp = web.HTTPFound("/login")
         resp.del_cookie(COOKIE_NAME)
         resp.del_cookie(VIEW_AS_COOKIE)

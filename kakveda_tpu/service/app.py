@@ -76,7 +76,12 @@ async def request_context_middleware(request: web.Request, handler):
 
 def make_app(platform: Optional[Platform] = None, **platform_kw) -> web.Application:
     plat = platform or Platform(**platform_kw)
-    app = web.Application(middlewares=[request_context_middleware])
+    from kakveda_tpu.core import otel
+
+    middlewares = [request_context_middleware]
+    if otel.setup_otel("platform"):
+        middlewares.insert(0, otel.otel_middleware())
+    app = web.Application(middlewares=middlewares)
     app[PLATFORM_KEY] = plat
 
     warn_batcher: MicroBatcher = MicroBatcher(plat.warn_batch, max_batch=64, deadline_s=0.002)

@@ -1,0 +1,104 @@
+"""OTel bootstrap (best-effort, gated) and JWT revocation on logout."""
+
+import asyncio
+import time
+
+from aiohttp.test_utils import TestClient, TestServer
+
+from kakveda_tpu.core import otel
+from kakveda_tpu.core.revocation import RevocationStore
+from kakveda_tpu.dashboard.app import make_dashboard_app
+from kakveda_tpu.models.runtime import StubRuntime
+from kakveda_tpu.platform import Platform
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def test_revocation_store_memory_ttl():
+    rs = RevocationStore(redis_url=None)
+    rs.revoke("jti-1", time.time() + 60)
+    assert rs.is_revoked("jti-1")
+    assert not rs.is_revoked("jti-2")
+    rs.revoke("jti-old", time.time() - 1)
+    assert not rs.is_revoked("jti-old"), "expired revocations fall away"
+
+
+def test_logout_revokes_token(tmp_path):
+    async def go():
+        plat = Platform(data_dir=tmp_path / "data", capacity=256, dim=1024)
+        app = make_dashboard_app(platform=plat, db_path=tmp_path / "dash.db", model=StubRuntime())
+        client = await _client(app)
+        try:
+            r = await client.post(
+                "/login",
+                data={"email": "admin@local", "password": "admin123", "next": "/"},
+                allow_redirects=False,
+            )
+            assert r.status == 302
+            token = client.session.cookie_jar.filter_cookies(client.make_url("/"))[
+                "kakveda_token"
+            ].value
+
+            r = await client.get("/", allow_redirects=False)
+            assert r.status == 200
+
+            await client.post("/logout", allow_redirects=False)
+            # Replay the captured (stolen) token: must no longer authenticate.
+            r = await client.get(
+                "/", headers={"Cookie": f"kakveda_token={token}"}, allow_redirects=False
+            )
+            assert r.status == 302 and "/login" in r.headers["Location"]
+        finally:
+            await client.close()
+
+    run(go())
+
+
+async def _client(app):
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    return client
+
+
+def test_otel_disabled_by_default(tmp_path, monkeypatch):
+    monkeypatch.setattr(otel, "_setup_done", False)
+    monkeypatch.setattr(otel, "_tracer", None)
+    monkeypatch.delenv("KAKVEDA_OTEL_ENABLED", raising=False)
+    assert otel.setup_otel("test") is False
+    assert otel.get_tracer() is None
+
+
+def test_otel_enabled_creates_tracer(monkeypatch):
+    monkeypatch.setattr(otel, "_setup_done", False)
+    monkeypatch.setattr(otel, "_tracer", None)
+    monkeypatch.setenv("KAKVEDA_OTEL_ENABLED", "1")
+    ok = otel.setup_otel("test")
+    try:
+        import opentelemetry.sdk  # noqa: F401
+
+        assert ok is True and otel.get_tracer() is not None
+    except ImportError:
+        # SDK absent: enabling must degrade to a no-op, never crash.
+        assert ok is False and otel.get_tracer() is None
+    # middleware wraps a handler without breaking it
+    from aiohttp import web
+
+    async def go():
+        app = web.Application(middlewares=[otel.otel_middleware()])
+
+        async def hello(request):
+            return web.json_response({"ok": True})
+
+        app.router.add_get("/", hello)
+        client = await _client(app)
+        try:
+            r = await client.get("/")
+            assert r.status == 200 and (await r.json())["ok"]
+        finally:
+            await client.close()
+
+    run(go())
+    monkeypatch.setattr(otel, "_setup_done", False)
+    monkeypatch.setattr(otel, "_tracer", None)
