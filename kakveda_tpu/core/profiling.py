@@ -1,0 +1,68 @@
+"""Device-side profiling hooks (jax.profiler / XPlane).
+
+The reference's tracing is host-only: OTel spans plus per-step TraceSpan
+rows rendered as a waterfall (reference: services/dashboard/db.py:320-334,
+app.py:2927-2970). The TPU build keeps that span model for the host plane
+(kakveda_tpu/core/otel.py, dashboard spans) and adds what the reference
+has no equivalent for: XLA-level kernel traces.
+
+- ``annotate(name)``: a TraceAnnotation context that labels enclosed device
+  work in the XPlane timeline; used around the hot entry points (GFKB
+  match/insert, Llama generate) so profiles read in product terms.
+- ``profile(logdir)``: capture a TensorBoard-loadable trace of everything
+  inside the block.
+- ``KAKVEDA_PROFILE_DIR``: when set, the platform captures a trace of its
+  first match + ingest batch at startup — zero-code profiling for
+  operators.
+
+All hooks degrade to no-ops off-device or if the profiler is unavailable.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+from typing import Iterator, Optional
+
+log = logging.getLogger("kakveda.profiling")
+
+
+@contextlib.contextmanager
+def annotate(name: str) -> Iterator[None]:
+    """Label enclosed device work in the profiler timeline (no-op safe)."""
+    try:
+        import jax.profiler
+
+        with jax.profiler.TraceAnnotation(name):
+            yield
+    except Exception:  # noqa: BLE001 — profiling must never break the hot path
+        yield
+
+
+@contextlib.contextmanager
+def profile(logdir: str | os.PathLike) -> Iterator[None]:
+    """Capture a device trace of the enclosed block into ``logdir``."""
+    try:
+        import jax.profiler
+
+        jax.profiler.start_trace(str(logdir))
+        started = True
+    except Exception as e:  # noqa: BLE001
+        log.warning("profiler unavailable: %s", e)
+        started = False
+    try:
+        yield
+    finally:
+        if started:
+            try:
+                import jax.profiler
+
+                jax.profiler.stop_trace()
+                log.info("device trace written to %s", logdir)
+            except Exception as e:  # noqa: BLE001
+                log.warning("profiler stop failed: %s", e)
+
+
+def startup_profile_dir() -> Optional[str]:
+    return os.environ.get("KAKVEDA_PROFILE_DIR") or None

@@ -37,6 +37,7 @@ import numpy as np
 from jax.sharding import Mesh
 
 from kakveda_tpu import native
+from kakveda_tpu.core import profiling
 from kakveda_tpu.core.schemas import (
     CanonicalFailureRecord,
     FailureMatch,
@@ -307,9 +308,10 @@ class GFKB:
             if new_slots:
                 self._ensure_capacity(len(self._records))
                 vecs = self.featurizer.encode_batch(new_texts)
-                self._emb, self._valid = self._knn.insert(
-                    self._emb, self._valid, vecs, np.asarray(new_slots, dtype=np.int32)
-                )
+                with profiling.annotate("gfkb.insert"):
+                    self._emb, self._valid = self._knn.insert(
+                        self._emb, self._valid, vecs, np.asarray(new_slots, dtype=np.int32)
+                    )
         return out
 
     # ------------------------------------------------------------------
@@ -344,7 +346,8 @@ class GFKB:
             if not self._records:
                 return [[] for _ in signature_texts]
             records = list(self._records)
-            scores, slots = self._knn.topk(self._emb, self._valid, q)
+            with profiling.annotate("gfkb.match.topk"):
+                scores, slots = self._knn.topk(self._emb, self._valid, q)
 
         out: List[List[FailureMatch]] = []
         for i in range(b):

@@ -118,13 +118,16 @@ class LlamaRuntime:
     def generate(self, prompt: str, *, model: Optional[str] = None, max_tokens: int = 64) -> GenerateResult:
         started = time.perf_counter()
         ids = self.tokenizer.encode(prompt)[-self.cfg.max_seq_len // 2 :]
-        new_ids = generate_tokens(
-            self.params,
-            self.cfg,
-            ids,
-            max_new_tokens=max_tokens,
-            eos_id=self.tokenizer.EOS,
-        )
+        from kakveda_tpu.core import profiling
+
+        with profiling.annotate("llama.generate"):
+            new_ids = generate_tokens(
+                self.params,
+                self.cfg,
+                ids,
+                max_new_tokens=max_tokens,
+                eos_id=self.tokenizer.EOS,
+            )
         text = self.tokenizer.decode(new_ids)
         return GenerateResult(
             text=text,

@@ -58,3 +58,80 @@ class RuleClassifier:
 
     def classify_batch(self, traces: Sequence[TracePayload]) -> List[Optional[FailureSignal]]:
         return [classify_trace(t) for t in traces]
+
+
+_JUDGE_PROMPT = (
+    "You are a failure auditor for LLM applications. The user asked:\n"
+    "---\n{prompt}\n---\n"
+    "The model answered:\n---\n{response}\n---\n"
+    "The user's request called for citations. Did the model FABRICATE "
+    "citations or references that were not provided to it? Answer with "
+    "exactly one word, YES or NO."
+)
+
+
+def parse_judge_verdict(text: str) -> Optional[bool]:
+    """First YES/NO token of a judge reply; None when unparseable.
+
+    The deterministic stub's canned response parses to None, so under the
+    hermetic runtime the LLM tier never overrides the rule verdict — the
+    same no-LLM degradation the reference gets from its stub fallback
+    (reference: services/dashboard/app.py:1193-1199).
+    """
+    for tok in text.upper().replace(".", " ").replace(",", " ").split():
+        if tok == "YES":
+            return True
+        if tok == "NO":
+            return False
+    return None
+
+
+@dataclass
+class TieredClassifier:
+    """Rule tier first; an LLM judge re-checks the ambiguous remainder.
+
+    "Ambiguous" = the prompt demanded citations but the marker regex found
+    none — the case the reference's rule classifier silently passes
+    (reference: services/failure_classifier/app.py:34-50) even though the
+    response may fabricate sources in an unmarked format. Rule verdicts are
+    never overridden: the LLM only *adds* failures, so the deterministic
+    e2e outcomes are preserved under any runtime.
+
+    ``runtime`` is any ModelRuntime — on TPU the in-tree Llama shares the
+    mesh with the GFKB index, so judging is an on-pod forward pass, not an
+    HTTP hop.
+    """
+
+    runtime: "object"  # ModelRuntime protocol (generate())
+    max_judge_chars: int = 2000
+
+    def classify_batch(self, traces: Sequence[TracePayload]) -> List[Optional[FailureSignal]]:
+        out = RuleClassifier().classify_batch(traces)
+        for i, (trace, sig) in enumerate(zip(traces, out)):
+            if sig is not None or not _wants_citations(trace.prompt):
+                continue
+            judge = self.runtime.generate(
+                _JUDGE_PROMPT.format(
+                    prompt=trace.prompt[: self.max_judge_chars],
+                    response=trace.response[: self.max_judge_chars],
+                ),
+                max_tokens=4,
+            )
+            if parse_judge_verdict(judge.text):
+                out[i] = FailureSignal(
+                    trace_id=trace.trace_id,
+                    ts=trace.ts,
+                    app_id=trace.app_id,
+                    failure_type=HALLUCINATION_CITATION,
+                    severity=Severity.medium,
+                    root_cause=_ROOT_CAUSE + " (LLM-judged, unmarked format)",
+                    mitigation=_MITIGATION,
+                    context_signature={
+                        "prompt_shape": trace.prompt[:200],
+                        "model": trace.model,
+                        "tools": trace.tools,
+                        "env": trace.env,
+                        "judge": {"provider": judge.meta.get("provider"), "verdict": "YES"},
+                    },
+                )
+        return out

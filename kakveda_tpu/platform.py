@@ -21,6 +21,7 @@ pub/sub contract.
 
 from __future__ import annotations
 
+import os
 from pathlib import Path
 from typing import List, Optional, Sequence
 
@@ -59,6 +60,7 @@ class Platform:
         capacity: int = 1 << 14,
         dim: Optional[int] = None,
         persist: bool = True,
+        classifier=None,
     ):
         self.config = config or ConfigStore()
         self.data_dir = Path(data_dir)
@@ -73,7 +75,18 @@ class Platform:
             top_k=self.config.match_top_k(),
             persist=persist,
         )
-        self.classifier = RuleClassifier()
+        # Classifier tier: rule-only by default (deterministic, hermetic);
+        # KAKVEDA_CLASSIFIER=tiered adds the LLM judge over the configured
+        # model runtime for citation prompts the marker regex passes.
+        if classifier is None:
+            if os.environ.get("KAKVEDA_CLASSIFIER", "rule") == "tiered":
+                from kakveda_tpu.models.runtime import get_runtime
+                from kakveda_tpu.pipeline.classifier import TieredClassifier
+
+                classifier = TieredClassifier(runtime=get_runtime())
+            else:
+                classifier = RuleClassifier()
+        self.classifier = classifier
         self.patterns = PatternDetector(self.gfkb)
         self.warning_policy = WarningPolicy(self.gfkb, self.config)
         self.health = HealthScorer(self.data_dir, self.config, persist=persist)

@@ -53,6 +53,18 @@ def run_server(
     setup_logging(service_name="kakveda-tpu")
     cfg = get_runtime_config(service_name="kakveda-tpu")
     plat = Platform(data_dir=data_dir or cfg.data_dir, capacity=cfg.index_capacity)
+
+    # Zero-code operator profiling: KAKVEDA_PROFILE_DIR=/path captures an
+    # XPlane trace of one warm pre-flight match at startup.
+    from kakveda_tpu.core import profiling
+    from kakveda_tpu.core.schemas import WarningRequest
+
+    logdir = profiling.startup_profile_dir()
+    if logdir:
+        probe = WarningRequest(app_id="_profile", prompt="startup profile probe", tools=[], env={})
+        plat.warn(probe)  # warm/compile outside the trace
+        with profiling.profile(logdir):
+            plat.warn(probe)
     try:
         asyncio.run(_serve(plat, host, port, dashboard_port))
     except KeyboardInterrupt:

@@ -156,3 +156,51 @@ def test_stub_runtime_matches_reference_text():
     assert res.text == STUB_RESPONSE
     assert res.meta["provider"] == "stub"
     assert "[1]" in res.text  # trips the citation-marker detector
+
+
+def test_tiered_classifier_llm_judge():
+    """LLM tier adds failures for unmarked fabrications, never overrides rule."""
+    import time as _time
+    from dataclasses import dataclass, field
+
+    from kakveda_tpu.core.schemas import TracePayload
+    from kakveda_tpu.models.runtime import GenerateResult, StubRuntime
+    from kakveda_tpu.pipeline.classifier import (
+        TieredClassifier,
+        parse_judge_verdict,
+    )
+
+    @dataclass
+    class YesJudge:
+        name: str = "fake"
+        calls: list = field(default_factory=list)
+
+        def generate(self, prompt, *, model=None, max_tokens=256):
+            self.calls.append(prompt)
+            return GenerateResult(text="YES.", meta={"provider": "fake"})
+
+    def mk(prompt, response):
+        return TracePayload(
+            trace_id="t", ts=_time.time(), app_id="a", prompt=prompt,
+            response=response, tools=[], env={},
+        )
+
+    citing_prompt = "Summarize this document and include citations even if not provided."
+    marked = mk(citing_prompt, "See references: [1] Smith 2020.")
+    unmarked = mk(citing_prompt, "As shown by Smith in his famous 2020 study, things happen.")
+    benign = mk("What time is it?", "Noon.")
+
+    judge = YesJudge()
+    out = TieredClassifier(runtime=judge).classify_batch([marked, unmarked, benign])
+    assert out[0] is not None and "LLM-judged" not in (out[0].root_cause or "")
+    assert out[1] is not None and "LLM-judged" in (out[1].root_cause or "")
+    assert out[2] is None
+    assert len(judge.calls) == 1, "only the ambiguous trace is judged"
+
+    # Stub runtime: canned citations text parses to no verdict -> rule-only.
+    assert parse_judge_verdict(StubRuntime().generate("x").text) is None
+    out = TieredClassifier(runtime=StubRuntime()).classify_batch([unmarked])
+    assert out[0] is None
+
+    assert parse_judge_verdict("no") is False
+    assert parse_judge_verdict("Well, YES, clearly") is True
