@@ -1,0 +1,72 @@
+"""Import-path compatibility alias: ``kakveda.*`` → ``kakveda_tpu.*``.
+
+Capability parity with the reference's root-level alias package
+(reference: shared/__init__.py:1-6, which re-exports services.shared.* as
+shared.* so deployment images and test paths resolve either way), done
+properly for a whole package tree: a meta-path finder resolves any
+``kakveda.X.Y`` import to the *same module object* as ``kakveda_tpu.X.Y``,
+so classes, singletons, and module state are never duplicated between the
+two spellings.
+"""
+
+from __future__ import annotations
+
+import importlib
+import importlib.abc
+import importlib.util
+import sys
+import types
+
+_TARGET = "kakveda_tpu"
+
+_pkg = importlib.import_module(_TARGET)
+__version__ = getattr(_pkg, "__version__", "0")
+
+
+class _AliasLoader(importlib.abc.Loader):
+    """Hands the already-imported real module back to the import machinery."""
+
+    _KEEP = ("__name__", "__spec__", "__loader__", "__package__")
+
+    def __init__(self, module: types.ModuleType):
+        self._module = module
+        self._saved = {k: getattr(module, k, None) for k in self._KEEP}
+
+    def create_module(self, spec):
+        return self._module
+
+    def exec_module(self, module):
+        # The machinery re-stamps __name__/__spec__/… with the alias spec in
+        # module_from_spec; restore the real identity so tooling that reads
+        # module metadata (pickling, repr, importlib.reload) is unaffected.
+        for key, value in self._saved.items():
+            if value is not None:
+                setattr(module, key, value)
+
+
+class _AliasFinder(importlib.abc.MetaPathFinder):
+    def find_spec(self, fullname, path=None, target=None):
+        if not fullname.startswith(__name__ + "."):
+            return None
+        real_name = _TARGET + fullname[len(__name__):]
+        try:
+            module = importlib.import_module(real_name)
+        except ModuleNotFoundError:
+            return None
+        return importlib.util.spec_from_loader(fullname, _AliasLoader(module))
+
+
+# Idempotent under re-import of this package.
+if not any(isinstance(f, _AliasFinder) for f in sys.meta_path):
+    sys.meta_path.insert(0, _AliasFinder())
+
+
+def __getattr__(name: str):
+    value = getattr(_pkg, name, None)
+    if value is not None:
+        return value
+    try:
+        return importlib.import_module(f"{__name__}.{name}")
+    except ModuleNotFoundError:
+        # hasattr()/getattr-with-default probes must see AttributeError.
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}") from None

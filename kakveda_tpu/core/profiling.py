@@ -31,13 +31,27 @@ log = logging.getLogger("kakveda.profiling")
 @contextlib.contextmanager
 def annotate(name: str) -> Iterator[None]:
     """Label enclosed device work in the profiler timeline (no-op safe)."""
+    # Only the profiler setup is guarded — the yield must stay outside the
+    # try/except, or an exception raised by the *enclosed work* would be
+    # thrown into the generator, caught here, and surface as contextlib's
+    # "generator didn't stop after throw()" RuntimeError with the real
+    # error destroyed.
+    annotation = None
     try:
         import jax.profiler
 
-        with jax.profiler.TraceAnnotation(name):
-            yield
+        annotation = jax.profiler.TraceAnnotation(name)
+        annotation.__enter__()
     except Exception:  # noqa: BLE001 — profiling must never break the hot path
+        annotation = None
+    try:
         yield
+    finally:
+        if annotation is not None:
+            try:
+                annotation.__exit__(None, None, None)
+            except Exception:  # noqa: BLE001
+                pass
 
 
 @contextlib.contextmanager

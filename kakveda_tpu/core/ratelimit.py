@@ -1,0 +1,61 @@
+"""Fixed-window rate limiter with a distributed (Redis) tier.
+
+Capability parity with the reference's RateLimiter (reference:
+services/shared/redis_helpers.py:62-84): INCR + EXPIRE on a per-window key
+when ``KAKVEDA_REDIS_URL`` points at a reachable Redis, else an in-memory
+fixed-window counter. The in-memory tier sweeps expired windows so keys
+derived from client IPs on unauthenticated routes cannot grow unboundedly.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Dict, Optional, Tuple
+
+
+class RateLimiter:
+    _SWEEP_EVERY = 1024
+
+    def __init__(self, redis_url: Optional[str] = None):
+        self._hits: Dict[str, Tuple[float, int]] = {}
+        self._calls = 0
+        self._redis = None
+        url = redis_url or os.environ.get("KAKVEDA_REDIS_URL")
+        if url:
+            try:
+                import redis  # type: ignore[import-not-found]
+
+                # Sub-second timeout: allow() runs synchronously on request
+                # paths (including inside an event loop), so a slow Redis
+                # must cost milliseconds, not seconds.
+                self._redis = redis.Redis.from_url(
+                    url, socket_timeout=0.25, socket_connect_timeout=0.25
+                )
+                self._redis.ping()
+            except Exception:  # noqa: BLE001 — fall back to memory
+                self._redis = None
+
+    def allow(self, key: str, limit: int, window_s: float = 60.0) -> bool:
+        if self._redis is not None:
+            try:
+                window = int(time.time() // window_s)
+                rkey = f"kakveda:rl:{key}:{window}"
+                count = self._redis.incr(rkey)
+                if count == 1:
+                    self._redis.expire(rkey, int(window_s) + 1)
+                return int(count) <= limit
+            except Exception:  # noqa: BLE001 — degrade to memory permanently:
+                # a dead Redis must not tax every subsequent request with a
+                # connect timeout for the life of the process.
+                self._redis = None
+        now = time.time()
+        self._calls += 1
+        if self._calls % self._SWEEP_EVERY == 0:
+            self._hits = {k: v for k, v in self._hits.items() if now - v[0] < window_s}
+        start, count = self._hits.get(key, (now, 0))
+        if now - start >= window_s:
+            start, count = now, 0
+        count += 1
+        self._hits[key] = (start, count)
+        return count <= limit

@@ -3,14 +3,14 @@
 from __future__ import annotations
 
 import logging
-import time
 from dataclasses import dataclass, field
 from pathlib import Path
-from typing import Any, Dict, List, Optional
+from typing import Any, List, Optional
 
 import jinja2
 from aiohttp import web
 
+from kakveda_tpu.core.ratelimit import RateLimiter
 from kakveda_tpu.core.revocation import RevocationStore
 from kakveda_tpu.core.runtime import get_runtime_config
 from kakveda_tpu.dashboard import auth as auth_lib
@@ -167,33 +167,5 @@ async def security_headers_middleware(request: web.Request, handler):
 
 
 # --- shared rate limiter ---------------------------------------------------
-
-
-class RateLimiter:
-    """Fixed-window in-memory limiter
-    (reference: services/shared/redis_helpers.py:62-84, in-memory tier)."""
-
-    # Keys include client IPs on unauthenticated routes, so expired windows
-    # must actually be evicted or a scan from many IPs leaks memory.
-    _SWEEP_EVERY = 1024
-
-    def __init__(self):
-        self._hits: Dict[str, tuple[float, int]] = {}
-        self._calls = 0
-
-    def allow(self, key: str, limit: int, window_s: float = 60.0) -> bool:
-        now = time.time()
-        self._calls += 1
-        if self._calls % self._SWEEP_EVERY == 0:
-            self._hits = {
-                k: v for k, v in self._hits.items() if now - v[0] < window_s
-            }
-        start, count = self._hits.get(key, (now, 0))
-        if now - start >= window_s:
-            start, count = now, 0
-        count += 1
-        self._hits[key] = (start, count)
-        return count <= limit
-
 
 RATE_LIMITER = RateLimiter()

@@ -254,6 +254,13 @@ def setup(app: web.Application) -> None:
             resp.del_cookie(PROJECT_COOKIE)
         raise resp
 
+    @require_login
+    async def project_clear(request):
+        """Drop the active-project cookie (reference: app.py:1436-1486)."""
+        resp = web.HTTPFound("/projects")
+        resp.del_cookie(PROJECT_COOKIE)
+        raise resp
+
     @require_roles("admin", "operator")
     async def project_api_key(request):
         """Mint an API key: shown once, stored as sha256
@@ -384,6 +391,7 @@ def setup(app: web.Application) -> None:
             web.get("/projects", projects_page),
             web.post("/projects/create", project_create),
             web.post("/projects/select", project_select),
+            web.post("/projects/clear", project_clear),
             web.post("/projects/api-key", project_api_key),
             web.post("/api/ingest/run", api_ingest_run),
         ]

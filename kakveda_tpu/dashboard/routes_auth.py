@@ -10,6 +10,7 @@ from aiohttp import web
 
 from kakveda_tpu.core.runtime import get_runtime_config
 from kakveda_tpu.dashboard import auth as auth_lib
+from kakveda_tpu.dashboard import email as email_lib
 from kakveda_tpu.dashboard.core import COOKIE_NAME, CTX_KEY, RATE_LIMITER, VIEW_AS_COOKIE
 from kakveda_tpu.dashboard.routes_main import off_loop
 
@@ -122,13 +123,22 @@ def setup(app: web.Application) -> None:
                 "INSERT INTO password_reset_tokens (token, user_id, expires_at) VALUES (?,?,?)",
                 (token, row["id"], time.time() + 3600),
             )
-            # Demo mode shows the link inline; in production that would hand
-            # any account's reset token to an anonymous requester, so the
-            # link is only disclosed outside production (SMTP delivery plugs
-            # in here — reference: services/dashboard/app.py:2585-2642).
-            if get_runtime_config(service_name="dashboard").env != "production":
-                reset_link = f"/reset?token={token}"
-            ctx.db.audit(email, "forgot.requested")
+            # SMTP delivery when configured (reference:
+            # services/dashboard/app.py:2585-2642); otherwise demo mode shows
+            # the link inline — but never in production, where that would
+            # hand any account's reset token to an anonymous requester.
+            link = f"/reset?token={token}"
+            sent = False
+            if email_lib.smtp_configured():
+                sent = await off_loop(
+                    email_lib.send_email,
+                    email,
+                    "Password reset",
+                    f"Reset your password: {link}\nThis link expires in 1 hour.",
+                )
+            if not sent and get_runtime_config(service_name="dashboard").env != "production":
+                reset_link = link
+            ctx.db.audit(email, "forgot.requested", {"emailed": sent})
         return ctx.render(request, "forgot.html", sent=True, reset_link=reset_link)
 
     async def reset_page(request):

@@ -38,6 +38,23 @@ def _decode_jit(params, cfg: LlamaConfig, tokens, cache):
     return decode_step(params, cfg, tokens, cache)
 
 
+@jax.jit
+def _sample_top_p(rng, logits, temperature, top_p):
+    """Nucleus sampling: keep the smallest prefix of the probability-sorted
+    vocab whose mass reaches ``top_p``, renormalize, sample. Runs entirely
+    on device with fixed shapes so the decode loop stays retrace-free."""
+    scaled = logits / temperature
+    sorted_logits = jnp.sort(scaled, axis=-1)[:, ::-1]
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # A token survives if the mass *before* it is < top_p (the first token
+    # always survives even when its own probability exceeds top_p).
+    keep_sorted = (cum - probs) < top_p
+    cutoff = jnp.min(jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1, keepdims=True)
+    masked = jnp.where(scaled >= cutoff, scaled, -jnp.inf)
+    return jax.random.categorical(rng, masked, axis=-1)
+
+
 def generate_tokens(
     params: Params,
     cfg: LlamaConfig,
@@ -45,6 +62,7 @@ def generate_tokens(
     *,
     max_new_tokens: int = 64,
     temperature: float = 0.0,
+    top_p: float = 1.0,
     rng: Optional[jax.Array] = None,
     eos_id: Optional[int] = None,
     max_len: Optional[int] = None,
@@ -73,7 +91,10 @@ def generate_tokens(
     for _ in range(max_new_tokens):
         if temperature > 0.0:
             rng, sub = jax.random.split(rng)
-            nxt = jax.random.categorical(sub, last / temperature, axis=-1)
+            if top_p < 1.0:
+                nxt = _sample_top_p(sub, last, temperature, top_p)
+            else:
+                nxt = jax.random.categorical(sub, last / temperature, axis=-1)
         else:
             nxt = jnp.argmax(last, axis=-1)
         tok = int(nxt[0])

@@ -63,3 +63,37 @@ def test_ensure_request_id():
     rid = ensure_request_id(None)
     assert len(rid) == 32
     assert ensure_request_id("x" * 500) == "x" * 128
+
+
+def test_rate_limiter_fixed_window():
+    from kakveda_tpu.core.ratelimit import RateLimiter
+
+    rl = RateLimiter(redis_url=None)
+    key = "t:1"
+    assert all(rl.allow(key, limit=3) for _ in range(3))
+    assert not rl.allow(key, limit=3)
+    # distinct keys are independent windows
+    assert rl.allow("t:2", limit=3)
+
+
+def test_alias_package_resolves_to_kakveda_tpu():
+    import kakveda
+    import kakveda_tpu
+    import kakveda_tpu.core
+
+    # attribute access and deep imports are identity-preserving: the alias
+    # meta-path finder hands back the same module objects, never duplicates
+    assert kakveda.core is kakveda_tpu.core
+    import kakveda.core.schemas as alias_schemas
+    import kakveda_tpu.core.schemas as real_schemas
+
+    assert alias_schemas is real_schemas
+    assert alias_schemas.WarningRequest is real_schemas.WarningRequest
+    # real module metadata survives the aliasing
+    assert real_schemas.__name__ == "kakveda_tpu.core.schemas"
+    from kakveda.core.fingerprint import fingerprint as fp_alias
+    from kakveda_tpu.core.fingerprint import fingerprint as fp_real
+
+    assert fp_alias is fp_real
+    # missing attributes probe cleanly
+    assert getattr(kakveda, "does_not_exist", None) is None

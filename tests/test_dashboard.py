@@ -436,3 +436,51 @@ def test_forgot_hides_reset_link_in_production(tmp_path, monkeypatch):
             await client.close()
 
     run(go())
+
+
+def test_project_clear_cookie(tmp_path):
+    async def go():
+        client = await _client(_mk_app(tmp_path))
+        try:
+            await _login(client)
+            await client.post("/projects/create", data={"name": "p1"})
+            r = await client.post(
+                "/projects/select", data={"project_id": "1"}, allow_redirects=False
+            )
+            assert r.status == 302
+            r = await client.post("/projects/clear", allow_redirects=False)
+            assert r.status == 302
+            # cleared cookie arrives as an expired Set-Cookie
+            sc = r.headers.getall("Set-Cookie", [])
+            assert any("kakveda_project" in c or "project" in c for c in sc)
+        finally:
+            await client.close()
+
+    run(go())
+
+
+def test_forgot_sends_email_when_smtp_configured(tmp_path, monkeypatch):
+    sent = {}
+
+    def fake_send(to, subject, body):
+        sent["to"], sent["subject"], sent["body"] = to, subject, body
+        return True
+
+    async def go():
+        monkeypatch.setenv("SMTP_HOST", "smtp.example.com")
+        monkeypatch.setenv("SMTP_USER", "mailer")
+        from kakveda_tpu.dashboard import email as email_lib
+
+        monkeypatch.setattr(email_lib, "send_email", fake_send)
+        client = await _client(_mk_app(tmp_path))
+        try:
+            r = await client.post("/forgot", data={"email": "admin@local"})
+            body = await r.text()
+            # delivered by email: the inline demo link is suppressed
+            assert "token=" not in body
+            assert sent["to"] == "admin@local"
+            assert "/reset?token=" in sent["body"]
+        finally:
+            await client.close()
+
+    run(go())

@@ -152,3 +152,25 @@ def test_byte_tokenizer_roundtrip():
     assert ids[0] == tok.BOS and ids[-1] == tok.EOS
     assert tok.decode(ids) == s
     assert max(ids) < tok.vocab_size
+
+
+def test_generate_top_p_sampling():
+    import jax
+
+    from kakveda_tpu.models.generate import generate_tokens
+    from kakveda_tpu.models.llama import init_params
+
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    ids = generate_tokens(
+        params, CFG, [5, 6, 7], max_new_tokens=8, temperature=0.8, top_p=0.9,
+        rng=jax.random.PRNGKey(1),
+    )
+    assert 0 < len(ids) <= 8
+    assert all(0 <= t < CFG.vocab_size for t in ids)
+    # top_p=tiny keeps only the argmax nucleus → matches greedy
+    greedy = generate_tokens(params, CFG, [5, 6, 7], max_new_tokens=8, temperature=0.0)
+    nucleus = generate_tokens(
+        params, CFG, [5, 6, 7], max_new_tokens=8, temperature=0.5, top_p=1e-6,
+        rng=jax.random.PRNGKey(2),
+    )
+    assert nucleus == greedy
