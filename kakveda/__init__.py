@@ -61,9 +61,12 @@ if not any(isinstance(f, _AliasFinder) for f in sys.meta_path):
     sys.meta_path.insert(0, _AliasFinder())
 
 
+_MISSING = object()
+
+
 def __getattr__(name: str):
-    value = getattr(_pkg, name, None)
-    if value is not None:
+    value = getattr(_pkg, name, _MISSING)
+    if value is not _MISSING:  # None-valued attributes are real (optional deps)
         return value
     try:
         return importlib.import_module(f"{__name__}.{name}")

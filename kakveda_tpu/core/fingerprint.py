@@ -17,6 +17,7 @@ from __future__ import annotations
 import hashlib
 import re
 from dataclasses import dataclass
+from functools import lru_cache
 from typing import Any, Dict, Iterable, List
 
 _WS_RE = re.compile(r"\s+")
@@ -56,6 +57,20 @@ def prompt_intent_tags(prompt: str) -> List[str]:
     Tag vocabulary matches the reference exactly
     (reference: services/shared/fingerprint.py:22-48).
     """
+    # Cache only prompts of bounded size: the entry count is capped but the
+    # keys are untrusted strings, and 64k × multi-KB prompts would pin
+    # gigabytes for the process lifetime.
+    if len(prompt) > _TAG_CACHE_MAX_PROMPT_LEN:
+        return list(_intent_tags_compute(prompt))
+    return list(_intent_tags_cached(prompt))
+
+
+_TAG_CACHE_MAX_PROMPT_LEN = 2048
+
+
+# The streaming path tags every prompt twice (classifier + signature_text);
+# the cache collapses that, and repeated prompts in production hit it too.
+def _intent_tags_compute(prompt: str) -> tuple:
     p = normalize_prompt(prompt)
     tags: List[str] = []
 
@@ -73,7 +88,10 @@ def prompt_intent_tags(prompt: str) -> List[str]:
     if "include" in p and wants_citations:
         tags.append("instruction:include_references")
 
-    return sorted(set(tags))
+    return tuple(sorted(set(tags)))
+
+
+_intent_tags_cached = lru_cache(maxsize=65536)(_intent_tags_compute)
 
 
 def signature_text(prompt: str, tools: Iterable[str], env: Dict[str, Any]) -> str:

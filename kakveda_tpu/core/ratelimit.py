@@ -5,45 +5,66 @@ services/shared/redis_helpers.py:62-84): INCR + EXPIRE on a per-window key
 when ``KAKVEDA_REDIS_URL`` points at a reachable Redis, else an in-memory
 fixed-window counter. The in-memory tier sweeps expired windows so keys
 derived from client IPs on unauthenticated routes cannot grow unboundedly.
+
+Async callers (aiohttp handlers) must use :meth:`allow_async`, which runs
+the Redis round-trips in the executor — the sync client must never block
+the event loop. Connection setup is lazy so constructing the limiter at
+module import costs nothing.
 """
 
 from __future__ import annotations
 
+import asyncio
 import os
 import time
 from typing import Dict, Optional, Tuple
+
+_UNSET = object()
 
 
 class RateLimiter:
     _SWEEP_EVERY = 1024
 
-    def __init__(self, redis_url: Optional[str] = None):
+    def __init__(self, redis_url: object = _UNSET):
         self._hits: Dict[str, Tuple[float, int]] = {}
         self._calls = 0
         self._redis = None
-        url = redis_url or os.environ.get("KAKVEDA_REDIS_URL")
-        if url:
-            try:
-                import redis  # type: ignore[import-not-found]
+        # Explicit redis_url=None opts out of Redis even when the env var is
+        # set (tests and deliberately-local limiters need that).
+        if redis_url is _UNSET:
+            self._url: Optional[str] = os.environ.get("KAKVEDA_REDIS_URL")
+        else:
+            self._url = redis_url  # type: ignore[assignment]
+        self._connect_attempted = False
 
-                # Sub-second timeout: allow() runs synchronously on request
-                # paths (including inside an event loop), so a slow Redis
-                # must cost milliseconds, not seconds.
-                self._redis = redis.Redis.from_url(
-                    url, socket_timeout=0.25, socket_connect_timeout=0.25
-                )
-                self._redis.ping()
-            except Exception:  # noqa: BLE001 — fall back to memory
-                self._redis = None
+    def _client(self):
+        if self._connect_attempted:
+            return self._redis
+        self._connect_attempted = True
+        if not self._url:
+            return None
+        try:
+            import redis  # type: ignore[import-not-found]
+
+            # Sub-second timeout: a slow Redis must cost milliseconds per
+            # miss, not seconds.
+            self._redis = redis.Redis.from_url(
+                self._url, socket_timeout=0.25, socket_connect_timeout=0.25
+            )
+            self._redis.ping()
+        except Exception:  # noqa: BLE001 — fall back to memory
+            self._redis = None
+        return self._redis
 
     def allow(self, key: str, limit: int, window_s: float = 60.0) -> bool:
-        if self._redis is not None:
+        client = self._client()
+        if client is not None:
             try:
                 window = int(time.time() // window_s)
                 rkey = f"kakveda:rl:{key}:{window}"
-                count = self._redis.incr(rkey)
+                count = client.incr(rkey)
                 if count == 1:
-                    self._redis.expire(rkey, int(window_s) + 1)
+                    client.expire(rkey, int(window_s) + 1)
                 return int(count) <= limit
             except Exception:  # noqa: BLE001 — degrade to memory permanently:
                 # a dead Redis must not tax every subsequent request with a
@@ -59,3 +80,12 @@ class RateLimiter:
         count += 1
         self._hits[key] = (start, count)
         return count <= limit
+
+    async def allow_async(self, key: str, limit: int, window_s: float = 60.0) -> bool:
+        """Event-loop-safe entry: Redis round trips (including the lazy
+        first connect) run in the executor; the pure in-memory tier is
+        answered inline."""
+        if self._url and not (self._connect_attempted and self._redis is None):
+            loop = asyncio.get_running_loop()
+            return await loop.run_in_executor(None, self.allow, key, limit, window_s)
+        return self.allow(key, limit, window_s)

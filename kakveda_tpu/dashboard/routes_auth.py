@@ -34,7 +34,7 @@ def setup(app: web.Application) -> None:
         return ctx.render(request, "login.html", error=None, next=request.query.get("next", "/"))
 
     async def login(request):
-        if not RATE_LIMITER.allow(_client_key(request, "login"), limit=20):
+        if not await RATE_LIMITER.allow_async(_client_key(request, "login"), limit=20):
             return ctx.render(request, "login.html", error="Too many attempts; slow down.", next="/")
         form = await request.post()
         email = str(form.get("email", "")).strip().lower()
@@ -82,7 +82,7 @@ def setup(app: web.Application) -> None:
         return ctx.render(request, "register.html", error=None)
 
     async def register(request):
-        if not RATE_LIMITER.allow(_client_key(request, "register"), limit=10):
+        if not await RATE_LIMITER.allow_async(_client_key(request, "register"), limit=10):
             return ctx.render(request, "register.html", error="Too many attempts; slow down.")
         form = await request.post()
         email = str(form.get("email", "")).strip().lower()
@@ -111,7 +111,7 @@ def setup(app: web.Application) -> None:
         return ctx.render(request, "forgot.html", sent=False, reset_link=None)
 
     async def forgot(request):
-        if not RATE_LIMITER.allow(_client_key(request, "forgot"), limit=5):
+        if not await RATE_LIMITER.allow_async(_client_key(request, "forgot"), limit=5):
             return ctx.render(request, "forgot.html", sent=True, reset_link=None)
         form = await request.post()
         email = str(form.get("email", "")).strip().lower()

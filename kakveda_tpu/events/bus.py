@@ -12,15 +12,21 @@ same HTTP POST contract the reference speaks. Device-side propagation
 kakveda_tpu.parallel.
 
 Improvements over the reference, deliberate: delivery results are reported
-(not silently swallowed), and local handlers are awaited with a timeout so
-one stuck consumer can't wedge the fan-out.
+(not silently swallowed), local handlers are awaited with a timeout so one
+stuck consumer can't wedge the fan-out, and HTTP subscriptions are durable
+— the reference loses every subscription when its bus container restarts
+(in-memory dict, event_bus/app.py:25; flagged as an ordering hazard at
+startup), whereas here URL subscriptions append to a JSONL log and are
+replayed on construction.
 """
 
 from __future__ import annotations
 
 import asyncio
+import json
 import logging
-from typing import Any, Awaitable, Callable, Dict, List, Union
+from pathlib import Path
+from typing import Any, Awaitable, Callable, Collection, Dict, List, Optional, Union
 
 log = logging.getLogger("kakveda.events")
 
@@ -35,31 +41,81 @@ class EventBus:
     """Topic → subscriber fan-out. Subscribers are async/sync callables or
     HTTP callback URLs (the reference's external contract)."""
 
-    def __init__(self, delivery_timeout: float = 3.0):
+    def __init__(
+        self,
+        delivery_timeout: float = 3.0,
+        persist_path: Optional[str | Path] = None,
+    ):
         self._subs: Dict[str, List[Union[Handler, str]]] = {}
         self.delivery_timeout = delivery_timeout
+        self._persist_path = Path(persist_path) if persist_path else None
+        if self._persist_path is not None:
+            self._replay_subscriptions()
+
+    # --- durable URL subscriptions -------------------------------------
+
+    def _replay_subscriptions(self) -> None:
+        path = self._persist_path
+        if path is None or not path.exists():
+            return
+        for line in path.read_text().splitlines():
+            if not line.strip():
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue  # torn tail write from a crashed process
+            topic, url = rec.get("topic"), rec.get("url")
+            if not topic or not url:
+                continue
+            subs = self._subs.setdefault(topic, [])
+            if rec.get("action") == "unsubscribe":
+                if url in subs:
+                    subs.remove(url)
+            elif url not in subs:
+                subs.append(url)
+
+    def _persist(self, action: str, topic: str, url: str) -> None:
+        if self._persist_path is None:
+            return
+        try:
+            self._persist_path.parent.mkdir(parents=True, exist_ok=True)
+            with self._persist_path.open("a") as f:
+                f.write(json.dumps({"action": action, "topic": topic, "url": url}) + "\n")
+        except OSError as e:
+            log.warning("subscription persist failed: %s", e)
 
     def subscribe(self, topic: str, handler: Union[Handler, str]) -> int:
         subs = self._subs.setdefault(topic, [])
         if handler not in subs:
             subs.append(handler)
+            if isinstance(handler, str):
+                self._persist("subscribe", topic, handler)
         return len(subs)
 
     def unsubscribe(self, topic: str, handler: Union[Handler, str]) -> None:
         subs = self._subs.get(topic, [])
         if handler in subs:
             subs.remove(handler)
+            if isinstance(handler, str):
+                self._persist("unsubscribe", topic, handler)
 
     def topics(self) -> Dict[str, int]:
         return {k: len(v) for k, v in self._subs.items()}
 
-    async def _deliver(self, sub: Union[Handler, str], event: dict) -> bool:
+    def has_subscribers(self, topic: str, exclude: Collection[Handler] = ()) -> bool:
+        return any(s not in exclude for s in self._subs.get(topic, []))
+
+    async def _deliver(self, sub: Union[Handler, str], event: dict, client=None) -> bool:
         try:
             if isinstance(sub, str):
-                import httpx
-
-                async with httpx.AsyncClient(timeout=self.delivery_timeout) as client:
+                if client is not None:
                     await client.post(sub, json=event)
+                else:
+                    import httpx
+
+                    async with httpx.AsyncClient(timeout=self.delivery_timeout) as c:
+                        await c.post(sub, json=event)
                 return True
             if asyncio.iscoroutinefunction(sub):
                 await asyncio.wait_for(sub(event), timeout=self.delivery_timeout)
@@ -77,13 +133,58 @@ class EventBus:
             log.warning("event delivery failed: %s -> %r: %s", type(e).__name__, sub, e)
             return False
 
-    async def publish(self, topic: str, event: dict) -> int:
-        """Fan out to all subscribers concurrently; returns delivered count."""
-        subs = list(self._subs.get(topic, []))
+    # Cap on simultaneous in-flight deliveries per publish call: a 512-trace
+    # ingest batch with a URL subscriber must not open hundreds of TCP
+    # connections in one gather (fd exhaustion surfaces as silently-dropped
+    # events).
+    MAX_CONCURRENT_DELIVERIES = 32
+
+    async def _fan_out(self, pairs: List[tuple]) -> int:
+        """Deliver (subscriber, event) pairs with bounded concurrency and one
+        shared pooled HTTP client for all URL deliveries."""
+        sem = asyncio.Semaphore(self.MAX_CONCURRENT_DELIVERIES)
+        needs_http = any(isinstance(s, str) for s, _ in pairs)
+        client = None
+        if needs_http:
+            import httpx
+
+            client = httpx.AsyncClient(
+                timeout=self.delivery_timeout,
+                limits=httpx.Limits(max_connections=self.MAX_CONCURRENT_DELIVERIES),
+            )
+
+        async def one(sub, event) -> bool:
+            async with sem:
+                return await self._deliver(sub, event, client=client)
+
+        try:
+            results = await asyncio.gather(*[one(s, e) for s, e in pairs])
+        finally:
+            if client is not None:
+                await client.aclose()
+        return sum(results)
+
+    async def publish(self, topic: str, event: dict, exclude: Collection[Handler] = ()) -> int:
+        """Fan out to all subscribers concurrently; returns delivered count.
+
+        ``exclude`` skips specific subscribers — used by the platform's
+        batched ingest, which invokes its internal reactors once per batch
+        directly and must not have them re-triggered per event.
+        """
+        subs = [s for s in self._subs.get(topic, []) if s not in exclude]
         if not subs:
             return 0
-        results = await asyncio.gather(*[self._deliver(s, event) for s in subs])
-        return sum(results)
+        return await self._fan_out([(s, event) for s in subs])
+
+    async def publish_many(
+        self, topic: str, events: List[dict], exclude: Collection[Handler] = ()
+    ) -> int:
+        """Publish a batch of events concurrently (bounded-concurrency
+        fan-out over all event×subscriber deliveries)."""
+        subs = [s for s in self._subs.get(topic, []) if s not in exclude]
+        if not subs or not events:
+            return 0
+        return await self._fan_out([(s, e) for e in events for s in subs])
 
     def publish_sync(self, topic: str, event: dict) -> int:
         """Publish from synchronous code (spins a private loop)."""

@@ -80,6 +80,11 @@ class GFKB:
         self._records: List[CanonicalFailureRecord] = []
         self._slot_by_key: Dict[Tuple[str, str], int] = {}
         self._patterns: Dict[str, PatternEntity] = {}  # name -> latest
+        # Per-type aggregates maintained incrementally at upsert so pattern
+        # detection reads them O(1) instead of rescanning every record per
+        # batch (O(N²) over a failure stream).
+        self._ids_by_type: Dict[str, List[str]] = {}
+        self._apps_by_type: Dict[str, set] = {}
         self._lock = threading.Lock()
         # Group-commit append logs (C++ writer when available): records are
         # buffered and flushed after each upsert batch instead of paying an
@@ -99,13 +104,18 @@ class GFKB:
         at the end of each public mutation (read-your-writes for external
         readers of the JSONL files, one syscall per batch instead of an
         open+write+close per record)."""
+        self._append_line(path, json.dumps(obj, ensure_ascii=False))
+
+    def _append_line(self, path: Path, line: str) -> None:
+        """Raw pre-serialized variant: the streaming path serializes with
+        pydantic's C serializer (model_dump_json) and skips the Python json
+        encoder entirely."""
         if not self.persist:
             return
         log = self._logs.get(path)
         if log is None:
             log = self._logs[path] = native.AppendLog(path)
-        line = json.dumps(obj, ensure_ascii=False) + "\n"
-        log.append(line.encode("utf-8"))
+        log.append((line + "\n").encode("utf-8"))
 
     def _flush_logs(self) -> None:
         for log in self._logs.values():
@@ -133,6 +143,11 @@ class GFKB:
             if order:
                 self._records = [latest[k] for k in order]
                 self._slot_by_key = {k: i for i, k in enumerate(order)}
+                for rec in self._records:
+                    self._ids_by_type.setdefault(rec.failure_type, []).append(rec.failure_id)
+                    self._apps_by_type.setdefault(rec.failure_type, set()).update(
+                        rec.affected_apps
+                    )
                 vecs = self.featurizer.encode_batch([latest[k].signature_text for k in order])
                 self._ensure_capacity(len(order))
                 slots = np.arange(len(order), dtype=np.int32)
@@ -160,6 +175,8 @@ class GFKB:
             self._records = []
             self._slot_by_key = {}
             self._patterns = {}
+            self._ids_by_type = {}
+            self._apps_by_type = {}
             if self.persist:
                 self._replay()
 
@@ -174,6 +191,16 @@ class GFKB:
     def list_failures(self) -> List[CanonicalFailureRecord]:
         with self._lock:
             return list(self._records)
+
+    def type_aggregate(self, failure_type: str) -> Tuple[List[str], List[str]]:
+        """(failure_ids in insertion order, sorted affected apps) for a type
+        — maintained incrementally so per-batch pattern detection never
+        rescans the record list."""
+        with self._lock:
+            return (
+                list(self._ids_by_type.get(failure_type, [])),
+                sorted(self._apps_by_type.get(failure_type, set())),
+            )
 
     def _ensure_capacity(self, needed: int) -> None:
         if needed <= self._knn.capacity:
@@ -233,6 +260,8 @@ class GFKB:
                 self._ensure_capacity(slot + 1)
                 self._records.append(rec)
                 self._slot_by_key[key] = slot
+                self._ids_by_type.setdefault(failure_type, []).append(rec.failure_id)
+                self._apps_by_type.setdefault(failure_type, set()).add(app_id)
                 vec = self.featurizer.encode_batch([signature_text])
                 self._emb, self._valid = self._knn.insert(
                     self._emb, self._valid, vec, np.asarray([slot], dtype=np.int32)
@@ -246,6 +275,7 @@ class GFKB:
                 rec.occurrences += 1
                 if app_id not in rec.affected_apps:
                     rec.affected_apps.append(app_id)
+                self._apps_by_type.setdefault(failure_type, set()).add(app_id)
                 rec.root_cause = root_cause or rec.root_cause
                 rec.resolution = resolution or rec.resolution
                 rec.context_signature = context_signature or rec.context_signature
@@ -270,7 +300,10 @@ class GFKB:
                 key = (item["failure_type"], item["signature_text"])
                 slot = self._slot_by_key.get(key)
                 if slot is None:
-                    rec = CanonicalFailureRecord(
+                    # model_construct: inputs are classifier-built and typed;
+                    # skipping validation keeps batch inserts off the pydantic
+                    # hot loop (single-record upsert_failure keeps validating).
+                    rec = CanonicalFailureRecord.model_construct(
                         failure_id=f"F-{len(self._records) + 1:04d}",
                         version=1,
                         created_at=now,
@@ -287,6 +320,8 @@ class GFKB:
                     slot = len(self._records)
                     self._records.append(rec)
                     self._slot_by_key[key] = slot
+                    self._ids_by_type.setdefault(rec.failure_type, []).append(rec.failure_id)
+                    self._apps_by_type.setdefault(rec.failure_type, set()).add(item["app_id"])
                     new_slots.append(slot)
                     new_texts.append(rec.signature_text)
                     out.append((rec, True))
@@ -298,12 +333,13 @@ class GFKB:
                     rec.occurrences += 1
                     if item["app_id"] not in rec.affected_apps:
                         rec.affected_apps.append(item["app_id"])
+                    self._apps_by_type.setdefault(rec.failure_type, set()).add(item["app_id"])
                     rec.root_cause = item.get("root_cause") or rec.root_cause
                     rec.resolution = item.get("resolution") or rec.resolution
                     rec.context_signature = item.get("context_signature") or rec.context_signature
                     self._records[slot] = rec
                     out.append((rec, False))
-                self._append_jsonl(self.failures_path, rec.model_dump(mode="json"))
+                self._append_line(self.failures_path, rec.model_dump_json())
             self._flush_logs()
             if new_slots:
                 self._ensure_capacity(len(self._records))

@@ -35,7 +35,9 @@ def classify_trace(trace: TracePayload) -> Optional[FailureSignal]:
     """Single-trace rule classification; None when the trace looks healthy."""
     if not (_wants_citations(trace.prompt) and detect_citation_markers(trace.response).has_citation_markers):
         return None
-    return FailureSignal(
+    # model_construct: every field comes straight off an already-validated
+    # TracePayload; skipping re-validation matters at streaming rates.
+    return FailureSignal.model_construct(
         trace_id=trace.trace_id,
         ts=trace.ts,
         app_id=trace.app_id,

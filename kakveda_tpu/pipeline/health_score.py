@@ -32,6 +32,31 @@ RECURRENCE_UNIT = 2.5
 WEIGHT_SCALE = 5.0
 
 
+class _AppWindow:
+    """Rolling window with incrementally-maintained aggregates: the score
+    math needs Σweight and per-type counts over the last ≤50 events, and
+    recomputing those per event is the streaming path's hottest host loop."""
+
+    __slots__ = ("events", "weighted", "counts")
+
+    def __init__(self) -> None:
+        self.events: Deque[dict] = deque()
+        self.weighted: float = 0.0
+        self.counts: Dict[str, int] = defaultdict(int)
+
+    def push(self, event: dict) -> None:
+        self.events.append(event)
+        self.weighted += event["weight"]
+        self.counts[str(event["failure_type"])] += 1
+        if len(self.events) > WINDOW:
+            old = self.events.popleft()
+            self.weighted -= old["weight"]
+            ft = str(old["failure_type"])
+            self.counts[ft] -= 1
+            if self.counts[ft] == 0:
+                del self.counts[ft]
+
+
 class HealthScorer:
     def __init__(
         self,
@@ -45,39 +70,39 @@ class HealthScorer:
         if persist:
             self.data_dir.mkdir(parents=True, exist_ok=True)
         self.health_path = self.data_dir / "health.jsonl"
-        self._windows: Dict[str, Deque[dict]] = defaultdict(lambda: deque(maxlen=WINDOW))
+        self._windows: Dict[str, _AppWindow] = defaultdict(_AppWindow)
         self._lock = threading.Lock()
 
     def _append_all(self, points: List[HealthPoint]) -> None:
         if not self.persist or not points:
             return
         with self.health_path.open("a", encoding="utf-8") as f:
-            for point in points:
-                f.write(json.dumps(point.model_dump(mode="json"), ensure_ascii=False) + "\n")
+            # pydantic's C serializer straight to JSON — no intermediate dict
+            # or Python json encoder on the streaming path.
+            f.write("".join(p.model_dump_json() + "\n" for p in points))
 
     def _score_one(self, failure: FailureSignal, weights: Dict[str, float], base: float) -> HealthPoint:
         """Window update + score math; caller holds the lock and owns I/O."""
         w = float(weights.get(failure.severity.value, 1.0))
         window = self._windows[failure.app_id]
-        window.append(
+        window.push(
             {
-                "ts": failure.ts.isoformat(),
                 "severity": failure.severity.value,
                 "weight": w,
                 "failure_type": failure.failure_type,
             }
         )
-        events = list(window)
+        n = len(window.events)
+        counts = window.counts
+        # Σ_type max(0, count-1) over counts where every count ≥ 1 reduces
+        # to (total events − distinct types).
+        recurrent_penalty = (n - len(counts)) * RECURRENCE_UNIT
+        score = max(0.0, base - window.weighted * WEIGHT_SCALE - recurrent_penalty)
+        last = window.events[-1]
 
-        n = len(events)
-        weighted = sum(e["weight"] for e in events)
-        counts: Dict[str, int] = defaultdict(int)
-        for e in events:
-            counts[str(e["failure_type"])] += 1
-        recurrent_penalty = sum(max(0, c - 1) for c in counts.values()) * RECURRENCE_UNIT
-        score = max(0.0, base - weighted * WEIGHT_SCALE - recurrent_penalty)
-
-        return HealthPoint(
+        # model_construct: fields are built here with correct types; skipping
+        # validation keeps the streaming path off the pydantic hot loop.
+        return HealthPoint.model_construct(
             ts=utcnow(),
             app_id=failure.app_id,
             score=score,
@@ -86,10 +111,10 @@ class HealthScorer:
             avg_recovery_time_sec=30.0 + 10.0 * recurrent_penalty,
             notes={
                 "window_failures": n,
-                "weighted": weighted,
+                "weighted": window.weighted,
                 "top_failure": max(counts, key=counts.get) if counts else None,
-                "last_failure": events[-1]["failure_type"] if events else None,
-                "last_severity": events[-1]["severity"] if events else None,
+                "last_failure": last["failure_type"],
+                "last_severity": last["severity"],
             },
         )
 

@@ -23,6 +23,7 @@ from kakveda_tpu.pipeline.classifier import HALLUCINATION_CITATION
 
 _CITATION_PATTERN_NAME = "Citation hallucination without sources"
 _CITATION_PATTERN_DESC = "Same prompt pattern causes hallucinated citations across apps"
+MAX_PATTERN_FAILURE_IDS = 1000
 
 
 class PatternDetector:
@@ -32,21 +33,36 @@ class PatternDetector:
 
     def on_failure(self, failure: FailureSignal) -> Optional[PatternEntity]:
         """Reactor invoked on every failure.detected event."""
-        if failure.failure_type != HALLUCINATION_CITATION:
-            return None
+        out = self.on_failures_batch([failure])
+        return out[0] if out else None
 
-        relevant = [f for f in self.gfkb.list_failures() if f.failure_type == failure.failure_type]
-        affected = sorted({a for f in relevant for a in f.affected_apps})
-        if len(affected) < self.min_apps:
-            return None
-        failure_ids = sorted({f.failure_id for f in relevant})
-        pattern, _ = self.gfkb.upsert_pattern(
-            name=_CITATION_PATTERN_NAME,
-            failure_ids=failure_ids,
-            affected_apps=affected,
-            description=_CITATION_PATTERN_DESC,
-        )
-        return pattern
+    def on_failures_batch(self, failures: List[FailureSignal]) -> List[PatternEntity]:
+        """Batch reactor for the streaming-ingest path: one GFKB scan and at
+        most one pattern upsert per distinct failure type in the batch —
+        per-event reaction would be O(N) scans per batch (O(N²) over a
+        stream) plus a pattern-version append per failure."""
+        types = {f.failure_type for f in failures if f.failure_type == HALLUCINATION_CITATION}
+        if not types:
+            return []
+        out: List[PatternEntity] = []
+        for ftype in sorted(types):
+            # O(1) read of incrementally-maintained aggregates — rescanning
+            # the GFKB per batch is O(N²) over a failure stream.
+            ids, affected = self.gfkb.type_aggregate(ftype)
+            if len(affected) < self.min_apps:
+                continue
+            # Cap the stored id list: each upsert re-appends the pattern to
+            # the JSONL log, so unbounded failure_ids makes the log O(N²)
+            # over a failure stream. The full membership is recoverable from
+            # the failures log by type.
+            pattern, _ = self.gfkb.upsert_pattern(
+                name=_CITATION_PATTERN_NAME,
+                failure_ids=ids[-MAX_PATTERN_FAILURE_IDS:],
+                affected_apps=affected,
+                description=_CITATION_PATTERN_DESC,
+            )
+            out.append(pattern)
+        return out
 
     def mine_patterns(self, threshold: float = 0.6) -> List[PatternEntity]:
         """Batch pattern mining over the whole GFKB via device clustering.

@@ -66,7 +66,11 @@ class Platform:
         self.data_dir = Path(data_dir)
         d = dim or self.config.embedding_dim()
 
-        self.bus = EventBus()
+        # HTTP-URL subscriptions survive restarts (replayed from the append
+        # log) — fixes the reference's lost-on-restart hazard (SURVEY §3.5).
+        self.bus = EventBus(
+            persist_path=(self.data_dir / "subscriptions.jsonl") if persist else None
+        )
         self.gfkb = GFKB(
             data_dir=self.data_dir,
             mesh=mesh,
@@ -131,9 +135,22 @@ class Platform:
                 for t, s in found
             ]
         )
-        for _, s in found:
-            await self.bus.publish(TOPIC_FAILURE_DETECTED, s.model_dump(mode="json"))
-        return [s for _, s in found]
+        signals_found = [s for _, s in found]
+        # Batch-aware reactors run once per batch (one GFKB scan for pattern
+        # detection, one health append) — the O(N²) trap of reacting per
+        # event is what keeps the reference from streaming throughput. The
+        # bus still delivers every failure.detected to external subscribers;
+        # the internal reactor is excluded because it just ran here.
+        self.patterns.on_failures_batch(signals_found)
+        self.health.on_failures_batch(signals_found)
+        exclude = (self._on_failure_event,)
+        if self.bus.has_subscribers(TOPIC_FAILURE_DETECTED, exclude=exclude):
+            await self.bus.publish_many(
+                TOPIC_FAILURE_DETECTED,
+                [s.model_dump(mode="json") for s in signals_found],
+                exclude=exclude,
+            )
+        return signals_found
 
     async def ingest(self, trace: TracePayload) -> None:
         """The reference's POST /ingest → publish trace.ingested

@@ -194,6 +194,14 @@ def make_app(platform: Optional[Platform] = None, **platform_kw) -> web.Applicat
         n = plat.bus.subscribe(topic, cb)
         return web.json_response({"ok": True, "topic": topic, "subscribers": n})
 
+    async def unsubscribe(request):
+        body = await request.json()
+        topic, cb = body.get("topic"), body.get("callback_url")
+        if not topic or not cb:
+            return _json_error(422, "topic and callback_url required")
+        plat.bus.unsubscribe(topic, cb)
+        return web.json_response({"ok": True, "topic": topic})
+
     async def publish(request):
         body = await request.json()
         topic, event = body.get("topic"), body.get("event")
@@ -218,6 +226,7 @@ def make_app(platform: Optional[Platform] = None, **platform_kw) -> web.Applicat
             web.post("/patterns/upsert", upsert_pattern),
             web.get("/health/{app_id}", app_health),
             web.post("/subscribe", subscribe),
+            web.post("/unsubscribe", unsubscribe),
             web.post("/publish", publish),
             web.get("/topics", topics),
         ]

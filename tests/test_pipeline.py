@@ -204,3 +204,24 @@ def test_tiered_classifier_llm_judge():
 
     assert parse_judge_verdict("no") is False
     assert parse_judge_verdict("Well, YES, clearly") is True
+
+
+def test_bus_durable_url_subscriptions(tmp_path):
+    path = tmp_path / "subs.jsonl"
+    bus = EventBus(persist_path=path)
+    bus.subscribe("trace.ingested", "http://agent:8120/events")
+    bus.subscribe("trace.ingested", "http://other:9000/cb")
+    bus.subscribe("failure.detected", "http://agent:8120/events")
+    bus.unsubscribe("trace.ingested", "http://other:9000/cb")
+    # local callables are never persisted
+    bus.subscribe("trace.ingested", lambda e: None)
+
+    bus2 = EventBus(persist_path=path)
+    assert bus2.topics() == {"trace.ingested": 1, "failure.detected": 1}
+    assert bus2._subs["trace.ingested"] == ["http://agent:8120/events"]
+
+    # torn tail line from a crash mid-append is skipped on replay
+    with path.open("a") as f:
+        f.write('{"action": "subscribe", "topic": "x", "ur')
+    bus3 = EventBus(persist_path=path)
+    assert "x" not in bus3.topics()
