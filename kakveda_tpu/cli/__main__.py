@@ -1,0 +1,7 @@
+"""`python -m kakveda_tpu.cli` — same entry as the `kakveda-tpu` script."""
+
+import sys
+
+from kakveda_tpu.cli.main import main
+
+sys.exit(main())

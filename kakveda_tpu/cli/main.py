@@ -36,6 +36,10 @@ def _cmd_init(args: argparse.Namespace) -> int:
         print(f"wrote {cfg}")
     (root / "data").mkdir(parents=True, exist_ok=True)
     print(f"data dir ready at {root / 'data'}")
+    if args.wizard or args.yes:
+        from kakveda_tpu.cli.wizard import run_wizard
+
+        run_wizard(root, assume_yes=args.yes)
     return 0
 
 
@@ -52,11 +56,71 @@ def _cmd_doctor(args: argparse.Namespace) -> int:
     def _jax():
         import jax
 
-        return f"{jax.__version__} backend={jax.default_backend()} devices={len(jax.devices())}"
+        try:
+            backend = jax.default_backend()
+            note = ""
+        except RuntimeError:
+            # Accelerator plugin present but not initializable from this
+            # environment — fall back so the rest of doctor still runs.
+            jax.config.update("jax_platforms", "cpu")
+            backend = jax.default_backend()
+            note = " (accelerator unavailable here; fell back to cpu)"
+        return f"{jax.__version__} backend={backend} devices={len(jax.devices())}{note}"
+
+    def _mesh():
+        from kakveda_tpu.parallel.mesh import create_mesh
+
+        mesh = create_mesh(os.environ.get("KAKVEDA_MESH_SHAPE", "data:-1"))
+        return f"axes={dict(mesh.shape)}"
+
+    def _device_compute():
+        import jax
+        import jax.numpy as jnp
+
+        x = jnp.ones((128, 128), jnp.float32)
+        y = jax.jit(lambda a: (a @ a).sum())(x)
+        return f"matmul ok (sum={float(y):.0f})"
+
+    def _native():
+        from kakveda_tpu import native
+
+        return "C++ fast path loaded" if native.available() else "pure-python fallback (run make in kakveda_tpu/native)"
+
+    def _config_parse():
+        from kakveda_tpu.core.config import ConfigStore
+
+        cs = ConfigStore()
+        return f"threshold={cs.similarity_threshold()} top_k={cs.match_top_k()}"
+
+    def _jwt_secret():
+        from kakveda_tpu.core.runtime import get_runtime_config
+
+        rc = get_runtime_config(service_name="doctor")
+        if rc.env == "production" and rc.dashboard_jwt_secret == "dev-secret-change-me":
+            raise RuntimeError("production with default JWT secret — set DASHBOARD_JWT_SECRET")
+        return "set" if rc.dashboard_jwt_secret != "dev-secret-change-me" else "dev default (fine outside production)"
+
+    def _redis():
+        url = os.environ.get("KAKVEDA_REDIS_URL")
+        if not url:
+            return "not configured (in-memory revocation/rate-limit)"
+        import redis  # type: ignore[import-not-found]
+
+        redis.Redis.from_url(url, socket_timeout=1).ping()
+        # Redact userinfo — the URL may carry a password, and doctor output
+        # lands in terminals and CI logs.
+        safe = url.split("@", 1)[-1] if "@" in url else url.split("//", 1)[-1]
+        return f"reachable at {safe}"
 
     check("python", lambda: sys.version.split()[0])
     check("jax", _jax)
+    check("device mesh", _mesh)
+    check("device compute", _device_compute)
+    check("native extension", _native)
     check("config", lambda: str(Path(os.environ.get("KAKVEDA_CONFIG_PATH", "config/config.yaml")).resolve()))
+    check("config parse", _config_parse)
+    check("jwt secret", _jwt_secret)
+    check("redis", _redis)
     check("data dir writable", lambda: _writable(os.environ.get("KAKVEDA_DATA_DIR", "data")))
 
     ok = all(c[1] for c in checks)
@@ -124,6 +188,8 @@ def build_parser() -> argparse.ArgumentParser:
     sp = sub.add_parser("init", help="write default config + create data dir")
     sp.add_argument("--dir", default=".", help="project root")
     sp.add_argument("--force", action="store_true")
+    sp.add_argument("--wizard", action="store_true", help="interactive .env setup")
+    sp.add_argument("--yes", action="store_true", help="write .env with all defaults, no questions")
     sp.set_defaults(fn=_cmd_init)
 
     sp = sub.add_parser("up", help="start the platform server")
@@ -158,6 +224,13 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
+    # Apply the wizard-written .env (real environment wins) so the config
+    # consumers see what docker compose would; `init` must not load it —
+    # it may be about to (re)write the file.
+    if args.cmd in ("up", "doctor", "status"):
+        from kakveda_tpu.cli.wizard import load_dotenv
+
+        load_dotenv(Path(getattr(args, "dir", ".")) / ".env")
     return args.fn(args)
 
 

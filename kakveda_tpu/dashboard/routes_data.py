@@ -190,6 +190,15 @@ def setup(app: web.Application) -> None:
         raise web.HTTPFound(f"/eval/{run_id}")
 
     @require_login
+    async def evals_page(request):
+        """All evaluation runs across datasets, newest first."""
+        runs = ctx.db.query(
+            "SELECT e.*, d.name AS dataset_name FROM evaluation_runs e"
+            " LEFT JOIN datasets d ON d.id=e.dataset_id ORDER BY e.ts DESC LIMIT 200"
+        )
+        return ctx.render(request, "evals.html", runs=runs)
+
+    @require_login
     async def eval_detail(request):
         """Pass-rate + p50/p95 latency + provider split
         (reference: services/dashboard/app.py:2396-2478)."""
@@ -313,6 +322,7 @@ def setup(app: web.Application) -> None:
             web.post("/datasets/{ds_id}/examples", example_add),
             web.post("/datasets/{ds_id}/examples/{ex_id}/run", example_run_now),
             web.post("/datasets/{ds_id}/eval", eval_run),
+            web.get("/evals", evals_page),
             web.get("/eval/{run_id}", eval_detail),
             web.get("/prompts", prompts_page),
             web.post("/prompts/save", prompt_save),

@@ -55,3 +55,107 @@ def test_status_counts_rows(tmp_path, capsys):
     status = json.loads(capsys.readouterr().out)
     assert status["failures"] == 2
     assert status["patterns"] == 0
+
+
+def test_init_yes_writes_env(tmp_path):
+    from kakveda_tpu.cli.main import main
+
+    assert main(["init", "--dir", str(tmp_path), "--yes"]) == 0
+    env = (tmp_path / ".env").read_text()
+    assert "DASHBOARD_JWT_SECRET=" in env
+    secret = [l for l in env.splitlines() if l.startswith("DASHBOARD_JWT_SECRET=")][0].split("=", 1)[1]
+    assert len(secret) == 64  # token_hex(32)
+    assert "KAKVEDA_ENV=development" in env
+    # re-running keeps the existing secret (sessions survive)
+    assert main(["init", "--dir", str(tmp_path), "--yes", "--force"]) == 0
+    assert secret in (tmp_path / ".env").read_text()
+
+
+def test_wizard_interactive_answers(tmp_path):
+    from kakveda_tpu.cli.wizard import run_wizard
+
+    answers = iter([
+        "production",          # env
+        "tpu",                 # model runtime
+        "text",                # log format
+        "4096",                # index capacity
+        "data:4,model:2",      # mesh shape
+        "redis://r:6379/0",    # redis url
+        "",                    # smtp host (skip)
+        "",                    # otel (skip)
+    ])
+    out = []
+    path = run_wizard(tmp_path, input_fn=lambda _: next(answers), print_fn=out.append)
+    env = path.read_text()
+    assert "KAKVEDA_ENV=production" in env
+    assert "KAKVEDA_MODEL_RUNTIME=tpu" in env
+    assert "KAKVEDA_MESH_SHAPE=data:4,model:2" in env
+    assert "KAKVEDA_REDIS_URL=redis://r:6379/0" in env
+    assert "SMTP_HOST" not in env
+    assert any("production mode" in line for line in out)
+
+
+def test_doctor_runs(capsys, tmp_path, monkeypatch):
+    from kakveda_tpu.cli.main import main
+
+    # hermetic: ambient redis/env settings or repo-dir writes must not leak
+    monkeypatch.delenv("KAKVEDA_REDIS_URL", raising=False)
+    monkeypatch.delenv("KAKVEDA_ENV", raising=False)
+    monkeypatch.delenv("KAKVEDA_MESH_SHAPE", raising=False)
+    monkeypatch.setenv("KAKVEDA_DATA_DIR", str(tmp_path / "data"))
+    monkeypatch.setenv("KAKVEDA_CONFIG_PATH", str(tmp_path / "config.yaml"))
+    monkeypatch.chdir(tmp_path)
+    rc = main(["doctor"])
+    outp = capsys.readouterr().out
+    assert "jax" in outp and "device mesh" in outp and "native extension" in outp
+    assert rc == 0
+
+
+def test_doctor_redacts_redis_password(capsys, tmp_path, monkeypatch):
+    from kakveda_tpu.cli.main import main
+
+    monkeypatch.setenv("KAKVEDA_REDIS_URL", "redis://:s3cretpass@localhost:1/0")
+    monkeypatch.setenv("KAKVEDA_DATA_DIR", str(tmp_path / "data"))
+    monkeypatch.chdir(tmp_path)
+    main(["doctor"])
+    outp = capsys.readouterr().out
+    assert "s3cretpass" not in outp
+
+
+def test_load_dotenv_env_wins(tmp_path, monkeypatch):
+    from kakveda_tpu.cli.wizard import load_dotenv
+
+    env = tmp_path / ".env"
+    env.write_text("KAKVEDA_TEST_A=from_file\nKAKVEDA_TEST_B=file_b\n# comment\nbad line\n")
+    monkeypatch.setenv("KAKVEDA_TEST_A", "from_env")
+    monkeypatch.delenv("KAKVEDA_TEST_B", raising=False)
+    applied = load_dotenv(env)
+    try:
+        import os
+        assert os.environ["KAKVEDA_TEST_A"] == "from_env"  # real env wins
+        assert os.environ["KAKVEDA_TEST_B"] == "file_b"
+        assert applied == 1
+    finally:
+        import os
+        os.environ.pop("KAKVEDA_TEST_B", None)
+
+
+def test_env_file_permissions(tmp_path):
+    import os, stat
+    from kakveda_tpu.cli.main import main
+
+    assert main(["init", "--dir", str(tmp_path), "--yes"]) == 0
+    mode = stat.S_IMODE(os.stat(tmp_path / ".env").st_mode)
+    assert mode == 0o600
+
+
+def test_wizard_rejects_invalid_choice(tmp_path):
+    from kakveda_tpu.cli.wizard import run_wizard
+
+    answers = iter([
+        "prod",            # invalid → re-asked
+        "production",      # valid env
+        "stub", "json", "4096", "data:-1", "", "", "",
+    ])
+    path = run_wizard(tmp_path, input_fn=lambda _: next(answers), print_fn=lambda s: None)
+    assert "KAKVEDA_ENV=production" in path.read_text()

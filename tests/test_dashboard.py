@@ -484,3 +484,27 @@ def test_forgot_sends_email_when_smtp_configured(tmp_path, monkeypatch):
             await client.close()
 
     run(go())
+
+
+def test_evals_list_page(tmp_path):
+    async def go():
+        client = await _client(_mk_app(tmp_path))
+        try:
+            await _login(client)
+            # empty state renders
+            r = await client.get("/evals")
+            assert r.status == 200 and "Evaluation runs" in await r.text()
+            # create dataset + example, run an eval, then the run lists
+            r = await client.post("/datasets/create", data={"name": "ds1", "description": ""})
+            await client.post(
+                "/datasets/1/examples",
+                data={"prompt": "Summarize with citations", "app_id": "eval-app", "expected": ""},
+            )
+            await client.post("/datasets/1/eval")
+            r = await client.get("/evals")
+            body = await r.text()
+            assert "/eval/1" in body and "ds1" in body
+        finally:
+            await client.close()
+
+    run(go())

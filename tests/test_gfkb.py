@@ -154,3 +154,52 @@ def test_pattern_upsert_merges(tmp_path):
     assert p2.failure_ids == ["F-1", "F-2", "F-3"]
     assert p2.affected_apps == ["a", "b"]
     assert p2.description == "d"
+
+
+def test_concurrent_upserts_and_match(tmp_path):
+    """SURVEY §5.2: the reference has unsynchronized shared state; here
+    concurrent writers + readers must stay consistent (lock-protected
+    metadata, atomic slot assignment, no lost records)."""
+    import threading
+
+    from kakveda_tpu.index.gfkb import GFKB
+
+    gfkb = GFKB(data_dir=tmp_path, capacity=512, dim=512)
+    n_threads, per_thread = 8, 25
+    errors = []
+
+    def writer(tid):
+        try:
+            for i in range(per_thread):
+                gfkb.upsert_failure(
+                    failure_type="HALLUCINATION_CITATION",
+                    signature_text=f"sig thread {tid} item {i} citations required",
+                    app_id=f"app-{tid}",
+                    impact_severity=Severity.medium,
+                )
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    def reader():
+        try:
+            for _ in range(40):
+                gfkb.match("sig thread citations required")
+                gfkb.list_failures()
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=writer, args=(t,)) for t in range(n_threads)]
+    threads += [threading.Thread(target=reader) for _ in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    assert not errors, errors
+    recs = gfkb.list_failures()
+    assert len(recs) == n_threads * per_thread
+    # slots and ids are unique despite interleaved writers
+    assert len({r.failure_id for r in recs}) == len(recs)
+    ids, apps = gfkb.type_aggregate("HALLUCINATION_CITATION")
+    assert len(ids) == len(recs)
+    assert len(apps) == n_threads
