@@ -214,6 +214,7 @@ def build_parser() -> argparse.ArgumentParser:
     sp.set_defaults(fn=_cmd_logs)
 
     sp = sub.add_parser("doctor", help="check the runtime environment")
+    sp.add_argument("--dir", default=".", help="project root (for .env)")
     sp.set_defaults(fn=_cmd_doctor)
 
     sp = sub.add_parser("version", help="print version")

@@ -84,4 +84,55 @@ def make_dashboard_app(
             return web.json_response({"ok": False, "error": str(e)}, status=503)
 
     app.add_routes([web.get("/healthz", healthz), web.get("/readyz", readyz)])
+
+    # Bus subscriptions (reference: services/dashboard/app.py:1332-1431):
+    # traces ingested through the platform API (not just scenario runs) land
+    # in the runs explorer, and child-safety alerts from external agents
+    # become WarningEvent rows.
+    def _on_trace_ingested(event: dict) -> None:
+        import time as _time
+
+        try:
+            db.execute(
+                "INSERT OR IGNORE INTO trace_runs (trace_id, ts, app_id, agent_id, prompt,"
+                " response, provider, model, status, tags_json) VALUES (?,?,?,?,?,?,?,?,'ok','[]')",
+                (
+                    str(event.get("trace_id") or ""),
+                    _time.time(),
+                    str(event.get("app_id") or "unknown"),
+                    event.get("agent_id"),
+                    str(event.get("prompt") or ""),
+                    str(event.get("response") or ""),
+                    "event",
+                    event.get("model"),
+                ),
+            )
+        except Exception:  # noqa: BLE001 — event persistence is best-effort
+            pass
+
+    def _on_child_safety(event: dict) -> None:
+        import time as _time
+
+        sev = str(event.get("severity") or "medium").lower()
+        confidence = {"low": 0.4, "medium": 0.7, "high": 0.95}.get(sev, 0.7)
+        try:
+            db.execute(
+                "INSERT INTO warning_events (ts, app_id, action, confidence, failure_type,"
+                " message, source) VALUES (?,?,?,?,?,?,'child_safety')",
+                (
+                    _time.time(),
+                    str(event.get("app_id") or "unknown"),
+                    "block" if sev == "high" else "warn",
+                    confidence,
+                    str(event.get("failure_type") or "CHILD_SAFETY"),
+                    str(event.get("message") or event.get("reason") or "child safety alert"),
+                ),
+            )
+        except Exception:  # noqa: BLE001
+            pass
+
+    from kakveda_tpu.events.bus import TOPIC_CHILD_SAFETY, TOPIC_TRACE_INGESTED
+
+    plat.bus.subscribe(TOPIC_TRACE_INGESTED, _on_trace_ingested)
+    plat.bus.subscribe(TOPIC_CHILD_SAFETY, _on_child_safety)
     return app

@@ -99,19 +99,8 @@ def setup(app: web.Application) -> None:
         )
         gen = await off_loop(ctx.model.generate, ex["prompt"])
         passed = citation_check_passes(ex["prompt"], gen.text)
-        await plat.ingest(
-            TracePayload(
-                trace_id=trace_id,
-                ts=datetime.now(timezone.utc),
-                app_id=ex["app_id"],
-                agent_id="eval",
-                prompt=ex["prompt"],
-                response=gen.text,
-                model=gen.meta.get("model"),
-                tools=[],
-                env={},
-            )
-        )
+        # Rich trace row BEFORE plat.ingest — the trace.ingested subscriber
+        # writes a sparse fallback row and INSERT OR IGNORE is first-wins.
         tin, tout = estimate_tokens(ex["prompt"]), estimate_tokens(gen.text)
         ctx.db.execute(
             "INSERT OR IGNORE INTO trace_runs (trace_id, ts, app_id, agent_id, prompt, response,"
@@ -131,6 +120,19 @@ def setup(app: web.Application) -> None:
                 tout,
                 estimate_cost_micro_usd(tin, tout),
             ),
+        )
+        await plat.ingest(
+            TracePayload(
+                trace_id=trace_id,
+                ts=datetime.now(timezone.utc),
+                app_id=ex["app_id"],
+                agent_id="eval",
+                prompt=ex["prompt"],
+                response=gen.text,
+                model=gen.meta.get("model"),
+                tools=[],
+                env={},
+            )
         )
         return {
             "trace_id": trace_id,

@@ -180,21 +180,9 @@ def setup(app: web.Application) -> None:
         gen = await off_loop(ctx.model.generate, prompt)
         g_t1 = time.time()
 
-        i_t0 = time.time()
-        trace = TracePayload(
-            trace_id=trace_id,
-            ts=datetime.now(timezone.utc),
-            app_id=app_id,
-            agent_id="dashboard",
-            prompt=prompt,
-            response=gen.text,
-            model=gen.meta.get("model"),
-            tools=[],
-            env={},
-        )
-        await plat.ingest(trace)
-        i_t1 = time.time()
-
+        # Rich trace row BEFORE plat.ingest: the dashboard's trace.ingested
+        # subscriber inserts a sparse fallback row for externally-ingested
+        # traces, and INSERT OR IGNORE means whichever lands first wins.
         tokens_in = estimate_tokens(prompt)
         tokens_out = estimate_tokens(gen.text)
         ctx.db.execute(
@@ -216,6 +204,21 @@ def setup(app: web.Application) -> None:
                 estimate_cost_micro_usd(tokens_in, tokens_out),
             ),
         )
+
+        i_t0 = time.time()
+        trace = TracePayload(
+            trace_id=trace_id,
+            ts=datetime.now(timezone.utc),
+            app_id=app_id,
+            agent_id="dashboard",
+            prompt=prompt,
+            response=gen.text,
+            model=gen.meta.get("model"),
+            tools=[],
+            env={},
+        )
+        await plat.ingest(trace)
+        i_t1 = time.time()
         ctx.db.execute(
             "INSERT INTO scenario_runs (ts, user_email, app_id, prompt, response, warning_action,"
             " warning_confidence, provider, model, latency_ms, trace_id) VALUES (?,?,?,?,?,?,?,?,?,?,?)",

@@ -67,9 +67,11 @@ class PatternDetector:
     def mine_patterns(self, threshold: float = 0.6) -> List[PatternEntity]:
         """Batch pattern mining over the whole GFKB via device clustering.
 
-        Clusters canonical failures by embedding similarity; any cluster of
-        ≥2 failures spanning ≥min_apps apps becomes (or refreshes) a pattern
-        named after its dominant failure type.
+        Clusters canonical failures by embedding similarity; any cluster
+        whose members span ≥min_apps apps becomes (or refreshes) a pattern
+        named after its dominant failure type. (Member count is NOT a
+        criterion: identical signatures canonicalize into one record, so a
+        singleton cluster can represent a failure recurring across apps.)
         """
         from kakveda_tpu.ops.clustering import cluster_embeddings
 
@@ -85,10 +87,12 @@ class PatternDetector:
 
         out: List[PatternEntity] = []
         for members in groups.values():
-            if len(members) < 2:
-                continue
             recs = [records[i] for i in members]
             apps = sorted({a for r in recs for a in r.affected_apps})
+            # App span is the criterion, not member count: identical
+            # signatures canonicalize into ONE record whose affected_apps
+            # grows, so a singleton cluster spanning ≥min_apps apps is
+            # exactly the recurring cross-app failure a pattern describes.
             if len(apps) < self.min_apps:
                 continue
             types = sorted({r.failure_type for r in recs})

@@ -194,6 +194,26 @@ def make_app(platform: Optional[Platform] = None, **platform_kw) -> web.Applicat
         n = plat.bus.subscribe(topic, cb)
         return web.json_response({"ok": True, "topic": topic, "subscribers": n})
 
+    async def mine_patterns(request):
+        """Batch pattern mining: device-side clustering over the full GFKB
+        embedding matrix (the batch job the reference never had). Body:
+        {"threshold": 0.6} optional."""
+        try:
+            body = await request.json()
+        except Exception:  # noqa: BLE001 — empty body is fine
+            body = {}
+        try:
+            threshold = float(body.get("threshold", 0.6))
+        except (TypeError, ValueError, AttributeError):
+            return _json_error(422, "threshold must be a number")
+        import asyncio as _asyncio
+
+        loop = _asyncio.get_running_loop()
+        found = await loop.run_in_executor(None, plat.patterns.mine_patterns, threshold)
+        return web.json_response(
+            {"ok": True, "patterns": [p.model_dump(mode="json") for p in found]}
+        )
+
     async def unsubscribe(request):
         body = await request.json()
         topic, cb = body.get("topic"), body.get("callback_url")
@@ -224,6 +244,7 @@ def make_app(platform: Optional[Platform] = None, **platform_kw) -> web.Applicat
             web.post("/failures/upsert", upsert_failure),
             web.get("/patterns", list_patterns),
             web.post("/patterns/upsert", upsert_pattern),
+            web.post("/patterns/mine", mine_patterns),
             web.get("/health/{app_id}", app_health),
             web.post("/subscribe", subscribe),
             web.post("/unsubscribe", unsubscribe),

@@ -219,3 +219,91 @@ def test_request_id_header(app):
         assert len(r.headers["x-request-id"]) == 32
 
     run(_with_client(app, go))
+
+
+def test_patterns_mine_endpoint(tmp_path):
+    """Device clustering over the GFKB via POST /patterns/mine."""
+    import asyncio
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from kakveda_tpu.core.schemas import Severity
+    from kakveda_tpu.platform import Platform
+    from kakveda_tpu.service.app import make_app
+
+    async def go():
+        plat = Platform(data_dir=tmp_path / "d", capacity=256, dim=1024)
+        # two similar citation failures across apps + one unrelated
+        for app_id in ("app-A", "app-B"):
+            plat.gfkb.upsert_failure(
+                failure_type="HALLUCINATION_CITATION",
+                signature_text="intent:citations_required | summarize the quarterly report",
+                app_id=app_id,
+                impact_severity=Severity.medium,
+            )
+        plat.gfkb.upsert_failure(
+            failure_type="TIMEOUT",
+            signature_text="totally different failure shape xyz",
+            app_id="app-C",
+            impact_severity=Severity.low,
+        )
+        c = TestClient(TestServer(make_app(plat)))
+        await c.start_server()
+        try:
+            r = await c.post("/patterns/mine", json={"threshold": 0.5})
+            assert r.status == 200
+            body = await r.json()
+            assert body["ok"]
+            names = [p["name"] for p in body["patterns"]]
+            assert any("itation" in n for n in names), names
+        finally:
+            await c.close()
+
+    asyncio.run(go())
+
+
+def test_dashboard_bus_subscriptions(tmp_path):
+    """API-ingested traces land in the runs explorer; child-safety alerts
+    become warning events (reference: dashboard/app.py:1332-1431)."""
+    import asyncio
+    from datetime import datetime, timezone
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from kakveda_tpu.core.schemas import TracePayload
+    from kakveda_tpu.dashboard.app import make_dashboard_app
+    from kakveda_tpu.models.runtime import StubRuntime
+    from kakveda_tpu.platform import Platform
+
+    async def go():
+        plat = Platform(data_dir=tmp_path / "d", capacity=256, dim=1024)
+        app = make_dashboard_app(platform=plat, db_path=tmp_path / "dash.db", model=StubRuntime())
+        c = TestClient(TestServer(app))
+        await c.start_server()
+        try:
+            await plat.ingest(
+                TracePayload(
+                    trace_id="ev-1",
+                    ts=datetime.now(timezone.utc),
+                    app_id="bus-app",
+                    agent_id="external",
+                    prompt="hello",
+                    response="world",
+                    model=None,
+                    tools=[],
+                    env={},
+                )
+            )
+            await plat.bus.publish(
+                "child_safety_alert",
+                {"app_id": "kids-app", "severity": "high", "message": "blocked topic"},
+            )
+            await c.post("/login", data={"email": "admin@local", "password": "admin123", "next": "/"})
+            runs = await (await c.get("/runs?q=")).text()
+            assert "ev-1" in runs
+            warnings = await (await c.get("/warnings")).text()
+            assert "kids-app" in warnings
+        finally:
+            await c.close()
+
+    asyncio.run(go())
