@@ -88,17 +88,36 @@ def make_dashboard_app(
     # Bus subscriptions (reference: services/dashboard/app.py:1332-1431):
     # traces ingested through the platform API (not just scenario runs) land
     # in the runs explorer, and child-safety alerts from external agents
-    # become WarningEvent rows.
-    def _on_trace_ingested(event: dict) -> None:
-        import time as _time
+    # become WarningEvent rows. Raising on failure lets the bus's delivery
+    # accounting see it (a swallowed insert error would silently lose e.g. a
+    # high-severity safety alert).
+    import logging as _logging
+    import time as _time
+    from datetime import datetime as _dt
 
+    _log = _logging.getLogger("kakveda.dashboard.events")
+
+    def _event_ts(event: dict) -> float:
+        """Honor the trace's own timestamp (backfilled traces must not all
+        land at 'now'); fall back to the wall clock."""
+        raw = event.get("ts")
+        if isinstance(raw, (int, float)):
+            return float(raw)
+        if isinstance(raw, str):
+            try:
+                return _dt.fromisoformat(raw.replace("Z", "+00:00")).timestamp()
+            except ValueError:
+                pass
+        return _time.time()
+
+    def _on_trace_ingested(event: dict) -> None:
         try:
             db.execute(
                 "INSERT OR IGNORE INTO trace_runs (trace_id, ts, app_id, agent_id, prompt,"
                 " response, provider, model, status, tags_json) VALUES (?,?,?,?,?,?,?,?,'ok','[]')",
                 (
                     str(event.get("trace_id") or ""),
-                    _time.time(),
+                    _event_ts(event),
                     str(event.get("app_id") or "unknown"),
                     event.get("agent_id"),
                     str(event.get("prompt") or ""),
@@ -107,12 +126,11 @@ def make_dashboard_app(
                     event.get("model"),
                 ),
             )
-        except Exception:  # noqa: BLE001 — event persistence is best-effort
-            pass
+        except Exception:
+            _log.exception("trace.ingested persistence failed")
+            raise
 
     def _on_child_safety(event: dict) -> None:
-        import time as _time
-
         sev = str(event.get("severity") or "medium").lower()
         confidence = {"low": 0.4, "medium": 0.7, "high": 0.95}.get(sev, 0.7)
         try:
@@ -120,7 +138,7 @@ def make_dashboard_app(
                 "INSERT INTO warning_events (ts, app_id, action, confidence, failure_type,"
                 " message, source) VALUES (?,?,?,?,?,?,'child_safety')",
                 (
-                    _time.time(),
+                    _event_ts(event),
                     str(event.get("app_id") or "unknown"),
                     "block" if sev == "high" else "warn",
                     confidence,
@@ -128,11 +146,20 @@ def make_dashboard_app(
                     str(event.get("message") or event.get("reason") or "child safety alert"),
                 ),
             )
-        except Exception:  # noqa: BLE001
-            pass
+        except Exception:
+            _log.exception("child_safety_alert persistence failed")
+            raise
 
     from kakveda_tpu.events.bus import TOPIC_CHILD_SAFETY, TOPIC_TRACE_INGESTED
 
     plat.bus.subscribe(TOPIC_TRACE_INGESTED, _on_trace_ingested)
     plat.bus.subscribe(TOPIC_CHILD_SAFETY, _on_child_safety)
+
+    async def _unsubscribe(app_):
+        # A second make_dashboard_app on the same Platform (tests, reload)
+        # must not leave stale closures duplicating rows / pinning the DB.
+        plat.bus.unsubscribe(TOPIC_TRACE_INGESTED, _on_trace_ingested)
+        plat.bus.unsubscribe(TOPIC_CHILD_SAFETY, _on_child_safety)
+
+    app.on_cleanup.append(_unsubscribe)
     return app

@@ -133,3 +133,71 @@ def make_sharded_train_step(
         donate_argnums=(0, 1),
     )
     return step, init_state
+
+
+# ---------------------------------------------------------------------------
+# training loop + checkpointing (train → save → serve on the platform)
+# ---------------------------------------------------------------------------
+
+
+def save_checkpoint(params: Params, path: str) -> None:
+    """Orbax checkpoint of the param pytree; LlamaRuntime.load_checkpoint
+    (and KAKVEDA_LLAMA_CKPT) restore it for serving."""
+    import os
+
+    import orbax.checkpoint as ocp
+
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(os.path.abspath(str(path)), params)
+    ckptr.wait_until_finished()
+
+
+def corpus_to_batches(text: str, cfg: LlamaConfig, batch: int, seq_len: int):
+    """Tokenize a text corpus into as many [batch, seq_len] blocks as it
+    yields (wrapping), for the demo fine-tune loop."""
+    import numpy as np
+
+    from kakveda_tpu.models.tokenizer import ByteTokenizer
+
+    tok = ByteTokenizer()
+    ids = tok.encode(text)
+    need = batch * seq_len
+    n_blocks = max(1, len(ids) // need)
+    flat = np.resize(np.asarray(ids, np.int32), n_blocks * need)
+    return [
+        jnp.asarray(flat[i * need : (i + 1) * need].reshape(batch, seq_len))
+        for i in range(n_blocks)
+    ]
+
+
+def fit(
+    cfg: LlamaConfig,
+    corpus: str,
+    *,
+    steps: int = 50,
+    batch: int = 4,
+    seq_len: int = 128,
+    lr: float = 3e-4,
+    seed: int = 0,
+    checkpoint_path: Optional[str] = None,
+    log_every: int = 10,
+    log_fn=print,
+) -> tuple[Params, list[float]]:
+    """Small-scale causal-LM fit over a text corpus; returns (params,
+    per-step losses) and optionally saves an orbax checkpoint that
+    ``runtime=tpu`` serves directly."""
+    params = init_params(jax.random.PRNGKey(seed), cfg)
+    step, opt = make_train_step(cfg, make_optimizer(lr))
+    opt_state = opt.init(params)
+    batches = corpus_to_batches(corpus, cfg, batch, seq_len)
+    losses: list[float] = []
+    for i in range(steps):
+        tokens = batches[i % len(batches)]
+        params, opt_state, loss = step(params, opt_state, tokens)
+        losses.append(float(loss))
+        if log_every and (i + 1) % log_every == 0:
+            log_fn(f"step {i + 1}/{steps} loss {losses[-1]:.4f}")
+    if checkpoint_path:
+        save_checkpoint(params, checkpoint_path)
+        log_fn(f"checkpoint saved to {checkpoint_path}")
+    return params, losses

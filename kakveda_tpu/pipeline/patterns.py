@@ -76,7 +76,7 @@ class PatternDetector:
         from kakveda_tpu.ops.clustering import cluster_embeddings
 
         records = self.gfkb.list_failures()
-        if len(records) < 2:
+        if not records:
             return []
         vecs = self.gfkb.featurizer.encode_batch([r.signature_text for r in records])
         labels = cluster_embeddings(vecs, threshold=threshold)

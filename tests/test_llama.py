@@ -174,3 +174,22 @@ def test_generate_top_p_sampling():
         rng=jax.random.PRNGKey(2),
     )
     assert nucleus == greedy
+
+
+def test_fit_and_checkpoint_roundtrip(tmp_path):
+    from kakveda_tpu.models.generate import LlamaRuntime
+    from kakveda_tpu.models.train import fit
+
+    ckpt = str(tmp_path / "ckpt")
+    params, losses = fit(
+        CFG, "the platform remembers failures. " * 40,
+        steps=12, batch=2, seq_len=64, checkpoint_path=ckpt, log_every=0,
+        log_fn=lambda s: None,
+    )
+    assert losses[-1] < losses[0]
+
+    rt = LlamaRuntime(cfg=CFG, params=params)
+    expected = rt.generate("the platform", max_tokens=8).text
+    fresh = LlamaRuntime(cfg=CFG, seed=999)  # different init...
+    fresh.load_checkpoint(ckpt)              # ...restored from disk
+    assert fresh.generate("the platform", max_tokens=8).text == expected
