@@ -87,16 +87,21 @@ def setup(app: web.Application) -> None:
         )
         raise web.HTTPFound(f"/datasets/{ds_id}")
 
-    async def _run_one_example(ex: dict) -> dict:
-        """warn → generate → deterministic check → trace persist."""
+    async def _run_one_example(ex: dict, prewarned: bool = False) -> dict:
+        """warn → generate → deterministic check → trace persist.
+        ``prewarned=True`` when the caller already warned the whole dataset
+        in one batched device call."""
         trace_id = new_trace_id()
         t0 = time.time()
         from kakveda_tpu.dashboard.routes_main import off_loop
 
-        await off_loop(
-            plat.warn,
-            WarningRequest(app_id=ex["app_id"], agent_id="eval", prompt=ex["prompt"], tools=[], env={}),
-        )
+        if not prewarned:
+            await off_loop(
+                plat.warn,
+                WarningRequest(
+                    app_id=ex["app_id"], agent_id="eval", prompt=ex["prompt"], tools=[], env={}
+                ),
+            )
         gen = await off_loop(ctx.model.generate, ex["prompt"])
         passed = citation_check_passes(ex["prompt"], gen.text)
         # Rich trace row BEFORE plat.ingest — the trace.ingested subscriber
@@ -168,9 +173,25 @@ def setup(app: web.Application) -> None:
             " VALUES (?,?,?,?,0,'running')",
             (ds_id, time.time(), request["user"].email, len(examples)),
         )
+        # Pre-flight warns for the whole dataset in ONE device call
+        # (warn_batch = one compiled matmul+top-k), then generate+persist per
+        # example — the reference loops warn→generate one example at a time
+        # (reference: services/dashboard/app.py:2315-2393, noted in SURVEY
+        # §3.4 as the obvious batch-parallel target).
+        from kakveda_tpu.dashboard.routes_main import off_loop
+
+        await off_loop(
+            plat.warn_batch,
+            [
+                WarningRequest(
+                    app_id=ex["app_id"], agent_id="eval", prompt=ex["prompt"], tools=[], env={}
+                )
+                for ex in examples
+            ],
+        )
         passed = 0
         for ex in examples:
-            res = await _run_one_example(ex)
+            res = await _run_one_example(ex, prewarned=True)
             passed += int(res["passed"])
             ctx.db.execute(
                 "INSERT INTO evaluation_results (eval_run_id, example_id, trace_id, passed,"

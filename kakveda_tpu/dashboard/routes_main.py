@@ -383,6 +383,20 @@ def setup(app: web.Application) -> None:
     # playground
     # ------------------------------------------------------------------
 
+    # Model listing may hit the network (Ollama /api/tags, 3 s timeout);
+    # cache it so page loads and run re-renders don't pay that per request.
+    _models_cache: dict = {"ts": 0.0, "models": None}
+    _MODELS_TTL_S = 60.0
+
+    async def _get_models() -> list:
+        now = time.time()
+        if _models_cache["models"] is None or now - _models_cache["ts"] > _MODELS_TTL_S:
+            from kakveda_tpu.models.runtime import list_models
+
+            _models_cache["models"] = await off_loop(list_models, ctx.model)
+            _models_cache["ts"] = now
+        return _models_cache["models"]
+
     @require_login
     async def playground_page(request):
         agents = ctx.db.query("SELECT * FROM agent_registry WHERE enabled=1")
@@ -397,6 +411,7 @@ def setup(app: web.Application) -> None:
             agents=agents,
             prompts=prompts,
             experiments=experiments,
+            models=await _get_models(),
             result=None,
         )
 
@@ -434,7 +449,10 @@ def setup(app: web.Application) -> None:
                 text = f"agent error: {type(e).__name__}: {e}"
                 meta = {"provider": f"agent:{name}", "model": name, "error": str(e)}
         else:
-            gen = await off_loop(ctx.model.generate, prompt)
+            # target "model" (runtime default) or "model:<name>" (explicit
+            # model — reference's per-model variant, app.py:1226-1258).
+            chosen = target.split(":", 1)[1] if target.startswith("model:") else None
+            gen = await off_loop(lambda: ctx.model.generate(prompt, model=chosen))
             text, meta = gen.text, gen.meta
         t1 = time.time()
         tokens_in, tokens_out = estimate_tokens(prompt), estimate_tokens(text)
@@ -472,6 +490,7 @@ def setup(app: web.Application) -> None:
             agents=agents,
             prompts=[],
             experiments=ctx.db.query("SELECT * FROM experiments"),
+            models=await _get_models(),
             result={"text": text, "meta": meta, "trace_id": trace_id},
         )
 

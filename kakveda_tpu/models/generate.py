@@ -136,6 +136,9 @@ class LlamaRuntime:
         ckptr = ocp.StandardCheckpointer()
         self.params = ckptr.restore(path, self.params)
 
+    def list_models(self) -> list:
+        return [f"llama-{self.cfg.n_layers}L-{self.cfg.d_model}d"]
+
     def generate(self, prompt: str, *, model: Optional[str] = None, max_tokens: int = 64) -> GenerateResult:
         started = time.perf_counter()
         ids = self.tokenizer.encode(prompt)[-self.cfg.max_seq_len // 2 :]

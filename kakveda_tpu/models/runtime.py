@@ -50,6 +50,20 @@ class ModelRuntime(Protocol):
     def generate(self, prompt: str, *, model: Optional[str] = None, max_tokens: int = 256) -> GenerateResult: ...
 
 
+def list_models(runtime: "ModelRuntime") -> list:
+    """Model names the runtime can serve, for the playground dropdown
+    (reference: services/dashboard/app.py:286-306, Ollama /api/tags).
+    Runtimes advertise via a ``list_models`` method; anything else falls
+    back to a single entry."""
+    fn = getattr(runtime, "list_models", None)
+    if callable(fn):
+        try:
+            return list(fn()) or [getattr(runtime, "name", "model")]
+        except Exception:  # noqa: BLE001 — listing is best-effort
+            pass
+    return [getattr(runtime, "name", "model")]
+
+
 class StubRuntime:
     """Deterministic canned-response backend — the hermetic test model."""
 
@@ -57,6 +71,9 @@ class StubRuntime:
 
     def __init__(self, model_label: str = "stub"):
         self.model_label = model_label
+
+    def list_models(self) -> list:
+        return [self.model_label]
 
     def generate(self, prompt: str, *, model: Optional[str] = None, max_tokens: int = 256) -> GenerateResult:
         started = time.perf_counter()
@@ -82,6 +99,19 @@ class OllamaRuntime:
         self.model = model or os.environ.get("OLLAMA_MODEL", "llama3")
         self.timeout = timeout
         self._stub = StubRuntime()
+
+    def list_models(self) -> list:
+        """Installed Ollama models via /api/tags (reference:
+        services/dashboard/app.py:286-306); configured default on failure."""
+        import httpx
+
+        try:
+            r = httpx.get(f"{self.url}/api/tags", timeout=3.0)
+            r.raise_for_status()
+            names = [m.get("name") for m in r.json().get("models", []) if m.get("name")]
+            return names or [self.model]
+        except Exception:  # noqa: BLE001
+            return [self.model]
 
     def generate(self, prompt: str, *, model: Optional[str] = None, max_tokens: int = 256) -> GenerateResult:
         import httpx

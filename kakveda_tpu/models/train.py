@@ -152,7 +152,7 @@ def save_checkpoint(params: Params, path: str) -> None:
     ckptr.wait_until_finished()
 
 
-def corpus_to_batches(text: str, cfg: LlamaConfig, batch: int, seq_len: int):
+def corpus_to_batches(text: str, batch: int, seq_len: int):
     """Tokenize a text corpus into as many [batch, seq_len] blocks as it
     yields (wrapping), for the demo fine-tune loop."""
     import numpy as np
@@ -189,7 +189,7 @@ def fit(
     params = init_params(jax.random.PRNGKey(seed), cfg)
     step, opt = make_train_step(cfg, make_optimizer(lr))
     opt_state = opt.init(params)
-    batches = corpus_to_batches(corpus, cfg, batch, seq_len)
+    batches = corpus_to_batches(corpus, batch, seq_len)
     losses: list[float] = []
     for i in range(steps):
         tokens = batches[i % len(batches)]

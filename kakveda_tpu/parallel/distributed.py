@@ -1,0 +1,79 @@
+"""Multi-host initialization: one logical mesh spanning TPU slices.
+
+The reference's "distributed backend" is an HTTP pub/sub bus on one node
+(reference: services/event_bus/app.py:25-54; SURVEY §2.9/§5.8). Here the
+scaling backend is JAX's runtime: on a multi-host slice (or multiple
+slices over DCN), every host calls :func:`initialize_multihost` before
+touching devices, after which ``jax.devices()`` spans the whole pod and
+the platform's `Mesh` (row-sharded GFKB index, TP/DP/CP Llama) extends
+across hosts with XLA inserting ICI/DCN collectives — no NCCL/MPI code
+anywhere in this tree.
+
+Configuration (all three required to opt in, matching
+``jax.distributed.initialize``):
+
+- ``KAKVEDA_COORDINATOR``   — host:port of process 0
+- ``KAKVEDA_NUM_PROCESSES`` — world size
+- ``KAKVEDA_PROCESS_ID``    — this host's rank
+
+On TPU pods with standard metadata (GKE/QueuedResources), the variables
+may all be omitted AND ``KAKVEDA_MULTIHOST=auto`` set: jax.distributed
+then self-configures from the TPU environment.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+log = logging.getLogger("kakveda.distributed")
+
+
+def multihost_config() -> Optional[dict]:
+    """Parse env into initialize() kwargs; None when not configured.
+    Raises ValueError on a partial configuration — silently running
+    single-host when the operator set 2 of 3 variables would strand the
+    other hosts at a barrier."""
+    mh = os.environ.get("KAKVEDA_MULTIHOST", "").strip().lower()
+    coord = os.environ.get("KAKVEDA_COORDINATOR")
+    nproc = os.environ.get("KAKVEDA_NUM_PROCESSES")
+    pid = os.environ.get("KAKVEDA_PROCESS_ID")
+    if mh in ("auto", "1", "true", "yes"):
+        return {}  # jax.distributed self-configures from TPU metadata
+    if mh not in ("", "0", "false", "off", "no"):
+        # A typo'd opt-in must fail loudly — silently booting single-host
+        # strands every other pod host at the collective barrier.
+        raise ValueError(f"KAKVEDA_MULTIHOST={mh!r} not understood (use 'auto')")
+    present = [v is not None for v in (coord, nproc, pid)]
+    if not any(present):
+        return None
+    if not all(present):
+        raise ValueError(
+            "partial multi-host config: set all of KAKVEDA_COORDINATOR, "
+            "KAKVEDA_NUM_PROCESSES, KAKVEDA_PROCESS_ID (or KAKVEDA_MULTIHOST=auto)"
+        )
+    return {
+        "coordinator_address": coord,
+        "num_processes": int(nproc),
+        "process_id": int(pid),
+    }
+
+
+def initialize_multihost() -> bool:
+    """Initialize jax.distributed when configured; returns True when the
+    process joined a multi-host world. Must run before the first device
+    touch (mesh creation, jax.devices())."""
+    cfg = multihost_config()
+    if cfg is None:
+        return False
+    import jax
+
+    jax.distributed.initialize(**cfg)
+    log.info(
+        "multi-host initialized: process %d/%d, %d global devices",
+        jax.process_index(),
+        jax.process_count(),
+        len(jax.devices()),
+    )
+    return True

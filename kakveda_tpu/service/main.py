@@ -52,6 +52,12 @@ def run_server(
 ) -> int:
     setup_logging(service_name="kakveda-tpu")
     cfg = get_runtime_config(service_name="kakveda-tpu")
+
+    # Join the multi-host world (if configured) BEFORE the Platform builds
+    # its mesh — jax.devices() must already span the pod.
+    from kakveda_tpu.parallel.distributed import initialize_multihost
+
+    initialize_multihost()
     plat = Platform(data_dir=data_dir or cfg.data_dir, capacity=cfg.index_capacity)
 
     # Zero-code operator profiling: KAKVEDA_PROFILE_DIR=/path captures an

@@ -508,3 +508,21 @@ def test_evals_list_page(tmp_path):
             await client.close()
 
     run(go())
+
+
+def test_playground_model_selection(tmp_path):
+    async def go():
+        client = await _client(_mk_app(tmp_path))
+        try:
+            await _login(client)
+            page = await (await client.get("/playground")).text()
+            assert 'value="model:stub"' in page
+            r = await client.post(
+                "/playground/run", data={"prompt": "hi", "target": "model:stub"}
+            )
+            assert r.status == 200
+            assert "stub" in await r.text()
+        finally:
+            await client.close()
+
+    run(go())

@@ -225,3 +225,23 @@ def test_bus_durable_url_subscriptions(tmp_path):
         f.write('{"action": "subscribe", "topic": "x", "ur')
     bus3 = EventBus(persist_path=path)
     assert "x" not in bus3.topics()
+
+
+def test_multihost_config_parsing(monkeypatch):
+    from kakveda_tpu.parallel.distributed import multihost_config
+
+    for var in ("KAKVEDA_MULTIHOST", "KAKVEDA_COORDINATOR", "KAKVEDA_NUM_PROCESSES", "KAKVEDA_PROCESS_ID"):
+        monkeypatch.delenv(var, raising=False)
+    assert multihost_config() is None
+
+    monkeypatch.setenv("KAKVEDA_COORDINATOR", "host0:1234")
+    with pytest.raises(ValueError, match="partial multi-host"):
+        multihost_config()
+
+    monkeypatch.setenv("KAKVEDA_NUM_PROCESSES", "4")
+    monkeypatch.setenv("KAKVEDA_PROCESS_ID", "1")
+    cfg = multihost_config()
+    assert cfg == {"coordinator_address": "host0:1234", "num_processes": 4, "process_id": 1}
+
+    monkeypatch.setenv("KAKVEDA_MULTIHOST", "auto")
+    assert multihost_config() == {}
