@@ -50,6 +50,18 @@ from kakveda_tpu.ops.knn import ShardedKnn, batch_bucket
 from kakveda_tpu.parallel.mesh import create_mesh
 
 
+def _record_from_snapshot(obj: dict) -> dict:
+    """Snapshot rows are our own model_dump_json output: re-hydrate the two
+    non-JSON-native field types for model_construct (which skips the
+    validators that would otherwise do this)."""
+    from datetime import datetime
+
+    obj["created_at"] = datetime.fromisoformat(obj["created_at"])
+    obj["updated_at"] = datetime.fromisoformat(obj["updated_at"])
+    obj["impact_severity"] = Severity(obj["impact_severity"])
+    return obj
+
+
 class GFKB:
     """Failure + pattern store with a device-resident similarity index."""
 
@@ -128,29 +140,44 @@ class GFKB:
         self._logs.clear()
 
     def _replay(self) -> None:
-        """Rebuild host metadata + device index from the append logs."""
+        """Rebuild host metadata + device index from the append logs,
+        fast-forwarding through a snapshot when one is valid (startup at
+        1M rows is dominated by re-embedding + re-parsing otherwise)."""
         if self.failures_path.exists():
+            tail_offset = self._restore_snapshot()
             latest: Dict[Tuple[str, str], CanonicalFailureRecord] = {}
             order: List[Tuple[str, str]] = []
-            for line in self.failures_path.read_text(encoding="utf-8").splitlines():
-                if not line.strip():
-                    continue
-                rec = CanonicalFailureRecord.model_validate(json.loads(line))
-                key = (rec.failure_type, rec.signature_text)
-                if key not in latest:
-                    order.append(key)
-                latest[key] = rec
+            with self.failures_path.open("r", encoding="utf-8") as f:
+                if tail_offset:
+                    f.seek(tail_offset)
+                for line in f:
+                    if not line.strip():
+                        continue
+                    rec = CanonicalFailureRecord.model_validate(json.loads(line))
+                    key = (rec.failure_type, rec.signature_text)
+                    if key in self._slot_by_key:  # snapshot row updated in tail
+                        self._records[self._slot_by_key[key]] = rec
+                        self._apps_by_type.setdefault(rec.failure_type, set()).update(
+                            rec.affected_apps
+                        )
+                        continue
+                    if key not in latest:
+                        order.append(key)
+                    latest[key] = rec
             if order:
-                self._records = [latest[k] for k in order]
-                self._slot_by_key = {k: i for i, k in enumerate(order)}
-                for rec in self._records:
+                base = len(self._records)
+                self._records.extend(latest[k] for k in order)
+                for i, k in enumerate(order):
+                    self._slot_by_key[k] = base + i
+                for k in order:
+                    rec = latest[k]
                     self._ids_by_type.setdefault(rec.failure_type, []).append(rec.failure_id)
                     self._apps_by_type.setdefault(rec.failure_type, set()).update(
                         rec.affected_apps
                     )
                 vecs = self.featurizer.encode_batch([latest[k].signature_text for k in order])
-                self._ensure_capacity(len(order))
-                slots = np.arange(len(order), dtype=np.int32)
+                self._ensure_capacity(len(self._records))
+                slots = np.arange(base, base + len(order), dtype=np.int32)
                 self._emb, self._valid = self._knn.insert(self._emb, self._valid, vecs, slots)
 
         if self.patterns_path.exists():
@@ -159,6 +186,124 @@ class GFKB:
                     continue
                 p = PatternEntity.model_validate(json.loads(line))
                 self._patterns[p.name] = p
+
+    # --- snapshot / restore --------------------------------------------
+
+    _SNAPSHOT_VERSION = 1
+    _TAIL_HASH_BYTES = 4096
+
+    def _snapshot_dir(self) -> Path:
+        return self.data_dir / "snapshot"
+
+    def _log_prefix_hash(self, offset: int) -> str:
+        """sha256 of the last ≤4KB of failures.jsonl before ``offset`` —
+        cheap integrity check that the log the snapshot covered is still
+        the same log (purge-demo rewrites it, for instance)."""
+        import hashlib
+
+        start = max(0, offset - self._TAIL_HASH_BYTES)
+        with self.failures_path.open("rb") as f:
+            f.seek(start)
+            return hashlib.sha256(f.read(offset - start)).hexdigest()
+
+    def snapshot(self) -> Path:
+        """Write an atomic point-in-time snapshot: slot-ordered embedding
+        rows (no re-embed on restore) + pre-serialized records (no pydantic
+        re-validate) + a manifest pinning the covered failures.jsonl byte
+        range. Restore replays only the log tail written after it."""
+        import shutil
+        import tempfile
+
+        # Capture a consistent view under the lock (records are replaced,
+        # never mutated, so a list copy pins the point-in-time state), then
+        # do the tens-of-seconds disk write WITHOUT the lock — a live
+        # service's warn/ingest path must not stall behind a snapshot.
+        with self._lock:
+            self._flush_logs()
+            records = list(self._records)
+            n = len(records)
+            offset = self.failures_path.stat().st_size if self.failures_path.exists() else 0
+            vecs = self._knn.gather_slots(self._emb, np.arange(n, dtype=np.int32))
+            log_hash = self._log_prefix_hash(offset) if offset else ""
+
+        sd = self._snapshot_dir()
+        tmp = Path(tempfile.mkdtemp(dir=self.data_dir, prefix=".snapshot-"))
+        try:
+            np.save(tmp / "vectors.npy", vecs)
+            with (tmp / "records.jsonl").open("w", encoding="utf-8") as f:
+                f.write("".join(r.model_dump_json() + "\n" for r in records))
+            (tmp / "manifest.json").write_text(
+                json.dumps(
+                    {
+                        "version": self._SNAPSHOT_VERSION,
+                        "n": n,
+                        "dim": self._knn.dim,
+                        "log_offset": offset,
+                        "log_hash": log_hash,
+                    }
+                )
+            )
+            if sd.exists():
+                shutil.rmtree(sd)
+            tmp.rename(sd)
+        except BaseException:
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise
+        return sd
+
+    def _restore_snapshot(self) -> int:
+        """Load a valid snapshot; returns the failures.jsonl byte offset to
+        replay from (0 = no usable snapshot, full replay)."""
+        sd = self._snapshot_dir()
+        manifest_path = sd / "manifest.json"
+        if not manifest_path.exists():
+            return 0
+        try:
+            manifest = json.loads(manifest_path.read_text())
+            if manifest.get("version") != self._SNAPSHOT_VERSION:
+                return 0
+            if manifest.get("dim") != self._knn.dim:
+                return 0
+            offset = int(manifest.get("log_offset", 0))
+            size = self.failures_path.stat().st_size if self.failures_path.exists() else 0
+            if size < offset:
+                return 0  # log truncated/rewritten since the snapshot
+            if offset and self._log_prefix_hash(offset) != manifest.get("log_hash"):
+                return 0  # log rewritten in place (e.g. purge) — full replay
+            n = int(manifest["n"])
+            records = []
+            with (sd / "records.jsonl").open("r", encoding="utf-8") as f:
+                for line in f:
+                    if line.strip():
+                        # our own snapshot — construct without re-validation
+                        records.append(
+                            CanonicalFailureRecord.model_construct(
+                                **_record_from_snapshot(json.loads(line))
+                            )
+                        )
+            if len(records) != n:
+                return 0
+            vecs = np.load(sd / "vectors.npy")
+            if vecs.shape != (n, self._knn.dim):
+                return 0
+        except Exception:  # noqa: BLE001 — any corruption ⇒ full replay
+            return 0
+        # Grow the index BEFORE installing the records: _ensure_capacity
+        # re-embeds from self._records on growth, which would re-do exactly
+        # the work the snapshot vectors exist to skip.
+        self._ensure_capacity(n)
+        self._records = records
+        self._slot_by_key = {
+            (r.failure_type, r.signature_text): i for i, r in enumerate(records)
+        }
+        for r in records:
+            self._ids_by_type.setdefault(r.failure_type, []).append(r.failure_id)
+            self._apps_by_type.setdefault(r.failure_type, set()).update(r.affected_apps)
+        if n:
+            self._emb, self._valid = self._knn.insert(
+                self._emb, self._valid, vecs, np.arange(n, dtype=np.int32)
+            )
+        return int(manifest["log_offset"])
 
     def reload(self) -> None:
         """Drop all in-memory/device state and replay the append logs.

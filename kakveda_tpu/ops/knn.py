@@ -132,6 +132,9 @@ class ShardedKnn:
             self._repl = NamedSharding(mesh, P())
             self._topk = jax.jit(self._topk_impl)
         self._insert = jax.jit(self._insert_impl, donate_argnums=(0, 1))
+        # Persistent jit (shape-keyed cache) for the snapshot gather — a
+        # fresh wrapper per call would recompile every snapshot.
+        self._gather = jax.jit(lambda e, p: e[p].astype(jnp.float32))
 
     # --- allocation ------------------------------------------------------
 
@@ -164,6 +167,17 @@ class ShardedKnn:
         phys = slot_to_physical(np.asarray(slots, dtype=np.int32), self.n_shards, self.rows_per_shard)
         vecs = jnp.asarray(vecs, dtype=jnp.float32)
         return self._insert(emb, valid, vecs, jnp.asarray(phys))
+
+    def gather_slots(self, emb: jax.Array, slots: np.ndarray) -> np.ndarray:
+        """Host copy of the embedding rows for logical ``slots`` (snapshot
+        path). Chunked so a 1M-row gather never materializes a second
+        full-size host buffer at once."""
+        phys = slot_to_physical(np.asarray(slots, dtype=np.int32), self.n_shards, self.rows_per_shard)
+        out = np.empty((len(phys), self.dim), dtype=np.float32)
+        chunk = 1 << 16
+        for i in range(0, len(phys), chunk):
+            out[i : i + chunk] = np.asarray(self._gather(emb, jnp.asarray(phys[i : i + chunk])))
+        return out
 
     # --- match -----------------------------------------------------------
 

@@ -39,25 +39,28 @@ def multihost_config() -> Optional[dict]:
     coord = os.environ.get("KAKVEDA_COORDINATOR")
     nproc = os.environ.get("KAKVEDA_NUM_PROCESSES")
     pid = os.environ.get("KAKVEDA_PROCESS_ID")
-    if mh in ("auto", "1", "true", "yes"):
-        return {}  # jax.distributed self-configures from TPU metadata
-    if mh not in ("", "0", "false", "off", "no"):
+    if mh in ("0", "false", "off", "no"):
+        return None  # explicit kill switch, even with coordinator vars set
+    if mh not in ("", "auto", "1", "true", "yes"):
         # A typo'd opt-in must fail loudly — silently booting single-host
         # strands every other pod host at the collective barrier.
-        raise ValueError(f"KAKVEDA_MULTIHOST={mh!r} not understood (use 'auto')")
+        raise ValueError(f"KAKVEDA_MULTIHOST={mh!r} not understood (use 'auto' or 0)")
+    enabled = mh != ""
     present = [v is not None for v in (coord, nproc, pid)]
-    if not any(present):
-        return None
-    if not all(present):
+    if all(present):
+        # Explicit coordinator config always wins over metadata autodetect.
+        return {
+            "coordinator_address": coord,
+            "num_processes": int(nproc),
+            "process_id": int(pid),
+        }
+    if any(present):
         raise ValueError(
             "partial multi-host config: set all of KAKVEDA_COORDINATOR, "
             "KAKVEDA_NUM_PROCESSES, KAKVEDA_PROCESS_ID (or KAKVEDA_MULTIHOST=auto)"
         )
-    return {
-        "coordinator_address": coord,
-        "num_processes": int(nproc),
-        "process_id": int(pid),
-    }
+    # No explicit vars: opt-in flag means TPU-metadata autodetect.
+    return {} if enabled else None
 
 
 def initialize_multihost() -> bool:

@@ -194,6 +194,15 @@ def make_app(platform: Optional[Platform] = None, **platform_kw) -> web.Applicat
         n = plat.bus.subscribe(topic, cb)
         return web.json_response({"ok": True, "topic": topic, "subscribers": n})
 
+    async def snapshot(request):
+        """Point-in-time GFKB snapshot: restart restores it and replays only
+        the log tail (startup at 1M rows drops from minutes to seconds)."""
+        import asyncio as _asyncio
+
+        loop = _asyncio.get_running_loop()
+        path = await loop.run_in_executor(None, plat.gfkb.snapshot)
+        return web.json_response({"ok": True, "path": str(path), "entries": plat.gfkb.count})
+
     async def mine_patterns(request):
         """Batch pattern mining: device-side clustering over the full GFKB
         embedding matrix (the batch job the reference never had). Body:
@@ -245,6 +254,7 @@ def make_app(platform: Optional[Platform] = None, **platform_kw) -> web.Applicat
             web.get("/patterns", list_patterns),
             web.post("/patterns/upsert", upsert_pattern),
             web.post("/patterns/mine", mine_patterns),
+            web.post("/snapshot", snapshot),
             web.get("/health/{app_id}", app_health),
             web.post("/subscribe", subscribe),
             web.post("/unsubscribe", unsubscribe),

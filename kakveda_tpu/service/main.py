@@ -42,6 +42,13 @@ async def _serve(
             await asyncio.sleep(3600)
     finally:
         await api_runner.cleanup()
+        # Graceful shutdown snapshot: the next start restores it and replays
+        # only the log tail instead of re-embedding the whole GFKB.
+        try:
+            plat.gfkb.snapshot()
+            log.info("gfkb snapshot written (%d entries)", plat.gfkb.count)
+        except Exception as e:  # noqa: BLE001 — shutdown must not fail on this
+            log.warning("shutdown snapshot failed: %s", e)
 
 
 def run_server(

@@ -203,3 +203,84 @@ def test_concurrent_upserts_and_match(tmp_path):
     ids, apps = gfkb.type_aggregate("HALLUCINATION_CITATION")
     assert len(ids) == len(recs)
     assert len(apps) == n_threads
+
+
+def _seed(gfkb, n, tag="s"):
+    gfkb.upsert_failures_batch([
+        {
+            "failure_type": "HALLUCINATION_CITATION",
+            "signature_text": f"intent:citations_required | {tag} doc {i} references",
+            "app_id": f"app-{i % 5}",
+            "impact_severity": "medium",
+        }
+        for i in range(n)
+    ])
+
+
+def test_snapshot_restore_and_tail_replay(tmp_path, monkeypatch):
+    gfkb = GFKB(data_dir=tmp_path, capacity=512, dim=1024)
+    _seed(gfkb, 100, "base")
+    gfkb.snapshot()
+    _seed(gfkb, 20, "tail")  # written after the snapshot
+    n_total = gfkb.count
+    pre_match = gfkb.match("intent:citations_required | base doc 7 references")
+    gfkb.close()
+
+    # restore: snapshot rows must NOT be re-embedded (only the 20-row tail)
+    import kakveda_tpu.ops.featurizer as feat_mod
+
+    calls = []
+    orig = feat_mod.HashedNGramFeaturizer.encode_batch
+
+    def counting(self, texts):
+        calls.append(len(texts))
+        return orig(self, texts)
+
+    monkeypatch.setattr(feat_mod.HashedNGramFeaturizer, "encode_batch", counting)
+    g2 = GFKB(data_dir=tmp_path, capacity=512, dim=1024)
+    assert g2.count == n_total
+    assert sum(calls) == 20, calls  # tail only
+    ids, apps = g2.type_aggregate("HALLUCINATION_CITATION")
+    assert len(ids) == n_total and len(apps) == 5
+    post_match = g2.match("intent:citations_required | base doc 7 references")
+    assert post_match[0].failure_id == pre_match[0].failure_id
+    g2.close()
+
+
+def test_snapshot_invalidated_by_log_rewrite(tmp_path):
+    gfkb = GFKB(data_dir=tmp_path, capacity=256, dim=1024)
+    _seed(gfkb, 30)
+    gfkb.snapshot()
+    gfkb.close()
+    # rewrite the log in place (what purge-demo does): keep only 10 rows
+    lines = (tmp_path / "failures.jsonl").read_text().splitlines()
+    (tmp_path / "failures.jsonl").write_text("\n".join(lines[:10]) + "\n")
+
+    g2 = GFKB(data_dir=tmp_path, capacity=256, dim=1024)
+    assert g2.count == 10  # stale snapshot rejected, full replay of new log
+    g2.close()
+
+
+def test_snapshot_tail_update_of_snapshotted_record(tmp_path):
+    gfkb = GFKB(data_dir=tmp_path, capacity=256, dim=1024)
+    gfkb.upsert_failure(
+        failure_type="HALLUCINATION_CITATION",
+        signature_text="sig one citations",
+        app_id="app-A",
+        impact_severity=Severity.medium,
+    )
+    gfkb.snapshot()
+    # version bump of the SAME record lands in the tail
+    gfkb.upsert_failure(
+        failure_type="HALLUCINATION_CITATION",
+        signature_text="sig one citations",
+        app_id="app-B",
+        impact_severity=Severity.medium,
+    )
+    gfkb.close()
+
+    g2 = GFKB(data_dir=tmp_path, capacity=256, dim=1024)
+    assert g2.count == 1
+    rec = g2.list_failures()[0]
+    assert rec.version == 2 and sorted(rec.affected_apps) == ["app-A", "app-B"]
+    g2.close()

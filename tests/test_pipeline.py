@@ -240,8 +240,22 @@ def test_multihost_config_parsing(monkeypatch):
 
     monkeypatch.setenv("KAKVEDA_NUM_PROCESSES", "4")
     monkeypatch.setenv("KAKVEDA_PROCESS_ID", "1")
-    cfg = multihost_config()
-    assert cfg == {"coordinator_address": "host0:1234", "num_processes": 4, "process_id": 1}
+    explicit = {"coordinator_address": "host0:1234", "num_processes": 4, "process_id": 1}
+    assert multihost_config() == explicit
 
+    # flag + complete explicit config: explicit wins over autodetect
+    monkeypatch.setenv("KAKVEDA_MULTIHOST", "1")
+    assert multihost_config() == explicit
+    # kill switch disables even with explicit vars exported
+    monkeypatch.setenv("KAKVEDA_MULTIHOST", "0")
+    assert multihost_config() is None
+    # typo fails loudly
+    monkeypatch.setenv("KAKVEDA_MULTIHOST", "yse")
+    with pytest.raises(ValueError, match="not understood"):
+        multihost_config()
+
+    # autodetect path: flag alone, no explicit vars
+    for var in ("KAKVEDA_COORDINATOR", "KAKVEDA_NUM_PROCESSES", "KAKVEDA_PROCESS_ID"):
+        monkeypatch.delenv(var, raising=False)
     monkeypatch.setenv("KAKVEDA_MULTIHOST", "auto")
     assert multihost_config() == {}
