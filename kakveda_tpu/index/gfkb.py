@@ -29,6 +29,7 @@ Deliberate deviations from the reference, both documented here:
 from __future__ import annotations
 
 import json
+import os
 import threading
 from pathlib import Path
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -92,6 +93,7 @@ class GFKB:
         self._records: List[CanonicalFailureRecord] = []
         self._slot_by_key: Dict[Tuple[str, str], int] = {}
         self._patterns: Dict[str, PatternEntity] = {}  # name -> latest
+        self._snapshot_write_lock = threading.Lock()
         # Per-type aggregates maintained incrementally at upsert so pattern
         # detection reads them O(1) instead of rescanning every record per
         # batch (O(N²) over a failure stream).
@@ -214,42 +216,53 @@ class GFKB:
         import shutil
         import tempfile
 
-        # Capture a consistent view under the lock (records are replaced,
-        # never mutated, so a list copy pins the point-in-time state), then
-        # do the tens-of-seconds disk write WITHOUT the lock — a live
-        # service's warn/ingest path must not stall behind a snapshot.
-        with self._lock:
-            self._flush_logs()
-            records = list(self._records)
-            n = len(records)
-            offset = self.failures_path.stat().st_size if self.failures_path.exists() else 0
-            vecs = self._knn.gather_slots(self._emb, np.arange(n, dtype=np.int32))
-            log_hash = self._log_prefix_hash(offset) if offset else ""
+        # Capture a consistent view under the data lock: records list copy
+        # (records are replaced, never mutated) + a device-side HBM copy of
+        # the embedding buffer (fast). The slow parts — the multi-GB host
+        # transfer and the disk write — run WITHOUT the data lock so a live
+        # service's warn/ingest path doesn't stall. A separate snapshot lock
+        # serializes concurrent snapshot() calls (endpoint + shutdown).
+        with self._snapshot_write_lock:
+            with self._lock:
+                self._flush_logs()
+                records = list(self._records)
+                n = len(records)
+                offset = self.failures_path.stat().st_size if self.failures_path.exists() else 0
+                emb_copy = self._knn.device_copy(self._emb)
+                log_hash = self._log_prefix_hash(offset) if offset else ""
 
-        sd = self._snapshot_dir()
-        tmp = Path(tempfile.mkdtemp(dir=self.data_dir, prefix=".snapshot-"))
-        try:
-            np.save(tmp / "vectors.npy", vecs)
-            with (tmp / "records.jsonl").open("w", encoding="utf-8") as f:
-                f.write("".join(r.model_dump_json() + "\n" for r in records))
-            (tmp / "manifest.json").write_text(
-                json.dumps(
-                    {
-                        "version": self._SNAPSHOT_VERSION,
-                        "n": n,
-                        "dim": self._knn.dim,
-                        "log_offset": offset,
-                        "log_hash": log_hash,
-                    }
+            vecs = self._knn.gather_slots(emb_copy, np.arange(n, dtype=np.int32))
+            del emb_copy
+            sd = self._snapshot_dir()
+            tmp = Path(tempfile.mkdtemp(dir=self.data_dir, prefix=".snapshot-"))
+            old = self.data_dir / f".snapshot-old-{os.getpid()}-{id(tmp)}"
+            try:
+                np.save(tmp / "vectors.npy", vecs)
+                with (tmp / "records.jsonl").open("w", encoding="utf-8") as f:
+                    f.writelines(r.model_dump_json() + "\n" for r in records)
+                (tmp / "manifest.json").write_text(
+                    json.dumps(
+                        {
+                            "version": self._SNAPSHOT_VERSION,
+                            "n": n,
+                            "dim": self._knn.dim,
+                            "log_offset": offset,
+                            "log_hash": log_hash,
+                        }
+                    )
                 )
-            )
-            if sd.exists():
-                shutil.rmtree(sd)
-            tmp.rename(sd)
-        except BaseException:
-            shutil.rmtree(tmp, ignore_errors=True)
-            raise
-        return sd
+                # Swap via renames: a crash mid-swap leaves at worst no
+                # snapshot (full replay fallback), never a half-written one.
+                if sd.exists():
+                    sd.rename(old)
+                tmp.rename(sd)
+                shutil.rmtree(old, ignore_errors=True)
+            except BaseException:
+                shutil.rmtree(tmp, ignore_errors=True)
+                if old.exists() and not sd.exists():
+                    old.rename(sd)  # restore the previous snapshot
+                raise
+            return sd
 
     def _restore_snapshot(self) -> int:
         """Load a valid snapshot; returns the failures.jsonl byte offset to
@@ -336,6 +349,17 @@ class GFKB:
     def list_failures(self) -> List[CanonicalFailureRecord]:
         with self._lock:
             return list(self._records)
+
+    def records_and_embeddings(self) -> Tuple[List[CanonicalFailureRecord], np.ndarray]:
+        """Consistent (records, slot-aligned embedding rows) pair — captured
+        atomically so a concurrent reload() (purge) can't misalign row i
+        with records[i]. The slow host transfer happens after the lock via a
+        device-side buffer copy."""
+        with self._lock:
+            records = list(self._records)
+            emb_copy = self._knn.device_copy(self._emb)
+        vecs = self._knn.gather_slots(emb_copy, np.arange(len(records), dtype=np.int32))
+        return records, vecs
 
     def type_aggregate(self, failure_type: str) -> Tuple[List[str], List[str]]:
         """(failure_ids in insertion order, sorted affected apps) for a type

@@ -135,6 +135,12 @@ class ShardedKnn:
         # Persistent jit (shape-keyed cache) for the snapshot gather — a
         # fresh wrapper per call would recompile every snapshot.
         self._gather = jax.jit(lambda e, p: e[p].astype(jnp.float32))
+        self._copy = jax.jit(jnp.copy)
+
+    def device_copy(self, emb: jax.Array) -> jax.Array:
+        """Device-side copy of the embedding buffer (fast HBM copy) so
+        callers can release their lock before the slow host transfer."""
+        return self._copy(emb)
 
     # --- allocation ------------------------------------------------------
 

@@ -54,13 +54,16 @@ def multihost_config() -> Optional[dict]:
             "num_processes": int(nproc),
             "process_id": int(pid),
         }
+    if enabled:
+        # Autodetect was requested: a stray partial var (orchestrators often
+        # export one of them) must not block boot — metadata wins.
+        return {}
     if any(present):
         raise ValueError(
             "partial multi-host config: set all of KAKVEDA_COORDINATOR, "
             "KAKVEDA_NUM_PROCESSES, KAKVEDA_PROCESS_ID (or KAKVEDA_MULTIHOST=auto)"
         )
-    # No explicit vars: opt-in flag means TPU-metadata autodetect.
-    return {} if enabled else None
+    return None
 
 
 def initialize_multihost() -> bool:

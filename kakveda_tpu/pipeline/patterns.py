@@ -75,10 +75,14 @@ class PatternDetector:
         """
         from kakveda_tpu.ops.clustering import cluster_embeddings
 
-        records = self.gfkb.list_failures()
+        # Reuse the device-resident index rows (one gather) instead of
+        # re-embedding every signature on host — at 1M records the re-embed
+        # costs minutes, the gather costs a device copy. Captured atomically
+        # with the record list so a concurrent purge/reload can't misalign
+        # rows with records.
+        records, vecs = self.gfkb.records_and_embeddings()
         if not records:
             return []
-        vecs = self.gfkb.featurizer.encode_batch([r.signature_text for r in records])
         labels = cluster_embeddings(vecs, threshold=threshold)
 
         groups: Dict[int, List[int]] = defaultdict(list)
