@@ -94,6 +94,10 @@ class GFKB:
         self._slot_by_key: Dict[Tuple[str, str], int] = {}
         self._patterns: Dict[str, PatternEntity] = {}  # name -> latest
         self._snapshot_write_lock = threading.Lock()
+        # Bumped by reload(); snapshot() aborts if it changed mid-write so a
+        # purge (external log rewrite + reload) can't race a snapshot into
+        # resurrecting pre-purge records.
+        self._generation = 0
         # Per-type aggregates maintained incrementally at upsert so pattern
         # detection reads them O(1) instead of rescanning every record per
         # batch (O(N²) over a failure stream).
@@ -222,16 +226,23 @@ class GFKB:
         # transfer and the disk write — run WITHOUT the data lock so a live
         # service's warn/ingest path doesn't stall. A separate snapshot lock
         # serializes concurrent snapshot() calls (endpoint + shutdown).
+        if not self.persist:
+            raise RuntimeError("snapshot requires a persistent GFKB (persist=True)")
         with self._snapshot_write_lock:
             with self._lock:
                 self._flush_logs()
                 records = list(self._records)
                 n = len(records)
                 offset = self.failures_path.stat().st_size if self.failures_path.exists() else 0
-                emb_copy = self._knn.device_copy(self._emb)
+                # Capture the knn alongside the buffer: a concurrent growth
+                # re-shard swaps self._knn and would decode emb_copy's
+                # layout with the wrong rows_per_shard.
+                knn = self._knn
+                emb_copy = knn.device_copy(self._emb)
                 log_hash = self._log_prefix_hash(offset) if offset else ""
+                generation = self._generation
 
-            vecs = self._knn.gather_slots(emb_copy, np.arange(n, dtype=np.int32))
+            vecs = knn.gather_slots(emb_copy, np.arange(n, dtype=np.int32))
             del emb_copy
             sd = self._snapshot_dir()
             tmp = Path(tempfile.mkdtemp(dir=self.data_dir, prefix=".snapshot-"))
@@ -245,17 +256,23 @@ class GFKB:
                         {
                             "version": self._SNAPSHOT_VERSION,
                             "n": n,
-                            "dim": self._knn.dim,
+                            "dim": knn.dim,
                             "log_offset": offset,
                             "log_hash": log_hash,
                         }
                     )
                 )
-                # Swap via renames: a crash mid-swap leaves at worst no
-                # snapshot (full replay fallback), never a half-written one.
-                if sd.exists():
-                    sd.rename(old)
-                tmp.rename(sd)
+                # Swap via renames under the data lock: serialized with
+                # reload(), and a crash mid-swap leaves at worst no snapshot
+                # (full replay fallback), never a half-written one.
+                with self._lock:
+                    if self._generation != generation:
+                        raise RuntimeError(
+                            "GFKB was reloaded during snapshot; snapshot aborted — retry"
+                        )
+                    if sd.exists():
+                        sd.rename(old)
+                    tmp.rename(sd)
                 shutil.rmtree(old, ignore_errors=True)
             except BaseException:
                 shutil.rmtree(tmp, ignore_errors=True)
@@ -316,16 +333,22 @@ class GFKB:
             self._emb, self._valid = self._knn.insert(
                 self._emb, self._valid, vecs, np.arange(n, dtype=np.int32)
             )
-        return int(manifest["log_offset"])
+        return offset
 
     def reload(self) -> None:
         """Drop all in-memory/device state and replay the append logs.
 
         Required after any external rewrite of the JSONL files (e.g. the
         dashboard's purge-demo flow) so the device index, id minting and
-        host metadata stay consistent with the log.
+        host metadata stay consistent with the log. Any existing snapshot
+        describes the pre-rewrite state and is deleted; an in-flight
+        snapshot is aborted via the generation bump.
         """
-        with self._lock:
+        import shutil
+
+        with self._snapshot_write_lock, self._lock:
+            self._generation += 1
+            shutil.rmtree(self._snapshot_dir(), ignore_errors=True)
             # Reopen the append logs: an external rewrite may have replaced
             # the files (new inode), and a held fd would append to the old one.
             self.close()
@@ -357,8 +380,9 @@ class GFKB:
         device-side buffer copy."""
         with self._lock:
             records = list(self._records)
-            emb_copy = self._knn.device_copy(self._emb)
-        vecs = self._knn.gather_slots(emb_copy, np.arange(len(records), dtype=np.int32))
+            knn = self._knn  # growth re-shard swaps the knn; pair it with the buffer
+            emb_copy = knn.device_copy(self._emb)
+        vecs = knn.gather_slots(emb_copy, np.arange(len(records), dtype=np.int32))
         return records, vecs
 
     def type_aggregate(self, failure_type: str) -> Tuple[List[str], List[str]]:

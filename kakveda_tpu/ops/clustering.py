@@ -1,18 +1,22 @@
 """Device-side clustering of failure embeddings.
 
 Connected components of the threshold cosine-similarity graph, computed by
-iterative min-label propagation — every step is a masked matmul-shaped op
-that XLA maps onto the MXU/VPU, with a ``lax.while_loop`` until fixpoint:
+iterative min-label propagation — every step is matmul-shaped work that XLA
+maps onto the MXU, with a ``lax.while_loop`` until fixpoint:
 
-    A      = (E @ E^T) >= threshold          # adjacency, [N, N]
-    l_i    <- min_j { l_j : A[i, j] }        # propagate smallest label
+    l_i <- min over j with cos(v_i, v_j) >= t of l_j
     repeat until no label changes (≤ graph diameter iterations)
 
-This replaces "pattern detection" as a group-by on failure_type
-(reference: services/pattern_detector/app.py:40-47) with actual similarity
-clustering over the index embeddings. Intended as a periodic batch job over
-up to ~100k canonical failures (N² adjacency); larger indexes should mine
-patterns over a recent window.
+Two tiers sharing the same math:
+
+- dense (N ≤ _DENSE_MAX): one [N, N] adjacency in memory;
+- blocked (any N): the similarity matrix is never materialized — each
+  iteration scans column blocks, computing ``v @ v_blockᵀ`` [N, B] tiles
+  and folding a running per-row min of neighbor labels. Memory is O(N·B)
+  instead of O(N²), so mining runs over the full GFKB at 1M rows (the
+  reference's pattern detector is a group-by on failure_type,
+  services/pattern_detector/app.py:40-47 — no similarity clustering at
+  all).
 """
 
 from __future__ import annotations
@@ -20,6 +24,10 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+_DENSE_MAX = 8192
+_BLOCK = 1024
+_BIG = jnp.iinfo(jnp.int32).max
 
 
 @jax.jit
@@ -34,9 +42,45 @@ def _propagate_labels(adj: jax.Array) -> jax.Array:
     def body(state):
         labels, _, it = state
         # min over neighbors' labels (self-edge keeps own label).
-        big = jnp.iinfo(jnp.int32).max
-        neigh = jnp.where(adj, labels[None, :], big)
+        neigh = jnp.where(adj, labels[None, :], _BIG)
         new = jnp.minimum(labels, jnp.min(neigh, axis=1))
+        return new, jnp.any(new != labels), it + 1
+
+    labels, _, _ = jax.lax.while_loop(cond, body, (init, jnp.bool_(True), jnp.int32(0)))
+    return labels
+
+
+@jax.jit
+def _propagate_labels_blocked(v: jax.Array, threshold: jax.Array) -> jax.Array:
+    """Blocked fixpoint: v is [Np, d] with Np a multiple of _BLOCK; padding
+    rows are zero (zero-norm ⇒ cosine 0 ⇒ below any positive threshold ⇒
+    isolated), so no row count argument is needed — and compile cache keys
+    change only per padded shape, not per exact record count."""
+    np_rows = v.shape[0]
+    init = jnp.arange(np_rows, dtype=jnp.int32)
+    vb = v.reshape(np_rows // _BLOCK, _BLOCK, v.shape[1])
+
+    def one_iteration(labels):
+        lb = labels.reshape(np_rows // _BLOCK, _BLOCK)
+
+        def scan_block(running_min, block):
+            vj, lj = block
+            sims = jax.lax.dot_general(
+                v, vj, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            )  # [Np, B]
+            neigh = jnp.where(sims >= threshold, lj[None, :], _BIG)
+            return jnp.minimum(running_min, jnp.min(neigh, axis=1)), None
+
+        mins, _ = jax.lax.scan(scan_block, jnp.full((np_rows,), _BIG, jnp.int32), (vb, lb))
+        return jnp.minimum(labels, mins)
+
+    def cond(state):
+        labels, changed, it = state
+        return jnp.logical_and(changed, it < np_rows)
+
+    def body(state):
+        labels, _, it = state
+        new = one_iteration(labels)
         return new, jnp.any(new != labels), it + 1
 
     labels, _, _ = jax.lax.while_loop(cond, body, (init, jnp.bool_(True), jnp.int32(0)))
@@ -50,8 +94,16 @@ def cluster_embeddings(vecs: np.ndarray, threshold: float = 0.6) -> np.ndarray:
     (the smallest member index).
     """
     v = jnp.asarray(vecs, dtype=jnp.float32)
-    sims = v @ v.T
-    adj = sims >= threshold
-    # Ensure self-edges so isolated rows keep their own label.
-    adj = jnp.logical_or(adj, jnp.eye(v.shape[0], dtype=bool))
-    return np.asarray(_propagate_labels(adj))
+    n = v.shape[0]
+    if n <= _DENSE_MAX:
+        sims = v @ v.T
+        adj = sims >= threshold
+        # Ensure self-edges so isolated rows keep their own label.
+        adj = jnp.logical_or(adj, jnp.eye(n, dtype=bool))
+        return np.asarray(_propagate_labels(adj))
+
+    pad = (-n) % _BLOCK
+    if pad:
+        v = jnp.concatenate([v, jnp.zeros((pad, v.shape[1]), v.dtype)], axis=0)
+    labels = _propagate_labels_blocked(v, jnp.float32(threshold))
+    return np.asarray(labels[:n])

@@ -259,3 +259,34 @@ def test_multihost_config_parsing(monkeypatch):
         monkeypatch.delenv(var, raising=False)
     monkeypatch.setenv("KAKVEDA_MULTIHOST", "auto")
     assert multihost_config() == {}
+
+
+def test_blocked_clustering_matches_dense():
+    import numpy as np
+
+    import kakveda_tpu.ops.clustering as cl
+
+    rng = np.random.default_rng(0)
+    # 3 well-separated cluster centers + per-point noise, unit-normalized
+    centers = rng.normal(size=(3, 64))
+    centers /= np.linalg.norm(centers, axis=1, keepdims=True)
+    pts = np.concatenate([
+        centers[i] + 0.05 * rng.normal(size=(40, 64)) for i in range(3)
+    ])
+    pts /= np.linalg.norm(pts, axis=1, keepdims=True)
+
+    dense = cl.cluster_embeddings(pts, threshold=0.8)
+
+    # force the blocked path on the same data
+    orig_dense_max, orig_block = cl._DENSE_MAX, cl._BLOCK
+    cl._DENSE_MAX, cl._BLOCK = 0, 32
+    try:
+        cl._propagate_labels_blocked.clear_cache()
+        blocked = cl.cluster_embeddings(pts, threshold=0.8)
+    finally:
+        cl._DENSE_MAX, cl._BLOCK = orig_dense_max, orig_block
+        cl._propagate_labels_blocked.clear_cache()
+
+    # identical partitions (labels themselves are smallest-member indices)
+    assert (dense == blocked).all()
+    assert len(set(dense.tolist())) == 3
