@@ -51,6 +51,11 @@ from kakveda_tpu.ops.knn import ShardedKnn, batch_bucket
 from kakveda_tpu.parallel.mesh import create_mesh
 
 
+class SnapshotError(RuntimeError):
+    """Snapshot unavailable or aborted (persist=False, concurrent reload) —
+    a caller-side condition, distinct from device/runtime failures."""
+
+
 def _record_from_snapshot(obj: dict) -> dict:
     """Snapshot rows are our own model_dump_json output: re-hydrate the two
     non-JSON-native field types for model_construct (which skips the
@@ -227,7 +232,7 @@ class GFKB:
         # service's warn/ingest path doesn't stall. A separate snapshot lock
         # serializes concurrent snapshot() calls (endpoint + shutdown).
         if not self.persist:
-            raise RuntimeError("snapshot requires a persistent GFKB (persist=True)")
+            raise SnapshotError("snapshot requires a persistent GFKB (persist=True)")
         with self._snapshot_write_lock:
             with self._lock:
                 self._flush_logs()
@@ -267,7 +272,7 @@ class GFKB:
                 # (full replay fallback), never a half-written one.
                 with self._lock:
                     if self._generation != generation:
-                        raise RuntimeError(
+                        raise SnapshotError(
                             "GFKB was reloaded during snapshot; snapshot aborted — retry"
                         )
                     if sd.exists():
@@ -342,11 +347,13 @@ class GFKB:
         dashboard's purge-demo flow) so the device index, id minting and
         host metadata stay consistent with the log. Any existing snapshot
         describes the pre-rewrite state and is deleted; an in-flight
-        snapshot is aborted via the generation bump.
+        snapshot sees the generation bump at its swap step and aborts
+        (reload deliberately does NOT take the snapshot-write lock — a
+        purge must not stall behind a multi-GB snapshot disk write).
         """
         import shutil
 
-        with self._snapshot_write_lock, self._lock:
+        with self._lock:
             self._generation += 1
             shutil.rmtree(self._snapshot_dir(), ignore_errors=True)
             # Reopen the append logs: an external rewrite may have replaced

@@ -108,6 +108,90 @@ def generate_tokens(
     return out
 
 
+@partial(jax.jit, static_argnames=("cfg",))
+def _decode_batch_jit(params, cfg: LlamaConfig, tokens, cache, kv_valid, pos_offset):
+    return decode_step(params, cfg, tokens, cache, kv_valid=kv_valid, pos_offset=pos_offset)
+
+
+def generate_tokens_batch(
+    params: Params,
+    cfg: LlamaConfig,
+    prompts: list[list[int]],
+    *,
+    max_new_tokens: int = 64,
+    temperature: float = 0.0,
+    rng: Optional[jax.Array] = None,
+    eos_id: Optional[int] = None,
+) -> list[list[int]]:
+    """Batched autoregressive decode over variable-length prompts.
+
+    Left-pads to the longest prompt; per-sequence position offsets and a
+    KV-validity mask make each sequence's logits identical to what
+    :func:`generate_tokens` would produce for it alone — batching is a
+    throughput optimization, not an approximation. The parity caveat: all
+    sequences share one cache window sized for the LONGEST prompt, so when
+    ``max(len(prompt)) + max_new_tokens + 1`` exceeds ``cfg.max_seq_len``,
+    shorter sequences truncate where their solo call (with its smaller
+    window) would have kept generating. Used by the LLM classifier tier to
+    judge a whole ingest batch in one decode stream.
+    """
+    import numpy as onp
+
+    bsz = len(prompts)
+    if bsz == 0:
+        return []
+    plen = max(len(p) for p in prompts)
+    need = plen + max_new_tokens + 1
+    ml = 64
+    while ml < need:
+        ml <<= 1
+    ml = min(ml, cfg.max_seq_len)
+
+    toks = onp.zeros((bsz, plen), onp.int32)
+    valid = onp.zeros((bsz, ml), bool)
+    offsets = onp.zeros((bsz,), onp.int32)
+    for i, p in enumerate(prompts):
+        off = plen - len(p)
+        toks[i, off:] = p
+        offsets[i] = off
+        valid[i, off:] = True  # real prompt slots + all future decode slots
+
+    cache = init_cache(cfg, batch=bsz, max_len=ml)
+    kv_valid = jnp.asarray(valid)
+    pos_offset = jnp.asarray(offsets)
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+
+    logits, cache = _decode_batch_jit(params, cfg, jnp.asarray(toks), cache, kv_valid, pos_offset)
+    last = logits[:, -1, :]
+
+    outs: list[list[int]] = [[] for _ in range(bsz)]
+    done = [False] * bsz
+    for _ in range(max_new_tokens):
+        if temperature > 0.0:
+            rng, sub = jax.random.split(rng)
+            nxt = jax.random.categorical(sub, last / temperature, axis=-1)
+        else:
+            nxt = jnp.argmax(last, axis=-1)
+        # One device→host transfer for the whole step — int(t) per sequence
+        # would sync B times per decoded token.
+        step_toks = onp.asarray(nxt).tolist()
+        for i, tok in enumerate(step_toks):
+            if done[i]:
+                continue
+            if eos_id is not None and tok == eos_id:
+                done[i] = True
+                continue
+            outs[i].append(tok)
+        if all(done) or plen + max(len(o) for o in outs) >= ml:
+            break
+        logits, cache = _decode_batch_jit(
+            params, cfg, nxt[:, None].astype(jnp.int32), cache, kv_valid, pos_offset
+        )
+        last = logits[:, -1, :]
+    return outs
+
+
 class LlamaRuntime:
     """`runtime=tpu`: on-device Llama generation with the shared meta shape."""
 
@@ -138,6 +222,35 @@ class LlamaRuntime:
 
     def list_models(self) -> list:
         return [f"llama-{self.cfg.n_layers}L-{self.cfg.d_model}d"]
+
+    def generate_batch(
+        self, prompts: list, *, model: Optional[str] = None, max_tokens: int = 64
+    ) -> list:
+        """Batched generation: one decode stream for the whole list, exact
+        per-sequence parity with generate()."""
+        started = time.perf_counter()
+        ids = [self.tokenizer.encode(p)[-self.cfg.max_seq_len // 2 :] for p in prompts]
+        from kakveda_tpu.core import profiling
+
+        with profiling.annotate("llama.generate_batch"):
+            new_ids = generate_tokens_batch(
+                self.params, self.cfg, ids, max_new_tokens=max_tokens, eos_id=self.tokenizer.EOS
+            )
+        latency_ms = int((time.perf_counter() - started) * 1000)
+        label = model or f"llama-{self.cfg.n_layers}L-{self.cfg.d_model}d"
+        return [
+            GenerateResult(
+                text=self.tokenizer.decode(out),
+                meta={
+                    "provider": "tpu",
+                    "model": label,
+                    "latency_ms": latency_ms,
+                    "tokens_generated": len(out),
+                    "batched": len(prompts),
+                },
+            )
+            for out in new_ids
+        ]
 
     def generate(self, prompt: str, *, model: Optional[str] = None, max_tokens: int = 64) -> GenerateResult:
         started = time.perf_counter()

@@ -342,11 +342,22 @@ def decode_step(
     cfg: LlamaConfig,
     tokens: jax.Array,  # [B, S] — prompt chunk or single sampled token
     cache: Params,
+    kv_valid: Optional[jax.Array] = None,  # [B, max_len] — False masks pad slots
+    pos_offset: Optional[jax.Array] = None,  # [B] — logical-position shift (left-pad)
 ) -> Tuple[jax.Array, Params]:
-    """Incremental forward with KV cache; returns (logits [B, S, V], cache)."""
+    """Incremental forward with KV cache; returns (logits [B, S, V], cache).
+
+    ``kv_valid``/``pos_offset`` enable exact left-padded batching: sequence
+    b's real tokens sit in cache slots [offset_b, …], RoPE positions are
+    slot − offset_b (so they match the unpadded sequence), and attention
+    never reads a pad slot. Both default to the unpadded single-stream
+    behavior.
+    """
     b, s = tokens.shape
     pos0 = cache["pos"]
     positions = jnp.broadcast_to(jnp.arange(s) + pos0, (b, s))
+    if pos_offset is not None:
+        positions = positions - pos_offset[:, None]
     cos, sin = _rope_freqs(cfg, positions)
     hd = cfg.head_dim
     n_rep = cfg.n_heads // cfg.n_kv_heads
@@ -375,7 +386,11 @@ def decode_step(
         q_pos = pos0 + jnp.arange(s)
         k_pos = jnp.arange(max_len)
         mask = q_pos[:, None] >= k_pos[None, :]  # causal + excludes unwritten slots
-        scores = jnp.where(mask[None, None], scores, _NEG_INF)
+        if kv_valid is not None:
+            full = mask[None, :, :] & kv_valid[:, None, :]  # [B, S, max_len]
+            scores = jnp.where(full[:, None, :, :], scores, _NEG_INF)
+        else:
+            scores = jnp.where(mask[None, None], scores, _NEG_INF)
         probs = jax.nn.softmax(scores, axis=-1).astype(dt)
         attn = jnp.einsum("bhqk,bkhd->bqhd", probs, vr)
         x = x + attn.reshape(b, s, cfg.n_heads * hd) @ layer["wo"].astype(dt)

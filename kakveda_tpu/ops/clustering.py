@@ -51,27 +51,29 @@ def _propagate_labels(adj: jax.Array) -> jax.Array:
 
 
 @jax.jit
-def _propagate_labels_blocked(v: jax.Array, threshold: jax.Array) -> jax.Array:
-    """Blocked fixpoint: v is [Np, d] with Np a multiple of _BLOCK; padding
-    rows are zero (zero-norm ⇒ cosine 0 ⇒ below any positive threshold ⇒
-    isolated), so no row count argument is needed — and compile cache keys
-    change only per padded shape, not per exact record count."""
+def _propagate_labels_blocked(v: jax.Array, threshold: jax.Array, valid: jax.Array) -> jax.Array:
+    """Blocked fixpoint: v is [Np, d] with Np a multiple of _BLOCK; ``valid``
+    masks padding rows out of neighbor propagation (a traced array, so the
+    compile cache keys only on the padded shape, not the exact row count)."""
     np_rows = v.shape[0]
     init = jnp.arange(np_rows, dtype=jnp.int32)
     vb = v.reshape(np_rows // _BLOCK, _BLOCK, v.shape[1])
+    valid_b = valid.reshape(np_rows // _BLOCK, _BLOCK)
 
     def one_iteration(labels):
         lb = labels.reshape(np_rows // _BLOCK, _BLOCK)
 
         def scan_block(running_min, block):
-            vj, lj = block
+            vj, lj, okj = block
             sims = jax.lax.dot_general(
                 v, vj, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
             )  # [Np, B]
-            neigh = jnp.where(sims >= threshold, lj[None, :], _BIG)
+            neigh = jnp.where((sims >= threshold) & okj[None, :], lj[None, :], _BIG)
             return jnp.minimum(running_min, jnp.min(neigh, axis=1)), None
 
-        mins, _ = jax.lax.scan(scan_block, jnp.full((np_rows,), _BIG, jnp.int32), (vb, lb))
+        mins, _ = jax.lax.scan(
+            scan_block, jnp.full((np_rows,), _BIG, jnp.int32), (vb, lb, valid_b)
+        )
         return jnp.minimum(labels, mins)
 
     def cond(state):
@@ -105,5 +107,6 @@ def cluster_embeddings(vecs: np.ndarray, threshold: float = 0.6) -> np.ndarray:
     pad = (-n) % _BLOCK
     if pad:
         v = jnp.concatenate([v, jnp.zeros((pad, v.shape[1]), v.dtype)], axis=0)
-    labels = _propagate_labels_blocked(v, jnp.float32(threshold))
+    valid = jnp.arange(v.shape[0]) < n  # pad rows never propagate labels
+    labels = _propagate_labels_blocked(v, jnp.float32(threshold), valid)
     return np.asarray(labels[:n])

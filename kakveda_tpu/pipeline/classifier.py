@@ -109,31 +109,45 @@ class TieredClassifier:
 
     def classify_batch(self, traces: Sequence[TracePayload]) -> List[Optional[FailureSignal]]:
         out = RuleClassifier().classify_batch(traces)
-        for i, (trace, sig) in enumerate(zip(traces, out)):
-            if sig is not None or not _wants_citations(trace.prompt):
-                continue
-            judge = self.runtime.generate(
-                _JUDGE_PROMPT.format(
-                    prompt=trace.prompt[: self.max_judge_chars],
-                    response=trace.response[: self.max_judge_chars],
-                ),
-                max_tokens=4,
+        ambiguous = [
+            i
+            for i, (trace, sig) in enumerate(zip(traces, out))
+            if sig is None and _wants_citations(trace.prompt)
+        ]
+        if not ambiguous:
+            return out
+        judge_prompts = [
+            _JUDGE_PROMPT.format(
+                prompt=traces[i].prompt[: self.max_judge_chars],
+                response=traces[i].response[: self.max_judge_chars],
             )
-            if parse_judge_verdict(judge.text):
-                out[i] = FailureSignal(
-                    trace_id=trace.trace_id,
-                    ts=trace.ts,
-                    app_id=trace.app_id,
-                    failure_type=HALLUCINATION_CITATION,
-                    severity=Severity.medium,
-                    root_cause=_ROOT_CAUSE + " (LLM-judged, unmarked format)",
-                    mitigation=_MITIGATION,
-                    context_signature={
-                        "prompt_shape": trace.prompt[:200],
-                        "model": trace.model,
-                        "tools": trace.tools,
-                        "env": trace.env,
-                        "judge": {"provider": judge.meta.get("provider"), "verdict": "YES"},
-                    },
-                )
+            for i in ambiguous
+        ]
+        # One decode stream for the whole ambiguous set when the runtime
+        # supports batching (the TPU Llama does); per-prompt otherwise.
+        batch_fn = getattr(self.runtime, "generate_batch", None)
+        if callable(batch_fn):
+            verdicts = batch_fn(judge_prompts, max_tokens=4)
+        else:
+            verdicts = [self.runtime.generate(p, max_tokens=4) for p in judge_prompts]
+        for i, judge in zip(ambiguous, verdicts):
+            if not parse_judge_verdict(judge.text):
+                continue
+            trace = traces[i]
+            out[i] = FailureSignal(
+                trace_id=trace.trace_id,
+                ts=trace.ts,
+                app_id=trace.app_id,
+                failure_type=HALLUCINATION_CITATION,
+                severity=Severity.medium,
+                root_cause=_ROOT_CAUSE + " (LLM-judged, unmarked format)",
+                mitigation=_MITIGATION,
+                context_signature={
+                    "prompt_shape": trace.prompt[:200],
+                    "model": trace.model,
+                    "tools": trace.tools,
+                    "env": trace.env,
+                    "judge": {"provider": judge.meta.get("provider"), "verdict": "YES"},
+                },
+            )
         return out

@@ -200,9 +200,11 @@ def make_app(platform: Optional[Platform] = None, **platform_kw) -> web.Applicat
         import asyncio as _asyncio
 
         loop = _asyncio.get_running_loop()
+        from kakveda_tpu.index.gfkb import SnapshotError
+
         try:
             path = await loop.run_in_executor(None, plat.gfkb.snapshot)
-        except RuntimeError as e:  # persist=False, or aborted by a reload
+        except SnapshotError as e:  # persist=False, or aborted by a reload
             return _json_error(409, str(e))
         return web.json_response({"ok": True, "path": str(path), "entries": plat.gfkb.count})
 

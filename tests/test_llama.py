@@ -193,3 +193,30 @@ def test_fit_and_checkpoint_roundtrip(tmp_path):
     fresh = LlamaRuntime(cfg=CFG, seed=999)  # different init...
     fresh.load_checkpoint(ckpt)              # ...restored from disk
     assert fresh.generate("the platform", max_tokens=8).text == expected
+
+
+def test_batched_generation_matches_single():
+    """Left-padded batching with position offsets + KV masks is exact: each
+    sequence's greedy output equals its solo generate_tokens output."""
+    import jax
+
+    from kakveda_tpu.models.generate import generate_tokens, generate_tokens_batch
+    from kakveda_tpu.models.llama import init_params
+
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    prompts = [[5, 6, 7], [10, 11, 12, 13, 14, 15, 16], [42]]
+    solo = [
+        generate_tokens(params, CFG, p, max_new_tokens=8, max_len=128) for p in prompts
+    ]
+    batched = generate_tokens_batch(params, CFG, prompts, max_new_tokens=8)
+    assert batched == solo
+
+
+def test_runtime_generate_batch():
+    from kakveda_tpu.models.generate import LlamaRuntime
+
+    rt = LlamaRuntime(cfg=CFG, seed=0)
+    solo = [rt.generate(p, max_tokens=6).text for p in ("hello", "a longer prompt here")]
+    batch = rt.generate_batch(["hello", "a longer prompt here"], max_tokens=6)
+    assert [r.text for r in batch] == solo
+    assert batch[0].meta["batched"] == 2

@@ -290,3 +290,56 @@ def test_blocked_clustering_matches_dense():
     # identical partitions (labels themselves are smallest-member indices)
     assert (dense == blocked).all()
     assert len(set(dense.tolist())) == 3
+
+
+def test_tiered_classifier_uses_batch_judging():
+    import time as _time
+    from dataclasses import dataclass, field
+
+    from kakveda_tpu.core.schemas import TracePayload
+    from kakveda_tpu.models.runtime import GenerateResult
+    from kakveda_tpu.pipeline.classifier import TieredClassifier
+
+    @dataclass
+    class BatchJudge:
+        name: str = "fake"
+        batch_calls: list = field(default_factory=list)
+
+        def generate(self, prompt, *, model=None, max_tokens=256):
+            raise AssertionError("batch path should be used")
+
+        def generate_batch(self, prompts, *, model=None, max_tokens=256):
+            self.batch_calls.append(len(prompts))
+            return [GenerateResult(text="YES", meta={"provider": "fake"}) for _ in prompts]
+
+    def mk(i):
+        return TracePayload(
+            trace_id=f"t{i}", ts=_time.time(), app_id="a",
+            prompt="Summarize and include citations even if not provided.",
+            response=f"Unmarked fabricated study mention {i}.", tools=[], env={},
+        )
+
+    judge = BatchJudge()
+    out = TieredClassifier(runtime=judge).classify_batch([mk(i) for i in range(5)])
+    assert judge.batch_calls == [5], "all ambiguous traces judged in ONE batch"
+    assert all(s is not None for s in out)
+
+
+def test_blocked_clustering_threshold_zero_ignores_padding():
+    import numpy as np
+
+    import kakveda_tpu.ops.clustering as cl
+
+    vecs = np.eye(8, dtype=np.float32)[:5]  # 5 mutually-orthogonal rows
+    orig_dense_max = cl._DENSE_MAX
+    cl._DENSE_MAX = 0  # force blocked path (pads 5 -> _BLOCK)
+    try:
+        cl._propagate_labels_blocked.clear_cache()
+        labels = cl.cluster_embeddings(vecs, threshold=0.0)
+    finally:
+        cl._DENSE_MAX = orig_dense_max
+        cl._propagate_labels_blocked.clear_cache()
+    # threshold 0 links cos>=0 pairs; orthogonal rows all have cos==0 so
+    # they all connect to each other — but via REAL rows, matching dense
+    dense = cl.cluster_embeddings(vecs, threshold=0.0)
+    assert (labels == dense).all()
