@@ -3,6 +3,7 @@
 
 from __future__ import annotations
 
+import os
 import re
 import time
 
@@ -127,7 +128,15 @@ def setup(app: web.Application) -> None:
             # services/dashboard/app.py:2585-2642); otherwise demo mode shows
             # the link inline — but never in production, where that would
             # hand any account's reset token to an anonymous requester.
-            link = f"/reset?token={token}"
+            # Mail clients need an absolute URL. Only DASHBOARD_BASE_URL is
+            # trusted in production — deriving the base from request.host
+            # would let an attacker poison the emailed link via the Host
+            # header and harvest the victim's live reset token. Outside
+            # production the request origin is a convenience fallback.
+            base = os.environ.get("DASHBOARD_BASE_URL", "").rstrip("/")
+            if not base and get_runtime_config(service_name="dashboard").env != "production":
+                base = f"{request.scheme}://{request.host}"
+            link = f"{base}/reset?token={token}"
             sent = False
             if email_lib.smtp_configured():
                 sent = await off_loop(

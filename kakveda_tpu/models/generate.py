@@ -141,6 +141,11 @@ def generate_tokens_batch(
     if bsz == 0:
         return []
     plen = max(len(p) for p in prompts)
+    if plen + 1 > cfg.max_seq_len:
+        raise ValueError(
+            f"longest prompt ({plen} tokens) leaves no room in the cache window "
+            f"(max_seq_len={cfg.max_seq_len}); truncate prompts before calling"
+        )
     need = plen + max_new_tokens + 1
     ml = 64
     while ml < need:

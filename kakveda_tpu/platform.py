@@ -159,9 +159,17 @@ class Platform:
 
     async def ingest_batch(self, traces: Sequence[TracePayload]) -> List[FailureSignal]:
         """Streaming ingest: classify + embed + insert whole batches in single
-        device calls. Bypasses per-trace bus fan-out for throughput but still
-        publishes failure.detected so reactors and external subscribers see
-        every failure."""
+        device calls. Bypasses the internal per-trace reactor (classification
+        runs here, batched) but still fans trace.ingested out to every OTHER
+        subscriber — durable URL subscribers and the dashboard's runs-explorer
+        handler see batched traces exactly as they see single ones."""
+        exclude = (self._on_trace_event,)
+        if self.bus.has_subscribers(TOPIC_TRACE_INGESTED, exclude=exclude):
+            await self.bus.publish_many(
+                TOPIC_TRACE_INGESTED,
+                [t.model_dump(mode="json") for t in traces],
+                exclude=exclude,
+            )
         return await self._classify_and_record(traces)
 
     def warn(self, req: WarningRequest) -> WarningResponse:
