@@ -1,4 +1,11 @@
-"""Benchmark: pre-flight warning p50 latency at a 1M-entry GFKB.
+"""Benchmarks: warn p50 @1M GFKB, streaming-ingest throughput, decode MFU.
+
+One `python bench.py` run measures all three and prints ONE JSON line —
+headline = the warn north star, with ingest + decode under
+``extra_metrics`` so the driver's BENCH_r{N}.json carries every number.
+``KAKVEDA_BENCH_METRIC=warn|ingest|decode`` runs a single metric instead.
+
+== warn: pre-flight warning p50 latency at a 1M-entry GFKB.
 
 The north-star metric (BASELINE.md): the reference answers a pre-flight
 match by reading the whole failures.jsonl, pydantic-validating every row,
@@ -27,7 +34,9 @@ Prints exactly one JSON line:
 
 Env knobs: KAKVEDA_BENCH_N (index entries; default 1M on TPU, 100k
 elsewhere), KAKVEDA_BENCH_DIM (default 2048), KAKVEDA_BENCH_QUERIES,
-KAKVEDA_BENCH_BATCH (μ-batch size, default 64).
+KAKVEDA_BENCH_BATCH (warn μ-batch, default 64), KAKVEDA_BENCH_TRACES /
+KAKVEDA_BENCH_INGEST_BATCH (ingest), KAKVEDA_BENCH_DECODE_PRESET (1b|tiny)
+/ KAKVEDA_BENCH_DECODE_BATCH / KAKVEDA_BENCH_DECODE_STEPS (decode MFU).
 """
 
 from __future__ import annotations
@@ -206,6 +215,101 @@ def _measure_ingest(n_traces: int, batch: int) -> tuple[float, float]:
     return ours_tps, seq_tps
 
 
+def _measure_decode(preset: str, bsz: int, steps: int) -> dict:
+    """Serving bench: prefill + steady-state decode tokens/sec and MFU on
+    the current chip, via the fused whole-generation-on-device decode
+    (models/generate.py:generate_tokens_fused — one compiled program per
+    generation, so the tunneled-TPU wire RTT is paid once, not per token).
+
+    Weight VALUES don't affect speed, so the model is random-init at real
+    shapes (no pretrained weights ship in this image); `vs_baseline` is the
+    batched-vs-unbatched throughput ratio measured in the same run.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from kakveda_tpu.models.generate import _generate_fused_jit
+    from kakveda_tpu.models.llama import LlamaConfig, init_cache, init_params
+
+    if preset == "1b":
+        # TinyLlama-1.1B shapes — the "small open checkpoint" serving class.
+        cfg = LlamaConfig(
+            vocab_size=32000, d_model=2048, n_layers=22, n_heads=32,
+            n_kv_heads=4, d_ff=5632, max_seq_len=2048,
+        )
+    else:
+        cfg = LlamaConfig()  # tiny — CPU smoke shape
+
+    params = jax.tree.map(
+        lambda x: x.astype(jnp.bfloat16), init_params(jax.random.PRNGKey(0), cfg)
+    )
+    n_params = sum(int(np.prod(x.shape)) for x in jax.tree.leaves(params))
+    # Matmul FLOPs/token: 2·(params excl. embedding gather) + attention
+    # (QK^T and PV: 4·L·ctx·d_model at the mean decode context).
+    n_mat = n_params - int(np.prod(params["embed"].shape))
+    plen = 128
+    mean_ctx = plen + steps / 2
+    flops_per_tok = 2 * n_mat + 4 * cfg.n_layers * mean_ctx * cfg.d_model
+
+    peak = {
+        # bf16 peak TFLOP/s per chip, by device_kind substring.
+        "v4": 275e12, "v5 lite": 197e12, "v5e": 197e12,
+        "v5p": 459e12, "v6": 918e12, "v6e": 918e12,
+    }
+    kind = jax.devices()[0].device_kind.lower()
+    peak_flops = next((v for k, v in peak.items() if k in kind), 197e12)
+
+    rng = np.random.default_rng(0)
+
+    def run(b: int) -> tuple[float, float]:
+        """Returns (decode_tokens_per_sec, prefill_tokens_per_sec)."""
+        toks = jnp.asarray(rng.integers(3, cfg.vocab_size, size=(b, plen)), jnp.int32)
+        valid = jnp.ones((b, 512), bool)
+        offs = jnp.zeros((b,), jnp.int32)
+        key = jax.random.PRNGKey(0)
+        temp = jnp.asarray(1e-6, jnp.float32)
+
+        def gen(n_steps: int):
+            cache = init_cache(cfg, batch=b, max_len=512)
+            out = _generate_fused_jit(
+                params, cfg, toks, cache, valid, offs, key, temp, n_steps, True
+            )
+            # Fetch to host: on a tunneled TPU, block_until_ready alone does
+            # not wait for remote execution — only a D2H copy syncs. Both
+            # timings below pay the same fixed wire RTT, so it cancels in
+            # the full-minus-prefill subtraction.
+            return np.asarray(out)
+
+        gen(steps)  # compile + warm
+        t0 = time.perf_counter()
+        gen(steps)
+        dt_full = time.perf_counter() - t0
+        # Prefill(+1 step)-only timing isolates the two phases.
+        gen(1)
+        t0 = time.perf_counter()
+        gen(1)
+        dt_prefill = time.perf_counter() - t0
+        decode_tps = b * (steps - 1) / max(dt_full - dt_prefill, 1e-9)
+        prefill_tps = b * plen / dt_prefill
+        return decode_tps, prefill_tps
+
+    decode_tps, prefill_tps = run(bsz)
+    solo_tps, _ = run(1)
+    mfu = decode_tps * flops_per_tok / peak_flops
+    prefill_mfu = prefill_tps * (2 * n_mat) / peak_flops
+    return {
+        "decode_tps": decode_tps,
+        "prefill_tps": prefill_tps,
+        "solo_tps": solo_tps,
+        "mfu": mfu,
+        "prefill_mfu": prefill_mfu,
+        "n_params": n_params,
+        "batch": bsz,
+        "device_kind": kind,
+        "peak_tflops": peak_flops / 1e12,
+    }
+
+
 def _measure_reference(dim_corpus: int, n_queries: int, target_n: int) -> float:
     """Reference algorithm (TF-IDF refit per query) on this host, timed at
     ``dim_corpus`` rows and linearly extrapolated to ``target_n`` rows."""
@@ -239,57 +343,94 @@ def _measure_reference(dim_corpus: int, n_queries: int, target_n: int) -> float:
     return p50_small * (target_n / dim_corpus)
 
 
-def main() -> int:
-    import jax
-
-    backend = jax.default_backend()
-
-    if os.environ.get("KAKVEDA_BENCH_METRIC", "warn") == "ingest":
-        n_traces = int(os.environ.get("KAKVEDA_BENCH_TRACES", 20_000))
-        batch = int(os.environ.get("KAKVEDA_BENCH_BATCH", 512))
-        print(f"bench[ingest]: backend={backend} traces={n_traces} batch={batch}", file=sys.stderr)
-        ours_tps, seq_tps = _measure_ingest(n_traces, batch)
-        print(
-            f"bench[ingest]: batched {ours_tps:,.0f} traces/s | per-trace "
-            f"(reference model, no HTTP hops) {seq_tps:,.0f} traces/s",
-            file=sys.stderr,
-        )
-        print(
-            json.dumps(
-                {
-                    "metric": "ingest_throughput_traces_per_sec",
-                    "value": round(ours_tps, 1),
-                    "unit": "traces/sec",
-                    "vs_baseline": round(ours_tps / seq_tps, 1) if seq_tps > 0 else 0.0,
-                }
-            )
-        )
-        return 0
-
+def _bench_warn(backend: str) -> dict:
     default_n = 1_000_000 if backend == "tpu" else 100_000
     n = int(os.environ.get("KAKVEDA_BENCH_N", default_n))
     dim = int(os.environ.get("KAKVEDA_BENCH_DIM", 2048))
     n_queries = int(os.environ.get("KAKVEDA_BENCH_QUERIES", 64))
 
-    print(f"bench: backend={backend} n={n} dim={dim} queries={n_queries}", file=sys.stderr)
+    print(f"bench[warn]: backend={backend} n={n} dim={dim} queries={n_queries}", file=sys.stderr)
     t0 = time.time()
     ours_p50 = _measure_ours(n, dim, n_queries)
-    print(f"bench: ours p50={ours_p50:.3f} ms (setup+run {time.time() - t0:.0f}s)", file=sys.stderr)
+    print(f"bench[warn]: ours p50={ours_p50:.3f} ms (setup+run {time.time() - t0:.0f}s)", file=sys.stderr)
 
     ref_p50 = _measure_reference(2000, min(10, n_queries), n)
-    print(f"bench: reference (extrapolated) p50={ref_p50:.1f} ms", file=sys.stderr)
+    print(f"bench[warn]: reference (extrapolated) p50={ref_p50:.1f} ms", file=sys.stderr)
 
     vs = ref_p50 / ours_p50 if ours_p50 > 0 and np.isfinite(ref_p50) else 0.0
+    return {
+        "metric": f"preflight_warn_p50_ms_at_{n}_gfkb",
+        "value": round(ours_p50, 3),
+        "unit": "ms",
+        "vs_baseline": round(vs, 1),
+    }
+
+
+def _bench_ingest(backend: str) -> dict:
+    n_traces = int(os.environ.get("KAKVEDA_BENCH_TRACES", 20_000))
+    batch = int(os.environ.get("KAKVEDA_BENCH_INGEST_BATCH", 512))
+    print(f"bench[ingest]: backend={backend} traces={n_traces} batch={batch}", file=sys.stderr)
+    ours_tps, seq_tps = _measure_ingest(n_traces, batch)
     print(
-        json.dumps(
-            {
-                "metric": f"preflight_warn_p50_ms_at_{n}_gfkb",
-                "value": round(ours_p50, 3),
-                "unit": "ms",
-                "vs_baseline": round(vs, 1),
-            }
-        )
+        f"bench[ingest]: batched {ours_tps:,.0f} traces/s | per-trace "
+        f"(reference model, no HTTP hops) {seq_tps:,.0f} traces/s",
+        file=sys.stderr,
     )
+    return {
+        "metric": "ingest_throughput_traces_per_sec",
+        "value": round(ours_tps, 1),
+        "unit": "traces/sec",
+        "vs_baseline": round(ours_tps / seq_tps, 1) if seq_tps > 0 else 0.0,
+    }
+
+
+def _bench_decode(backend: str) -> dict:
+    preset = os.environ.get("KAKVEDA_BENCH_DECODE_PRESET", "1b" if backend == "tpu" else "tiny")
+    bsz = int(os.environ.get("KAKVEDA_BENCH_DECODE_BATCH", 16))
+    steps = int(os.environ.get("KAKVEDA_BENCH_DECODE_STEPS", 128))
+    print(f"bench[decode]: backend={backend} preset={preset} batch={bsz} steps={steps}", file=sys.stderr)
+    r = _measure_decode(preset, bsz, steps)
+    print(
+        f"bench[decode]: {r['n_params']/1e9:.2f}B params on {r['device_kind']} "
+        f"(peak {r['peak_tflops']:.0f} bf16 TFLOP/s assumed) — decode {r['decode_tps']:,.0f} tok/s "
+        f"@batch {r['batch']} (MFU {r['mfu']*100:.1f}%), prefill {r['prefill_tps']:,.0f} tok/s "
+        f"(MFU {r['prefill_mfu']*100:.1f}%), unbatched {r['solo_tps']:,.0f} tok/s",
+        file=sys.stderr,
+    )
+    return {
+        "metric": f"decode_tokens_per_sec_{preset}_b{bsz}",
+        "value": round(r["decode_tps"], 1),
+        "unit": "tokens/sec",
+        "vs_baseline": round(r["decode_tps"] / r["solo_tps"], 1) if r["solo_tps"] > 0 else 0.0,
+        "mfu": round(r["mfu"], 4),
+        "prefill_tokens_per_sec": round(r["prefill_tps"], 1),
+        "prefill_mfu": round(r["prefill_mfu"], 4),
+    }
+
+
+def main() -> int:
+    import jax
+
+    backend = jax.default_backend()
+    which = os.environ.get("KAKVEDA_BENCH_METRIC", "all")
+
+    if which in ("warn", "ingest", "decode"):
+        print(json.dumps({"warn": _bench_warn, "ingest": _bench_ingest, "decode": _bench_decode}[which](backend)))
+        return 0
+
+    # Default: every metric in one run, one JSON line — the driver records
+    # the whole object, so warn + ingest + decode all land in BENCH_r{N}.json.
+    results = []
+    for fn in (_bench_warn, _bench_ingest, _bench_decode):
+        try:
+            results.append(fn(backend))
+        except Exception as e:  # noqa: BLE001 — one failed metric must not hide the others
+            print(f"bench: {fn.__name__} failed: {type(e).__name__}: {e}", file=sys.stderr)
+    if not results:
+        return 1
+    headline = results[0]
+    headline["extra_metrics"] = results[1:]
+    print(json.dumps(headline))
     return 0
 
 

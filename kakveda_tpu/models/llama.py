@@ -51,6 +51,17 @@ class LlamaConfig:
     rope_theta: float = 10000.0
     norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
+    # Llama-3.1-style NTK rope scaling (HF `rope_scaling.rope_type=llama3`).
+    # factor == 1.0 means off. Kept as flat floats so the config stays
+    # hashable (it is a static jit argument).
+    rope_factor: float = 1.0
+    rope_low_freq_factor: float = 1.0
+    rope_high_freq_factor: float = 4.0
+    rope_original_max_len: int = 8192
+    # When a checkpoint's vocab is padded up to a TP-friendly multiple,
+    # `vocab_size` is the padded table size and `effective_vocab` the real
+    # tokenizer vocab; sampling masks logits beyond it. None = no padding.
+    effective_vocab: Optional[int] = None
 
     @property
     def head_dim(self) -> int:
@@ -144,9 +155,24 @@ def rms_norm(x: jax.Array, w: jax.Array, eps: float) -> jax.Array:
 
 
 def _rope_freqs(cfg: LlamaConfig, positions: jax.Array) -> Tuple[jax.Array, jax.Array]:
-    """cos/sin tables [..., head_dim/2] for given positions."""
+    """cos/sin tables [..., head_dim/2] for given positions.
+
+    With ``rope_factor > 1`` applies Llama-3.1's wavelength-dependent NTK
+    scaling (matches HF ``_compute_llama3_parameters``): low-frequency
+    components are stretched by ``factor``, high-frequency kept, and the
+    band between ``low/high_freq_factor`` wavelength thresholds is blended.
+    """
     half = cfg.head_dim // 2
     inv = 1.0 / (cfg.rope_theta ** (jnp.arange(half, dtype=jnp.float32) / half))
+    if cfg.rope_factor != 1.0:
+        wavelen = 2.0 * math.pi / inv
+        low_wl = cfg.rope_original_max_len / cfg.rope_low_freq_factor
+        high_wl = cfg.rope_original_max_len / cfg.rope_high_freq_factor
+        smooth = (cfg.rope_original_max_len / wavelen - cfg.rope_low_freq_factor) / (
+            cfg.rope_high_freq_factor - cfg.rope_low_freq_factor
+        )
+        blended = (1.0 - smooth) * inv / cfg.rope_factor + smooth * inv
+        inv = jnp.where(wavelen > low_wl, inv / cfg.rope_factor, jnp.where(wavelen < high_wl, inv, blended))
     ang = positions[..., None].astype(jnp.float32) * inv  # [..., half]
     return jnp.cos(ang), jnp.sin(ang)
 
@@ -328,12 +354,18 @@ def forward(
 
 
 def init_cache(cfg: LlamaConfig, batch: int, max_len: Optional[int] = None) -> Params:
+    """Per-layer K/V buffer lists. Each layer's [B, max_len, KV, hd] buffer
+    is dynamic-update-sliced independently, which XLA turns into in-place
+    row writes — one stacked [L, ...] array (whether rebuilt with jnp.stack
+    or updated with a leading-dim DUS) either rewrites the whole cache per
+    decode step or compiles pathologically at 1B scale."""
     ml = max_len or cfg.max_seq_len
     hd = cfg.head_dim
+    shape = (batch, ml, cfg.n_kv_heads, hd)
     return {
         "pos": jnp.zeros((), jnp.int32),
-        "k": jnp.zeros((cfg.n_layers, batch, ml, cfg.n_kv_heads, hd), cfg.dtype),
-        "v": jnp.zeros((cfg.n_layers, batch, ml, cfg.n_kv_heads, hd), cfg.dtype),
+        "k": [jnp.zeros(shape, cfg.dtype) for _ in range(cfg.n_layers)],
+        "v": [jnp.zeros(shape, cfg.dtype) for _ in range(cfg.n_layers)],
     }
 
 
@@ -361,10 +393,11 @@ def decode_step(
     cos, sin = _rope_freqs(cfg, positions)
     hd = cfg.head_dim
     n_rep = cfg.n_heads // cfg.n_kv_heads
-    max_len = cache["k"].shape[2]
+    max_len = cache["k"][0].shape[1]
 
     x = params["embed"].astype(cfg.dtype)[tokens]
-    new_k, new_v = [], []
+    new_k: list = []
+    new_v: list = []
     for li, layer in enumerate(params["layers"]):
         h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
         dt = h.dtype
@@ -374,8 +407,12 @@ def decode_step(
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
 
-        k_all = jax.lax.dynamic_update_slice(cache["k"][li], k.astype(cfg.dtype), (0, pos0, 0, 0))
-        v_all = jax.lax.dynamic_update_slice(cache["v"][li], v.astype(cfg.dtype), (0, pos0, 0, 0))
+        k_all = jax.lax.dynamic_update_slice(
+            cache["k"][li], k.astype(cfg.dtype), (0, pos0, 0, 0)
+        )
+        v_all = jax.lax.dynamic_update_slice(
+            cache["v"][li], v.astype(cfg.dtype), (0, pos0, 0, 0)
+        )
         new_k.append(k_all)
         new_v.append(v_all)
 
@@ -400,5 +437,5 @@ def decode_step(
 
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     logits = (x @ params["lm_head"].astype(cfg.dtype)).astype(jnp.float32)
-    new_cache = {"pos": pos0 + s, "k": jnp.stack(new_k), "v": jnp.stack(new_v)}
+    new_cache = {"pos": pos0 + s, "k": new_k, "v": new_v}
     return logits, new_cache

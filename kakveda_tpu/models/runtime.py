@@ -50,6 +50,19 @@ class ModelRuntime(Protocol):
     def generate(self, prompt: str, *, model: Optional[str] = None, max_tokens: int = 256) -> GenerateResult: ...
 
 
+def generate_batch(
+    runtime: "ModelRuntime", prompts: list, *, model: Optional[str] = None, max_tokens: int = 256
+) -> list:
+    """Batched generation through whatever the runtime offers: the TPU
+    runtime decodes the whole list in one left-padded stream
+    (LlamaRuntime.generate_batch); stub/ollama fall back to a per-prompt
+    loop. Callers (eval runner, LLM classifier) stay runtime-agnostic."""
+    fn = getattr(runtime, "generate_batch", None)
+    if callable(fn):
+        return fn(prompts, model=model, max_tokens=max_tokens)
+    return [runtime.generate(p, model=model, max_tokens=max_tokens) for p in prompts]
+
+
 def list_models(runtime: "ModelRuntime") -> list:
     """Model names the runtime can serve, for the playground dropdown
     (reference: services/dashboard/app.py:286-306, Ollama /api/tags).

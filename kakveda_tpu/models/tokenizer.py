@@ -31,3 +31,34 @@ class ByteTokenizer:
     def decode(self, ids: List[int]) -> str:
         data = bytes(i - self._OFFSET for i in ids if i >= self._OFFSET)
         return data.decode("utf-8", errors="replace")
+
+
+class HFTokenizer:
+    """Real-vocab tokenizer loaded from a local HF checkpoint/tokenizer dir.
+
+    Same encode/decode surface as :class:`ByteTokenizer`, so the runtime and
+    the LLM-classifier tier swap tokenizers without caring which is active.
+    Zero egress: ``path`` must already hold tokenizer files on disk (it is
+    normally the same directory as the converted checkpoint).
+    """
+
+    def __init__(self, path: str):
+        from transformers import AutoTokenizer
+
+        self._tok = AutoTokenizer.from_pretrained(path)
+        self.BOS = self._tok.bos_token_id
+        self.EOS = self._tok.eos_token_id
+        pad = self._tok.pad_token_id
+        self.PAD = pad if pad is not None else (self.EOS if self.EOS is not None else 0)
+        self.vocab_size = len(self._tok)
+
+    def encode(self, text: str, *, bos: bool = True, eos: bool = False) -> List[int]:
+        ids = self._tok.encode(text, add_special_tokens=False)
+        if bos and self.BOS is not None:
+            ids = [self.BOS] + ids
+        if eos and self.EOS is not None:
+            ids = ids + [self.EOS]
+        return ids
+
+    def decode(self, ids: List[int]) -> str:
+        return self._tok.decode(ids, skip_special_tokens=True)

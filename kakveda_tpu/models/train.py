@@ -61,9 +61,11 @@ def make_train_step(cfg: LlamaConfig, opt: Optional[optax.GradientTransformation
 
 
 def shard_params(params: Params, cfg: LlamaConfig, mesh: Mesh) -> Params:
+    from kakveda_tpu.parallel.distributed import put_global
+
     specs = param_specs(cfg)
     return jax.tree.map(
-        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        lambda x, s: put_global(x, NamedSharding(mesh, s)),
         params,
         specs,
         is_leaf=lambda x: isinstance(x, jax.Array) or hasattr(x, "shape"),

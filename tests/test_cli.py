@@ -77,6 +77,7 @@ def test_wizard_interactive_answers(tmp_path):
     answers = iter([
         "production",          # env
         "tpu",                 # model runtime
+        "/ckpts/llama3-8b",    # hf checkpoint dir
         "text",                # log format
         "4096",                # index capacity
         "data:4,model:2",      # mesh shape
@@ -89,6 +90,7 @@ def test_wizard_interactive_answers(tmp_path):
     env = path.read_text()
     assert "KAKVEDA_ENV=production" in env
     assert "KAKVEDA_MODEL_RUNTIME=tpu" in env
+    assert "KAKVEDA_HF_CKPT=/ckpts/llama3-8b" in env
     assert "KAKVEDA_MESH_SHAPE=data:4,model:2" in env
     assert "KAKVEDA_REDIS_URL=redis://r:6379/0" in env
     assert "SMTP_HOST" not in env
@@ -155,7 +157,7 @@ def test_wizard_rejects_invalid_choice(tmp_path):
     answers = iter([
         "prod",            # invalid → re-asked
         "production",      # valid env
-        "stub", "json", "4096", "data:-1", "", "", "",
+        "stub", "", "json", "4096", "data:-1", "", "", "",
     ])
     path = run_wizard(tmp_path, input_fn=lambda _: next(answers), print_fn=lambda s: None)
     assert "KAKVEDA_ENV=production" in path.read_text()

@@ -212,6 +212,29 @@ def test_batched_generation_matches_single():
     assert batched == solo
 
 
+def test_fused_generation_matches_step_loop():
+    """The one-compiled-program decode (lax.scan over decode_step) must emit
+    exactly what the per-step loop emits under greedy sampling."""
+    import jax
+
+    from kakveda_tpu.models.generate import generate_tokens_batch, generate_tokens_fused
+    from kakveda_tpu.models.llama import init_params
+
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    prompts = [[5, 6, 7], [10, 11, 12, 13, 14, 15, 16], [42]]
+    stepped = generate_tokens_batch(params, CFG, prompts, max_new_tokens=8)
+    fused = generate_tokens_fused(params, CFG, prompts, max_new_tokens=8)
+    assert fused == stepped
+
+    # EOS truncation: force an eos_id that the greedy path emits and check
+    # the fused output stops there like the step loop does.
+    eos = stepped[0][2] if len(stepped[0]) > 2 else None
+    if eos is not None:
+        f = generate_tokens_fused(params, CFG, prompts, max_new_tokens=8, eos_id=eos)
+        s = generate_tokens_batch(params, CFG, prompts, max_new_tokens=8, eos_id=eos)
+        assert f == s
+
+
 def test_runtime_generate_batch():
     from kakveda_tpu.models.generate import LlamaRuntime
 
