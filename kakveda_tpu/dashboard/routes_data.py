@@ -87,6 +87,44 @@ def setup(app: web.Application) -> None:
         )
         raise web.HTTPFound(f"/datasets/{ds_id}")
 
+    def _persist_trace(ex: dict, gen, trace_id: str, ts: float) -> TracePayload:
+        """Rich trace_runs row + the TracePayload to ingest, shared by the
+        single-example and batched-eval paths so the 13-column insert can't
+        drift between them. The row goes in BEFORE plat.ingest — the
+        trace.ingested subscriber writes a sparse fallback row and
+        INSERT OR IGNORE is first-wins."""
+        tin, tout = estimate_tokens(ex["prompt"]), estimate_tokens(gen.text)
+        ctx.db.execute(
+            "INSERT OR IGNORE INTO trace_runs (trace_id, ts, app_id, agent_id, prompt, response,"
+            " provider, model, latency_ms, tokens_in, tokens_out, cost_micro_usd, status)"
+            " VALUES (?,?,?,?,?,?,?,?,?,?,?,?,'ok')",
+            (
+                trace_id,
+                ts,
+                ex["app_id"],
+                "eval",
+                ex["prompt"],
+                gen.text,
+                gen.meta.get("provider"),
+                gen.meta.get("model"),
+                gen.meta.get("latency_ms"),
+                tin,
+                tout,
+                estimate_cost_micro_usd(tin, tout),
+            ),
+        )
+        return TracePayload(
+            trace_id=trace_id,
+            ts=datetime.now(timezone.utc),
+            app_id=ex["app_id"],
+            agent_id="eval",
+            prompt=ex["prompt"],
+            response=gen.text,
+            model=gen.meta.get("model"),
+            tools=[],
+            env={},
+        )
+
     async def _run_one_example(ex: dict, prewarned: bool = False) -> dict:
         """warn → generate → deterministic check → trace persist.
         ``prewarned=True`` when the caller already warned the whole dataset
@@ -104,41 +142,7 @@ def setup(app: web.Application) -> None:
             )
         gen = await off_loop(ctx.model.generate, ex["prompt"])
         passed = citation_check_passes(ex["prompt"], gen.text)
-        # Rich trace row BEFORE plat.ingest — the trace.ingested subscriber
-        # writes a sparse fallback row and INSERT OR IGNORE is first-wins.
-        tin, tout = estimate_tokens(ex["prompt"]), estimate_tokens(gen.text)
-        ctx.db.execute(
-            "INSERT OR IGNORE INTO trace_runs (trace_id, ts, app_id, agent_id, prompt, response,"
-            " provider, model, latency_ms, tokens_in, tokens_out, cost_micro_usd, status)"
-            " VALUES (?,?,?,?,?,?,?,?,?,?,?,?,'ok')",
-            (
-                trace_id,
-                t0,
-                ex["app_id"],
-                "eval",
-                ex["prompt"],
-                gen.text,
-                gen.meta.get("provider"),
-                gen.meta.get("model"),
-                gen.meta.get("latency_ms"),
-                tin,
-                tout,
-                estimate_cost_micro_usd(tin, tout),
-            ),
-        )
-        await plat.ingest(
-            TracePayload(
-                trace_id=trace_id,
-                ts=datetime.now(timezone.utc),
-                app_id=ex["app_id"],
-                agent_id="eval",
-                prompt=ex["prompt"],
-                response=gen.text,
-                model=gen.meta.get("model"),
-                tools=[],
-                env={},
-            )
-        )
+        await plat.ingest(_persist_trace(ex, gen, trace_id, t0))
         return {
             "trace_id": trace_id,
             "passed": passed,
@@ -173,12 +177,16 @@ def setup(app: web.Application) -> None:
             " VALUES (?,?,?,?,0,'running')",
             (ds_id, time.time(), request["user"].email, len(examples)),
         )
-        # Pre-flight warns for the whole dataset in ONE device call
-        # (warn_batch = one compiled matmul+top-k), then generate+persist per
-        # example — the reference loops warn→generate one example at a time
-        # (reference: services/dashboard/app.py:2315-2393, noted in SURVEY
-        # §3.4 as the obvious batch-parallel target).
+        # The whole dataset runs as THREE batched calls — one warn_batch
+        # (single compiled matmul+top-k), one generate_batch (single padded
+        # decode stream on the TPU runtime), one ingest_batch (single
+        # classify+embed+insert) — where the reference loops
+        # warn→generate→ingest one example at a time
+        # (reference: services/dashboard/app.py:2315-2393, SURVEY §3.4's
+        # "obvious batch-parallel target"). Per-example results are
+        # unchanged: generate_batch is exact left-padded batching.
         from kakveda_tpu.dashboard.routes_main import off_loop
+        from kakveda_tpu.models.runtime import generate_batch
 
         await off_loop(
             plat.warn_batch,
@@ -189,23 +197,29 @@ def setup(app: web.Application) -> None:
                 for ex in examples
             ],
         )
+        t0 = time.time()
+        gens = await off_loop(generate_batch, ctx.model, [ex["prompt"] for ex in examples])
         passed = 0
-        for ex in examples:
-            res = await _run_one_example(ex, prewarned=True)
-            passed += int(res["passed"])
+        traces = []
+        for ex, gen in zip(examples, gens):
+            trace_id = new_trace_id()
+            ok = citation_check_passes(ex["prompt"], gen.text)
+            passed += int(ok)
+            traces.append(_persist_trace(ex, gen, trace_id, t0))
             ctx.db.execute(
                 "INSERT INTO evaluation_results (eval_run_id, example_id, trace_id, passed,"
                 " detail, latency_ms, provider) VALUES (?,?,?,?,?,?,?)",
                 (
                     run_id,
                     ex["id"],
-                    res["trace_id"],
-                    int(res["passed"]),
-                    None if res["passed"] else "citation hallucination detected",
-                    res["latency_ms"],
-                    res["provider"],
+                    trace_id,
+                    int(ok),
+                    None if ok else "citation hallucination detected",
+                    gen.meta.get("latency_ms", 0),
+                    gen.meta.get("provider"),
                 ),
             )
+        await plat.ingest_batch(traces)
         ctx.db.execute(
             "UPDATE evaluation_runs SET passed=?, status='done' WHERE id=?", (passed, run_id)
         )

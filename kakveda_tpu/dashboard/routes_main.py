@@ -69,9 +69,11 @@ def setup(app: web.Application) -> None:
 
     @require_login
     async def home(request):
-        failures = plat.failures()
+        # Paged + incrementally-maintained accessors: the home view costs
+        # O(page), not O(all records), at 1M-row GFKBs.
+        failures = plat.failures_page(limit=15)
         patterns = plat.patterns_list()
-        apps = sorted({a for f in failures for a in f.affected_apps})
+        apps = plat.apps()
         health = {a: plat.health_history(a, limit=1) for a in apps}
         recent_warnings = ctx.db.query(
             "SELECT * FROM warning_events ORDER BY ts DESC LIMIT 10"
@@ -93,7 +95,7 @@ def setup(app: web.Application) -> None:
     @require_login
     async def health_page(request):
         app_id = request.query.get("app_id", "")
-        apps = sorted({a for f in plat.failures() for a in f.affected_apps})
+        apps = plat.apps()
         points = plat.health_history(app_id, limit=100) if app_id else []
         return ctx.render(request, "health.html", apps=apps, app_id=app_id, points=points)
 
@@ -127,7 +129,7 @@ def setup(app: web.Application) -> None:
             base, _, v = fid.rpartition("v")
             if v.isdigit():
                 fid, want_version = base, int(v)
-        rec = next((f for f in plat.failures() if f.failure_id == fid), None)
+        rec = plat.get_failure(fid)
         if rec is None:
             raise web.HTTPNotFound(text=f"failure {fid} not found")
         history = []

@@ -23,7 +23,8 @@ Deliberate deviations from the reference, both documented here:
     top-5 so a type-filtered query can return fewer (or zero) matches even
     when matching failures exist (reference: services/gfkb/app.py:89-91).
     ``type_filter="post"`` (default) preserves that observable behavior;
-    a device-side pre-selection mask is planned as a follow-up.
+    ``type_filter="pre"`` fixes it with a device-side pre-selection mask
+    (per-slot type ids AND-ed into the valid mask before top-k).
 """
 
 from __future__ import annotations
@@ -93,10 +94,15 @@ class GFKB:
         self.top_k = top_k
         self._knn = ShardedKnn(self.mesh, capacity, dim, k=top_k)
         self._emb, self._valid = self._knn.alloc()
+        # Per-slot failure-type ids (device int32 side-table) for the
+        # device-side type pre-filter; host mapping type name -> id.
+        self._types = self._knn.alloc_i32()
+        self._type_ids: Dict[str, int] = {}
 
         # Host-side metadata: one entry per canonical failure, slot-aligned.
         self._records: List[CanonicalFailureRecord] = []
         self._slot_by_key: Dict[Tuple[str, str], int] = {}
+        self._slot_by_id: Dict[str, int] = {}
         self._patterns: Dict[str, PatternEntity] = {}  # name -> latest
         self._snapshot_write_lock = threading.Lock()
         # Bumped by reload(); snapshot() aborts if it changed mid-write so a
@@ -109,14 +115,27 @@ class GFKB:
         self._ids_by_type: Dict[str, List[str]] = {}
         self._apps_by_type: Dict[str, set] = {}
         self._lock = threading.Lock()
+        # Upserts append records under the lock but embed AFTER releasing it
+        # (_embed_new_slots). Consumers of (records, embeddings) pairs —
+        # snapshot(), records_and_embeddings() — must not observe appended
+        # records whose rows are still zero: they drain this in-flight
+        # counter first (snapshots would otherwise persist zero vectors
+        # permanently, since restore never re-embeds).
+        self._pending_embeds = 0
+        self._embeds_cv = threading.Condition(self._lock)
         # Group-commit append logs (C++ writer when available): records are
         # buffered and flushed after each upsert batch instead of paying an
         # open+write+close per record (the reference's pattern,
         # services/gfkb/app.py:49-51).
         self._logs: Dict[Path, "native.AppendLog"] = {}
+        # Published immutable view for lock-free matching: a tuple swap is
+        # atomic under the GIL, so match_batch never takes the data lock —
+        # see match_batch for the consistency argument.
+        self._view = (self._knn, self._emb, self._valid, self._types, self._records)
 
         if persist:
             self._replay()
+        self._publish()
 
     # ------------------------------------------------------------------
     # persistence
@@ -180,6 +199,7 @@ class GFKB:
                 self._records.extend(latest[k] for k in order)
                 for i, k in enumerate(order):
                     self._slot_by_key[k] = base + i
+                    self._slot_by_id[latest[k].failure_id] = base + i
                 for k in order:
                     rec = latest[k]
                     self._ids_by_type.setdefault(rec.failure_type, []).append(rec.failure_id)
@@ -188,8 +208,12 @@ class GFKB:
                     )
                 vecs = self.featurizer.encode_batch([latest[k].signature_text for k in order])
                 self._ensure_capacity(len(self._records))
-                slots = np.arange(base, base + len(order), dtype=np.int32)
-                self._emb, self._valid = self._knn.insert(self._emb, self._valid, vecs, slots)
+                tids = np.asarray(
+                    [self._type_id(latest[k].failure_type) for k in order], np.int32
+                )
+                self._insert_chunked(
+                    vecs, np.arange(base, base + len(order), dtype=np.int32), tids
+                )
 
         if self.patterns_path.exists():
             for line in self.patterns_path.read_text(encoding="utf-8").splitlines():
@@ -235,6 +259,7 @@ class GFKB:
             raise SnapshotError("snapshot requires a persistent GFKB (persist=True)")
         with self._snapshot_write_lock:
             with self._lock:
+                self._drain_pending_embeds()
                 self._flush_logs()
                 records = list(self._records)
                 n = len(records)
@@ -331,14 +356,26 @@ class GFKB:
         self._slot_by_key = {
             (r.failure_type, r.signature_text): i for i, r in enumerate(records)
         }
+        self._slot_by_id = {r.failure_id: i for i, r in enumerate(records)}
         for r in records:
             self._ids_by_type.setdefault(r.failure_type, []).append(r.failure_id)
             self._apps_by_type.setdefault(r.failure_type, set()).update(r.affected_apps)
         if n:
-            self._emb, self._valid = self._knn.insert(
-                self._emb, self._valid, vecs, np.arange(n, dtype=np.int32)
-            )
+            tids = np.asarray([self._type_id(r.failure_type) for r in records], np.int32)
+            self._insert_chunked(vecs, np.arange(n, dtype=np.int32), tids)
         return offset
+
+    def _insert_chunked(self, vecs: np.ndarray, slots: np.ndarray, tids: np.ndarray) -> None:
+        """Bulk insert in bounded chunks: insert inputs are replicated on
+        every device, so a million-row restore in one call would put the
+        whole matrix on each chip; 64k rows at a time bounds that."""
+        chunk = 1 << 16
+        for i in range(0, len(slots), chunk):
+            sl = slots[i : i + chunk]
+            self._emb, self._valid = self._knn.insert(
+                self._emb, self._valid, vecs[i : i + chunk], sl
+            )
+            self._types = self._knn.scatter_i32(self._types, sl, tids[i : i + chunk])
 
     def reload(self) -> None:
         """Drop all in-memory/device state and replay the append logs.
@@ -360,13 +397,17 @@ class GFKB:
             # the files (new inode), and a held fd would append to the old one.
             self.close()
             self._emb, self._valid = self._knn.alloc()
+            self._types = self._knn.alloc_i32()
+            self._type_ids = {}
             self._records = []
             self._slot_by_key = {}
+            self._slot_by_id = {}
             self._patterns = {}
             self._ids_by_type = {}
             self._apps_by_type = {}
             if self.persist:
                 self._replay()
+            self._publish()
 
     # ------------------------------------------------------------------
     # failures
@@ -380,12 +421,41 @@ class GFKB:
         with self._lock:
             return list(self._records)
 
+    def list_failures_page(
+        self, offset: int = 0, limit: int = 50, newest_first: bool = True
+    ) -> List[CanonicalFailureRecord]:
+        """A page of records without copying the whole list — dashboard
+        views at 1M records must not pay O(N) per page render."""
+        with self._lock:
+            n = len(self._records)
+            if newest_first:
+                hi = max(0, n - offset)
+                lo = max(0, hi - limit)
+                return self._records[lo:hi][::-1]
+            return self._records[offset : offset + limit]
+
+    def get_failure(self, failure_id: str) -> Optional[CanonicalFailureRecord]:
+        """O(1) id lookup via the maintained id→slot map."""
+        with self._lock:
+            slot = self._slot_by_id.get(failure_id)
+            return self._records[slot] if slot is not None else None
+
+    def all_apps(self) -> List[str]:
+        """Sorted union of affected apps — maintained incrementally so the
+        dashboard's app dropdowns never scan the record list."""
+        with self._lock:
+            out: set = set()
+            for apps in self._apps_by_type.values():
+                out |= apps
+            return sorted(out)
+
     def records_and_embeddings(self) -> Tuple[List[CanonicalFailureRecord], np.ndarray]:
         """Consistent (records, slot-aligned embedding rows) pair — captured
         atomically so a concurrent reload() (purge) can't misalign row i
         with records[i]. The slow host transfer happens after the lock via a
         device-side buffer copy."""
         with self._lock:
+            self._drain_pending_embeds()
             records = list(self._records)
             knn = self._knn  # growth re-shard swaps the knn; pair it with the buffer
             emb_copy = knn.device_copy(self._emb)
@@ -402,21 +472,84 @@ class GFKB:
                 sorted(self._apps_by_type.get(failure_type, set())),
             )
 
+    def _publish(self) -> None:
+        """Swap the lock-free read view (call with the data lock held, or
+        single-threaded during init)."""
+        self._view = (self._knn, self._emb, self._valid, self._types, self._records)
+
+    def _type_id(self, failure_type: str) -> int:
+        """Dense id for a failure type (assigns on first sight; callers hold
+        the data lock when creating records)."""
+        tid = self._type_ids.get(failure_type)
+        if tid is None:
+            tid = self._type_ids[failure_type] = len(self._type_ids)
+        return tid
+
+    def _build_index(self, new_cap: int, records: Sequence[CanonicalFailureRecord]):
+        """Allocate a capacity-``new_cap`` index populated with ``records``
+        (re-embed + type scatter). Pure construction — no shared state."""
+        knn = ShardedKnn(self.mesh, new_cap, self._knn.dim, k=self.top_k)
+        emb, valid = knn.alloc()
+        types = knn.alloc_i32()
+        if records:
+            chunk = 1 << 16
+            tids = np.asarray([self._type_ids[r.failure_type] for r in records], np.int32)
+            for i in range(0, len(records), chunk):
+                batch = records[i : i + chunk]
+                vecs = self.featurizer.encode_batch([r.signature_text for r in batch])
+                slots = np.arange(i, i + len(batch), dtype=np.int32)
+                emb, valid = knn.insert(emb, valid, vecs, slots)
+                types = knn.scatter_i32(types, slots, tids[i : i + chunk])
+        return knn, emb, valid, types
+
     def _ensure_capacity(self, needed: int) -> None:
+        """Init-time growth (replay/restore run single-threaded)."""
         if needed <= self._knn.capacity:
             return
         new_cap = self._knn.capacity
         while new_cap < needed:
             new_cap *= 2
-        # Growth is an explicit re-shard event: allocate a doubled index and
-        # re-embed from host metadata (rare; amortized O(1) per insert).
-        knn = ShardedKnn(self.mesh, new_cap, self._knn.dim, k=self.top_k)
-        emb, valid = knn.alloc()
-        if self._records:
-            vecs = self.featurizer.encode_batch([r.signature_text for r in self._records])
-            slots = np.arange(len(self._records), dtype=np.int32)
-            emb, valid = knn.insert(emb, valid, vecs, slots)
-        self._knn, self._emb, self._valid = knn, emb, valid
+        self._knn, self._emb, self._valid, self._types = self._build_index(
+            new_cap, self._records
+        )
+        self._publish()
+
+    def _grow_and_reembed(self) -> None:
+        """Runtime growth: an explicit re-shard event. The expensive work —
+        re-embedding every record and building the doubled index — runs
+        WITHOUT the data lock so concurrent matches and ingests aren't
+        stalled behind it; the swap re-checks under the lock and retries if
+        a reload or competing growth won the race. Rows appended while the
+        rebuild ran are delta-scattered at swap time."""
+        while True:
+            with self._lock:
+                needed = len(self._records)
+                if needed <= self._knn.capacity:
+                    return
+                records = list(self._records)
+                old_knn = self._knn
+                gen = self._generation
+            new_cap = old_knn.capacity
+            while new_cap < len(records):
+                new_cap *= 2
+            knn, emb, valid, types = self._build_index(new_cap, records)
+            with self._lock:
+                if self._generation != gen or self._knn is not old_knn:
+                    continue  # reload or another growth swapped first; re-check
+                if len(self._records) > new_cap:
+                    continue  # appends outran the doubling; rebuild bigger
+                if len(self._records) > len(records):
+                    delta = self._records[len(records) :]
+                    dvecs = self.featurizer.encode_batch([r.signature_text for r in delta])
+                    dslots = np.arange(len(records), len(self._records), dtype=np.int32)
+                    emb, valid = knn.insert(emb, valid, dvecs, dslots)
+                    dtids = np.asarray(
+                        [self._type_id(r.failure_type) for r in delta], np.int32
+                    )
+                    types = knn.scatter_i32(types, dslots, dtids)
+                self._knn, self._emb, self._valid, self._types = knn, emb, valid, types
+                self._publish()
+                return
 
     def upsert_failure(
         self,
@@ -440,6 +573,7 @@ class GFKB:
             key = (failure_type, signature_text)
             slot = self._slot_by_key.get(key)
             now = utcnow()
+            gen = self._generation
             if slot is None:
                 created = True
                 rec = CanonicalFailureRecord(
@@ -457,15 +591,12 @@ class GFKB:
                     signature_text=signature_text,
                 )
                 slot = len(self._records)
-                self._ensure_capacity(slot + 1)
+                tid = self._type_id(failure_type)
                 self._records.append(rec)
                 self._slot_by_key[key] = slot
+                self._slot_by_id[rec.failure_id] = slot
                 self._ids_by_type.setdefault(failure_type, []).append(rec.failure_id)
                 self._apps_by_type.setdefault(failure_type, set()).add(app_id)
-                vec = self.featurizer.encode_batch([signature_text])
-                self._emb, self._valid = self._knn.insert(
-                    self._emb, self._valid, vec, np.asarray([slot], dtype=np.int32)
-                )
             else:
                 created = False
                 old = self._records[slot]
@@ -483,7 +614,11 @@ class GFKB:
                 # Same signature text => identical embedding; no device write.
             self._append_jsonl(self.failures_path, rec.model_dump(mode="json"))
             self._flush_logs()
-            return rec, created
+            if created:
+                self._pending_embeds += 1
+        if created:
+            self._embed_new_slots([slot], [signature_text], [tid], gen)
+        return rec, created
 
     def upsert_failures_batch(self, items: Sequence[dict]) -> List[Tuple[CanonicalFailureRecord, bool]]:
         """Batched upsert for the streaming-ingest path.
@@ -494,7 +629,9 @@ class GFKB:
         out: List[Tuple[CanonicalFailureRecord, bool]] = []
         new_slots: List[int] = []
         new_texts: List[str] = []
+        new_tids: List[int] = []
         with self._lock:
+            gen = self._generation
             now = utcnow()
             for item in items:
                 key = (item["failure_type"], item["signature_text"])
@@ -520,10 +657,12 @@ class GFKB:
                     slot = len(self._records)
                     self._records.append(rec)
                     self._slot_by_key[key] = slot
+                    self._slot_by_id[rec.failure_id] = slot
                     self._ids_by_type.setdefault(rec.failure_type, []).append(rec.failure_id)
                     self._apps_by_type.setdefault(rec.failure_type, set()).add(item["app_id"])
                     new_slots.append(slot)
                     new_texts.append(rec.signature_text)
+                    new_tids.append(self._type_id(rec.failure_type))
                     out.append((rec, True))
                 else:
                     old = self._records[slot]
@@ -542,13 +681,58 @@ class GFKB:
                 self._append_line(self.failures_path, rec.model_dump_json())
             self._flush_logs()
             if new_slots:
-                self._ensure_capacity(len(self._records))
-                vecs = self.featurizer.encode_batch(new_texts)
-                with profiling.annotate("gfkb.insert"):
-                    self._emb, self._valid = self._knn.insert(
-                        self._emb, self._valid, vecs, np.asarray(new_slots, dtype=np.int32)
-                    )
+                self._pending_embeds += 1
+        if new_slots:
+            self._embed_new_slots(new_slots, new_texts, new_tids, gen)
         return out
+
+    def _embed_new_slots(
+        self, slots: List[int], texts: List[str], tids: List[int], gen: int
+    ) -> None:
+        """Embed freshly appended records and scatter them into the index.
+
+        Runs AFTER the metadata lock is released: the (expensive) host-side
+        embedding never blocks matches or other ingests. Correctness under
+        concurrency: slots are disjoint per caller, scatters are idempotent,
+        and a growth that raced us re-embeds every record it captured plus a
+        delta — so whichever order the swaps land, every slot ends up
+        written. A reload (generation bump) makes the slots meaningless;
+        replay already re-embedded everything from the log, so we skip.
+        Callers incremented _pending_embeds under the append lock; the
+        finally block releases snapshot()/records_and_embeddings() waiters."""
+        try:
+            if len(self._records) > self._knn.capacity:
+                self._grow_and_reembed()
+                return
+            vecs = self.featurizer.encode_batch(texts)
+            arr_slots = np.asarray(slots, dtype=np.int32)
+            arr_tids = np.asarray(tids, dtype=np.int32)
+            with self._lock:
+                if self._generation != gen:
+                    return  # reloaded since append; replay covered these rows
+                if len(self._records) > self._knn.capacity:
+                    need_growth = True
+                else:
+                    need_growth = False
+                    with profiling.annotate("gfkb.insert"):
+                        self._emb, self._valid = self._knn.insert(
+                            self._emb, self._valid, vecs, arr_slots
+                        )
+                        self._types = self._knn.scatter_i32(self._types, arr_slots, arr_tids)
+                    self._publish()
+            if need_growth:
+                self._grow_and_reembed()
+        finally:
+            with self._lock:
+                self._pending_embeds -= 1
+                self._embeds_cv.notify_all()
+
+    def _drain_pending_embeds(self) -> None:
+        """Wait (holding the lock via the condition) until no appended
+        record is still awaiting its embedding scatter. Call with the data
+        lock held; may release and re-acquire it."""
+        while self._pending_embeds > 0:
+            self._embeds_cv.wait(timeout=30.0)
 
     # ------------------------------------------------------------------
     # match
@@ -568,28 +752,56 @@ class GFKB:
         failure_type: Optional[str] = None,
         type_filter: str = "post",
     ) -> List[List[FailureMatch]]:
-        """Top-k similarity matches for a batch of queries (one device call)."""
+        """Top-k similarity matches for a batch of queries (one device call).
+
+        ``type_filter``:
+          * ``"post"`` (default) — reference-compatible: the type filter
+            applies AFTER top-k truncation, so a filtered query can return
+            < k matches even when more of that type exist (the reference's
+            observable behavior, services/gfkb/app.py:89-91).
+          * ``"pre"`` — device-side pre-selection: the per-slot type id is
+            AND-ed into the valid mask BEFORE top-k, so the query returns k
+            hits whenever ≥ k failures of that type exist.
+
+        Concurrency design: the query embedding (host work) runs before the
+        lock and the result fetch (one wire RTT on remote-attached TPUs —
+        the dominant cost) runs after it; the lock covers only the async
+        DISPATCH of the top-k (microseconds). Dispatches must be serialized
+        with mutators because inserts donate the index buffers and PJRT's
+        buffer-hold bookkeeping is not safe against a concurrent reader
+        dispatch; once dispatched, execution ordering protects the read.
+        Warn latency therefore no longer serializes behind ingest's
+        embedding work, capacity-growth re-embeds (both off-lock now), or
+        other matches' result fetches.
+        """
         q = self.featurizer.encode_batch(list(signature_texts))
         b = q.shape[0]
         bb = batch_bucket(b)
         if bb != b:
             q = np.concatenate([q, np.zeros((bb - b, q.shape[1]), dtype=q.dtype)])
 
-        # The device call runs under the lock: inserts donate the (emb, valid)
-        # buffers, so a concurrent upsert would invalidate a lock-free
-        # snapshot (and a capacity growth would change the slot mapping).
         with self._lock:
-            if not self._records:
+            knn, emb, valid, types, records = self._view
+            n = len(records)
+            if n == 0:
                 return [[] for _ in signature_texts]
-            records = list(self._records)
-            with profiling.annotate("gfkb.match.topk"):
-                scores, slots = self._knn.topk(self._emb, self._valid, q)
+            tid = None
+            if type_filter == "pre" and failure_type is not None:
+                tid = self._type_ids.get(failure_type)
+                if tid is None:
+                    return [[] for _ in signature_texts]
+            with profiling.annotate("gfkb.match.dispatch"):
+                if tid is not None:
+                    valid = knn.mask_valid(valid, types, tid)
+                packed = knn.topk_async(emb, valid, q)
+        with profiling.annotate("gfkb.match.fetch"):
+            scores, slots = knn.topk_result(packed)
 
         out: List[List[FailureMatch]] = []
         for i in range(b):
             row: List[FailureMatch] = []
             for s, slot in zip(scores[i], slots[i]):
-                if s <= -1.0 or slot >= len(records):
+                if s <= -1.0 or slot >= n:
                     continue  # padding / invalid rows
                 rec = records[int(slot)]
                 if failure_type and rec.failure_type != failure_type:

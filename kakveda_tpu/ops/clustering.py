@@ -1,25 +1,33 @@
 """Device-side clustering of failure embeddings.
 
-Connected components of the threshold cosine-similarity graph, computed by
-iterative min-label propagation — every step is matmul-shaped work that XLA
-maps onto the MXU, with a ``lax.while_loop`` until fixpoint:
+Connected components of the threshold cosine-similarity graph. Two tiers:
 
-    l_i <- min over j with cos(v_i, v_j) >= t of l_j
-    repeat until no label changes (≤ graph diameter iterations)
+- **dense** (N ≤ _DENSE_MAX): one [N, N] adjacency + on-device min-label
+  propagation to fixpoint — the small-N oracle.
+- **kNN graph** (any N): ONE blocked top-k sweep builds a symmetric-union
+  k-nearest-neighbor candidate graph (each row keeps its k best neighbors;
+  an edge exists when either endpoint keeps the other), edges below the
+  threshold are dropped, and connected components run on that sparse graph
+  on host. Total device work is O(N²·d_c) for the single sweep — not per
+  fixpoint iteration like a dense propagation — with d_c the candidate
+  dim: full dim up to _EXACT_SWEEP_MAX rows, a random projection above it
+  (candidates from the projection, every surviving edge re-scored at full
+  dim, so edge *weights* are always exact; projection only affects which
+  candidates are seen).
 
-Two tiers sharing the same math:
-
-- dense (N ≤ _DENSE_MAX): one [N, N] adjacency in memory;
-- blocked (any N): the similarity matrix is never materialized — each
-  iteration scans column blocks, computing ``v @ v_blockᵀ`` [N, B] tiles
-  and folding a running per-row min of neighbor labels. Memory is O(N·B)
-  instead of O(N²), so mining runs over the full GFKB at 1M rows (the
-  reference's pattern detector is a group-by on failure_type,
-  services/pattern_detector/app.py:40-47 — no similarity clustering at
-  all).
+Graph-equivalence note: the union-kNN graph preserves the dense partition
+whenever every row has ≤ k neighbors above threshold (then it IS the
+threshold graph). Rows with more neighbors keep their k nearest, and
+mutual-kNN chains keep real clusters connected; pathological merges that
+hinge on a single pair ranked > k from both sides can split — the
+documented approximation that buys 1M-row mining
+(the reference's pattern detector is a group-by on failure_type,
+services/pattern_detector/app.py:40-47 — no similarity clustering at all).
 """
 
 from __future__ import annotations
+
+from functools import partial
 
 import jax
 import jax.numpy as jnp
@@ -27,6 +35,12 @@ import numpy as np
 
 _DENSE_MAX = 8192
 _BLOCK = 1024
+# Query rows per device dispatch: each dispatch costs one device→host fetch
+# (a fixed wire RTT on remote-attached TPUs), so bigger blocks amortize it.
+_QBLOCK = 4096
+_EXACT_SWEEP_MAX = 1 << 17  # full-dim candidate sweep up to 131k rows
+_MINE_DIM = 256  # projection dim for the candidate sweep beyond that
+_KNN_K = 32
 _BIG = jnp.iinfo(jnp.int32).max
 
 
@@ -50,46 +64,152 @@ def _propagate_labels(adj: jax.Array) -> jax.Array:
     return labels
 
 
-@jax.jit
-def _propagate_labels_blocked(v: jax.Array, threshold: jax.Array, valid: jax.Array) -> jax.Array:
-    """Blocked fixpoint: v is [Np, d] with Np a multiple of _BLOCK; ``valid``
-    masks padding rows out of neighbor propagation (a traced array, so the
-    compile cache keys only on the padded shape, not the exact row count)."""
-    np_rows = v.shape[0]
-    init = jnp.arange(np_rows, dtype=jnp.int32)
-    vb = v.reshape(np_rows // _BLOCK, _BLOCK, v.shape[1])
-    valid_b = valid.reshape(np_rows // _BLOCK, _BLOCK)
+@partial(jax.jit, static_argnames=("k",))
+def _block_topk(q: jax.Array, v: jax.Array, valid: jax.Array, k: int):
+    """Streaming top-k of ``q @ v.T`` without materializing [Q, N]: scan
+    over column blocks collecting per-block candidates, then one exact
+    merge. The per-block select uses ``approx_max_k`` — the TPU-native
+    partial-reduce (an exact top-k on other backends); its <1 recall is
+    candidate-level only and every surviving edge is exact-rescored by the
+    caller. q [Q, d], v [Np, d] (Np multiple of _BLOCK), valid [Np]."""
+    nb = v.shape[0] // _BLOCK
+    vb = v.reshape(nb, _BLOCK, v.shape[1])
+    validb = valid.reshape(nb, _BLOCK)
+    bases = (jnp.arange(nb) * _BLOCK).astype(jnp.int32)
+    kb = min(k, _BLOCK)
 
-    def one_iteration(labels):
-        lb = labels.reshape(np_rows // _BLOCK, _BLOCK)
+    def scan_fn(_, block):
+        vj, okj, base = block
+        sims = jax.lax.dot_general(
+            q, vj, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [Q, B]
+        sims = jnp.where(okj[None, :], sims, -jnp.inf)
+        vals, idx = jax.lax.approx_max_k(sims, kb, recall_target=0.98)
+        return None, (vals, (idx + base).astype(jnp.int32))
 
-        def scan_block(running_min, block):
-            vj, lj, okj = block
-            sims = jax.lax.dot_general(
-                v, vj, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-            )  # [Np, B]
-            neigh = jnp.where((sims >= threshold) & okj[None, :], lj[None, :], _BIG)
-            return jnp.minimum(running_min, jnp.min(neigh, axis=1)), None
-
-        mins, _ = jax.lax.scan(
-            scan_block, jnp.full((np_rows,), _BIG, jnp.int32), (vb, lb, valid_b)
-        )
-        return jnp.minimum(labels, mins)
-
-    def cond(state):
-        labels, changed, it = state
-        return jnp.logical_and(changed, it < np_rows)
-
-    def body(state):
-        labels, _, it = state
-        new = one_iteration(labels)
-        return new, jnp.any(new != labels), it + 1
-
-    labels, _, _ = jax.lax.while_loop(cond, body, (init, jnp.bool_(True), jnp.int32(0)))
-    return labels
+    _, (ys_v, ys_i) = jax.lax.scan(scan_fn, None, (vb, validb, bases))
+    # [nb, Q, kb] -> [Q, nb*kb], exact merge down to k.
+    q_rows = q.shape[0]
+    flat_v = jnp.transpose(ys_v, (1, 0, 2)).reshape(q_rows, nb * kb)
+    flat_i = jnp.transpose(ys_i, (1, 0, 2)).reshape(q_rows, nb * kb)
+    bv, sel = jax.lax.top_k(flat_v, min(k, nb * kb))
+    bi = jnp.take_along_axis(flat_i, sel, axis=1)
+    # Pack (values, indices) into ONE output buffer => one host fetch per
+    # dispatch (indices are exact in f32 up to 2^24 rows).
+    return jnp.concatenate([bv, bi.astype(jnp.float32)], axis=1)
 
 
-def cluster_embeddings(vecs: np.ndarray, threshold: float = 0.6) -> np.ndarray:
+@partial(jax.jit, static_argnames=())
+def _rescore_pairs(v: jax.Array, rows: jax.Array, cols: jax.Array) -> jax.Array:
+    """Exact full-dim cosine for candidate pairs (embeddings are unit-norm)."""
+    return jnp.sum(v[rows] * v[cols], axis=1)
+
+
+def _project(v: jax.Array, out_dim: int) -> jax.Array:
+    """Fixed-seed Gaussian random projection, re-normalized — preserves
+    cosine ranking well enough for CANDIDATE generation (edges are
+    re-scored exactly afterwards)."""
+    r = jax.random.normal(jax.random.PRNGKey(7), (v.shape[1], out_dim), jnp.float32)
+    p = v @ (r / np.sqrt(out_dim))
+    return p / jnp.maximum(jnp.linalg.norm(p, axis=1, keepdims=True), 1e-12)
+
+
+def build_knn_edges(
+    vecs: np.ndarray, *, k: int = _KNN_K, threshold: float = 0.6
+) -> tuple[np.ndarray, np.ndarray]:
+    """(rows, cols) of the symmetric-union kNN graph restricted to exact
+    cosine ≥ threshold. One blocked sweep; O(N·k) edges out."""
+    v = jnp.asarray(vecs, jnp.float32)
+    n, d = v.shape
+    kk = min(k + 1, n)  # +1: each row's own top-1 is itself
+
+    exact = n <= _EXACT_SWEEP_MAX or d <= _MINE_DIM
+    vc = v if exact else _project(v, _MINE_DIM)
+
+    pad = (-n) % _BLOCK
+    if pad:
+        vc_p = jnp.concatenate([vc, jnp.zeros((pad, vc.shape[1]), vc.dtype)], axis=0)
+    else:
+        vc_p = vc
+    valid = jnp.arange(n + pad) < n
+
+    # Dispatch every query block up front (async), then drain fetches — the
+    # device computes block i+1 while the host pulls block i's packed
+    # results, so the per-fetch wire RTT overlaps compute.
+    pending = []
+    for start in range(0, n, _QBLOCK):
+        stop = min(start + _QBLOCK, n)
+        q = vc[start:stop]
+        if q.shape[0] < _QBLOCK:  # pad the last block to keep one compile
+            q = jnp.concatenate([q, jnp.zeros((_QBLOCK - q.shape[0], q.shape[1]), q.dtype)])
+        packed = _block_topk(q, vc_p, valid, kk)
+        packed.copy_to_host_async()
+        pending.append((start, stop, packed))
+
+    rows_out, cols_out, sims_out = [], [], []
+    for start, stop, dev in pending:
+        packed = np.asarray(dev)[: stop - start]
+        kk_eff = packed.shape[1] // 2  # ≤ kk when the padded index is tiny
+        bv_h = packed[:, :kk_eff]
+        bi_h = packed[:, kk_eff:].astype(np.int64)
+        qi = np.repeat(np.arange(start, stop), kk_eff)
+        ci = bi_h.reshape(-1)
+        sv = bv_h.reshape(-1)
+        keep = (ci != qi) & np.isfinite(sv)
+        rows_out.append(qi[keep])
+        cols_out.append(ci[keep])
+        sims_out.append(sv[keep])
+
+    rows = np.concatenate(rows_out) if rows_out else np.zeros(0, np.int64)
+    cols = np.concatenate(cols_out) if cols_out else np.zeros(0, np.int64)
+    sims = np.concatenate(sims_out) if sims_out else np.zeros(0, np.float32)
+
+    if not exact:
+        # Candidates came from the projection; re-score exactly, in chunks
+        # that bound the gather memory.
+        chunk = 1 << 20
+        exact_sims = np.empty_like(sims)
+        for s in range(0, len(rows), chunk):
+            e = min(s + chunk, len(rows))
+            exact_sims[s:e] = np.asarray(
+                _rescore_pairs(v, jnp.asarray(rows[s:e]), jnp.asarray(cols[s:e]))
+            )
+        sims = exact_sims
+
+    keep = sims >= threshold
+    return rows[keep], cols[keep]
+
+
+def _sparse_components(n: int, rows: np.ndarray, cols: np.ndarray) -> np.ndarray:
+    """Connected components over an edge list; labels = min member index
+    (the dense path's convention)."""
+    try:
+        from scipy.sparse import coo_matrix
+        from scipy.sparse.csgraph import connected_components
+
+        g = coo_matrix((np.ones(len(rows), np.int8), (rows, cols)), shape=(n, n))
+        _, comp = connected_components(g, directed=False)
+    except ImportError:  # vectorized host label propagation fallback
+        comp = np.arange(n, dtype=np.int64)
+        # undirected: propagate both ways each sweep
+        r = np.concatenate([rows, cols])
+        c = np.concatenate([cols, rows])
+        while True:
+            new = comp.copy()
+            np.minimum.at(new, r, comp[c])
+            if np.array_equal(new, comp):
+                break
+            comp = new
+        return comp.astype(np.int32)
+
+    mins = np.full(comp.max() + 1 if len(comp) else 0, np.iinfo(np.int64).max)
+    np.minimum.at(mins, comp, np.arange(n))
+    return mins[comp].astype(np.int32)
+
+
+def cluster_embeddings(
+    vecs: np.ndarray, threshold: float = 0.6, *, knn_k: int = _KNN_K
+) -> np.ndarray:
     """Connected-component labels for L2-normalized embeddings [N, d].
 
     Returns int32 labels [N]; rows in the same component share a label
@@ -97,6 +217,8 @@ def cluster_embeddings(vecs: np.ndarray, threshold: float = 0.6) -> np.ndarray:
     """
     v = jnp.asarray(vecs, dtype=jnp.float32)
     n = v.shape[0]
+    if n == 0:
+        return np.zeros(0, np.int32)
     if n <= _DENSE_MAX:
         sims = v @ v.T
         adj = sims >= threshold
@@ -104,9 +226,5 @@ def cluster_embeddings(vecs: np.ndarray, threshold: float = 0.6) -> np.ndarray:
         adj = jnp.logical_or(adj, jnp.eye(n, dtype=bool))
         return np.asarray(_propagate_labels(adj))
 
-    pad = (-n) % _BLOCK
-    if pad:
-        v = jnp.concatenate([v, jnp.zeros((pad, v.shape[1]), v.dtype)], axis=0)
-    valid = jnp.arange(v.shape[0]) < n  # pad rows never propagate labels
-    labels = _propagate_labels_blocked(v, jnp.float32(threshold), valid)
-    return np.asarray(labels[:n])
+    rows, cols = build_knn_edges(vecs, k=knn_k, threshold=threshold)
+    return _sparse_components(n, rows, cols)

@@ -132,9 +132,30 @@ class ShardedKnn:
             self._repl = NamedSharding(mesh, P())
             self._topk = jax.jit(self._topk_impl)
         self._insert = jax.jit(self._insert_impl, donate_argnums=(0, 1))
+        # Int32 side-table (per-slot failure-type ids) sharded like `valid`:
+        # scattered on insert, AND-ed into the valid mask for device-side
+        # type-filtered matches.
+        self._scatter_i32_jit = jax.jit(
+            lambda a, rows, vals: a.at[rows].set(vals, mode="drop"), donate_argnums=(0,)
+        )
+        self._mask_jit = jax.jit(lambda valid, types, tid: valid & (types == tid))
+        # Allocation happens INSIDE jit with explicit output shardings: under
+        # multi-controller JAX (process_count > 1) no single host could
+        # device_put a full [capacity, dim] host array onto the global mesh —
+        # and even single-host this skips a host→device transfer of zeros.
+        cap = self.capacity
+        sd = self.store_dtype
+        self._alloc_jit = jax.jit(
+            lambda: (jnp.zeros((cap, dim), sd), jnp.zeros((cap,), jnp.bool_)),
+            out_shardings=(self._emb_sharding, self._valid_sharding),
+        )
+        self._alloc_i32_jit = jax.jit(
+            lambda: jnp.full((cap,), -1, jnp.int32), out_shardings=self._valid_sharding
+        )
         # Persistent jit (shape-keyed cache) for the snapshot gather — a
-        # fresh wrapper per call would recompile every snapshot.
-        self._gather = jax.jit(lambda e, p: e[p].astype(jnp.float32))
+        # fresh wrapper per call would recompile every snapshot. Replicated
+        # output so every process can read the gathered rows to host.
+        self._gather = jax.jit(lambda e, p: e[p].astype(jnp.float32), out_shardings=self._repl)
         self._copy = jax.jit(jnp.copy)
 
     def device_copy(self, emb: jax.Array) -> jax.Array:
@@ -146,14 +167,31 @@ class ShardedKnn:
 
     def alloc(self) -> Tuple[jax.Array, jax.Array]:
         """Fresh (embeddings, valid) buffers on the mesh, zeroed."""
-        emb = jax.device_put(
-            jnp.zeros((self.capacity, self.dim), dtype=self.store_dtype),
-            self._emb_sharding,
+        return self._alloc_jit()
+
+    def alloc_i32(self) -> jax.Array:
+        """Fresh per-slot int32 side-table (-1 = unset), sharded like valid."""
+        return self._alloc_i32_jit()
+
+    def _replicate(self, x: np.ndarray) -> jax.Array:
+        """Host array → replicated device array. Every process passes the
+        same value (the SPMD contract: all hosts see the same log/queries),
+        which is exactly what device_put-to-replicated supports under
+        multi-controller JAX."""
+        return jax.device_put(x, self._repl)
+
+    def scatter_i32(self, arr: jax.Array, slots: np.ndarray, values: np.ndarray) -> jax.Array:
+        """Write int32 values at logical slots (donates ``arr``)."""
+        phys = slot_to_physical(np.asarray(slots, dtype=np.int32), self.n_shards, self.rows_per_shard)
+        return self._scatter_i32_jit(
+            arr, self._replicate(phys), self._replicate(np.asarray(values, np.int32))
         )
-        valid = jax.device_put(
-            jnp.zeros((self.capacity,), dtype=jnp.bool_), self._valid_sharding
-        )
-        return emb, valid
+
+    def mask_valid(self, valid: jax.Array, types: jax.Array, type_id: int) -> jax.Array:
+        """valid AND (types == type_id) — the device-side pre-selection mask
+        for type-filtered matches. ``type_id`` stays a Python scalar so it
+        replicates implicitly on any mesh."""
+        return self._mask_jit(valid, types, type_id)
 
     # --- insert ----------------------------------------------------------
 
@@ -171,8 +209,8 @@ class ShardedKnn:
     ) -> Tuple[jax.Array, jax.Array]:
         """Write rows for logical ``slots`` (new inserts or version updates)."""
         phys = slot_to_physical(np.asarray(slots, dtype=np.int32), self.n_shards, self.rows_per_shard)
-        vecs = jnp.asarray(vecs, dtype=jnp.float32)
-        return self._insert(emb, valid, vecs, jnp.asarray(phys))
+        vecs_d = self._replicate(np.asarray(vecs, dtype=np.float32))
+        return self._insert(emb, valid, vecs_d, self._replicate(phys))
 
     def gather_slots(self, emb: jax.Array, slots: np.ndarray) -> np.ndarray:
         """Host copy of the embedding rows for logical ``slots`` (snapshot
@@ -182,7 +220,7 @@ class ShardedKnn:
         out = np.empty((len(phys), self.dim), dtype=np.float32)
         chunk = 1 << 16
         for i in range(0, len(phys), chunk):
-            out[i : i + chunk] = np.asarray(self._gather(emb, jnp.asarray(phys[i : i + chunk])))
+            out[i : i + chunk] = np.asarray(self._gather(emb, self._replicate(phys[i : i + chunk])))
         return out
 
     # --- match -----------------------------------------------------------

@@ -18,6 +18,7 @@ streaming entry: one config read and one file append per batch.
 from __future__ import annotations
 
 import json
+import os
 import threading
 from collections import defaultdict, deque
 from pathlib import Path
@@ -133,17 +134,42 @@ class HealthScorer:
 
     def history(self, app_id: str, limit: int = 50) -> List[dict]:
         """Tail of the persisted health timeline for one app
-        (reference: services/health_scoring/app.py:116-130)."""
+        (reference: services/health_scoring/app.py:116-130).
+
+        Reads the log BACKWARDS in fixed-size chunks and stops as soon as
+        ``limit`` matching points are found — at streaming-ingest rates the
+        file grows without bound, and the reference's read-everything
+        approach makes every dashboard health view O(all points ever). Cost
+        here is O(tail) for any app actively emitting points (worst case
+        one full pass for an app absent from the log)."""
         if not self.health_path.exists():
             return []
-        pts = []
-        for line in self.health_path.read_text(encoding="utf-8").splitlines():
-            if not line.strip():
-                continue
-            try:
-                obj = json.loads(line)
-            except json.JSONDecodeError:
-                continue
-            if obj.get("app_id") == app_id:
-                pts.append(obj)
-        return pts[-limit:]
+        pts: List[dict] = []
+        chunk_size = 1 << 16
+        with self.health_path.open("rb") as f:
+            f.seek(0, os.SEEK_END)
+            pos = f.tell()
+            carry = b""
+            while pos > 0 and len(pts) < limit:
+                step = min(chunk_size, pos)
+                pos -= step
+                f.seek(pos)
+                block = f.read(step) + carry
+                lines = block.split(b"\n")
+                # The first piece may be a partial line continued in the
+                # previous (earlier) chunk — carry it into the next read.
+                carry = lines[0] if pos > 0 else b""
+                start = 1 if pos > 0 else 0
+                for line in reversed(lines[start:]):
+                    if len(pts) >= limit:
+                        break
+                    if not line.strip():
+                        continue
+                    try:
+                        obj = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue
+                    if obj.get("app_id") == app_id:
+                        pts.append(obj)
+        pts.reverse()  # back to chronological order
+        return pts

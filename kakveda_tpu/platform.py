@@ -185,6 +185,17 @@ class Platform:
     def failures(self):
         return self.gfkb.list_failures()
 
+    def failures_page(self, offset: int = 0, limit: int = 50):
+        """Newest-first page — dashboard views must stay O(page), not
+        O(records), as the GFKB grows."""
+        return self.gfkb.list_failures_page(offset, limit)
+
+    def get_failure(self, failure_id: str):
+        return self.gfkb.get_failure(failure_id)
+
+    def apps(self) -> List[str]:
+        return self.gfkb.all_apps()
+
     def patterns_list(self) -> List[PatternEntity]:
         return self.gfkb.list_patterns()
 

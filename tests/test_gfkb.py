@@ -83,6 +83,80 @@ def test_match_type_post_filter(tmp_path):
     assert kb.match(_sig("Summarize with citations"), failure_type="OTHER") == []
 
 
+def test_match_type_pre_filter_returns_k(tmp_path):
+    """VERDICT item 8: with type_filter="pre" a type-filtered match returns
+    k hits whenever ≥k failures of that type exist — even when failures of
+    OTHER types score higher (where the reference-compatible "post" mode
+    returns fewer, reference: services/gfkb/app.py:89-91)."""
+    kb = _mk(tmp_path)
+    # 6 near-identical OTHER failures that will dominate raw top-5...
+    for i in range(6):
+        kb.upsert_failure(
+            failure_type="OTHER",
+            signature_text=_sig(f"Summarize the annual report with citations please v{i}"),
+            app_id=f"app-{i}",
+            impact_severity=Severity.low,
+        )
+    # ...and 5 weaker-matching HALLUCINATION_CITATION failures.
+    for i in range(5):
+        kb.upsert_failure(
+            failure_type="HALLUCINATION_CITATION",
+            signature_text=_sig(f"Write about topic {i} including citations"),
+            app_id=f"app-h{i}",
+            impact_severity=Severity.medium,
+        )
+    query = _sig("Summarize the annual report with citations please v0")
+    post = kb.match(query, failure_type="HALLUCINATION_CITATION", type_filter="post")
+    pre = kb.match(query, failure_type="HALLUCINATION_CITATION", type_filter="pre")
+    assert len(pre) == 5
+    assert all(m.failure_type == "HALLUCINATION_CITATION" for m in pre)
+    assert len(post) < len(pre)  # the documented reference behavior loses hits
+    # unknown type: pre returns empty, not an error
+    assert kb.match(query, failure_type="NEVER_SEEN", type_filter="pre") == []
+
+
+def test_match_during_concurrent_growth(tmp_path):
+    """Capacity growth re-embeds off the write lock; matches issued during
+    a growth storm must stay correct (never silently empty/wrong)."""
+    import threading
+
+    kb = GFKB(data_dir=tmp_path / "g", capacity=8, dim=512)
+    sig = _sig("Summarize with citations baseline")
+    kb.upsert_failure(
+        failure_type="HALLUCINATION_CITATION",
+        signature_text=sig,
+        app_id="a0",
+        impact_severity=Severity.medium,
+    )
+    errors = []
+
+    def grower():
+        try:
+            for i in range(200):
+                kb.upsert_failure(
+                    failure_type="OTHER",
+                    signature_text=_sig(f"filler row {i} to force doubling"),
+                    app_id="b",
+                    impact_severity=Severity.low,
+                )
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    t = threading.Thread(target=grower)
+    t.start()
+    try:
+        while t.is_alive():
+            hits = kb.match(sig)
+            assert hits and hits[0].score > 0.99, hits
+    finally:
+        t.join()
+    assert not errors, errors
+    assert kb.count == 201
+    # and the index is still exact post-growth
+    hits = kb.match(sig)
+    assert hits and hits[0].score > 0.99
+
+
 def test_batch_upsert_and_batch_match(tmp_path):
     kb = _mk(tmp_path)
     items = [

@@ -261,35 +261,84 @@ def test_multihost_config_parsing(monkeypatch):
     assert multihost_config() == {}
 
 
-def test_blocked_clustering_matches_dense():
+def _clustered_points(rng, n_clusters=3, per=40, dim=64, noise=0.05):
+    import numpy as np
+
+    centers = rng.normal(size=(n_clusters, dim))
+    centers /= np.linalg.norm(centers, axis=1, keepdims=True)
+    pts = np.concatenate([centers[i] + noise * rng.normal(size=(per, dim)) for i in range(n_clusters)])
+    pts /= np.linalg.norm(pts, axis=1, keepdims=True)
+    return pts
+
+
+def test_knn_graph_clustering_matches_dense():
     import numpy as np
 
     import kakveda_tpu.ops.clustering as cl
 
-    rng = np.random.default_rng(0)
-    # 3 well-separated cluster centers + per-point noise, unit-normalized
-    centers = rng.normal(size=(3, 64))
-    centers /= np.linalg.norm(centers, axis=1, keepdims=True)
-    pts = np.concatenate([
-        centers[i] + 0.05 * rng.normal(size=(40, 64)) for i in range(3)
-    ])
-    pts /= np.linalg.norm(pts, axis=1, keepdims=True)
-
+    pts = _clustered_points(np.random.default_rng(0))
     dense = cl.cluster_embeddings(pts, threshold=0.8)
 
-    # force the blocked path on the same data
-    orig_dense_max, orig_block = cl._DENSE_MAX, cl._BLOCK
-    cl._DENSE_MAX, cl._BLOCK = 0, 32
+    # force the sparse kNN-graph path with small blocks on the same data
+    orig = (cl._DENSE_MAX, cl._BLOCK, cl._QBLOCK)
+    cl._DENSE_MAX, cl._BLOCK, cl._QBLOCK = 0, 32, 48
     try:
-        cl._propagate_labels_blocked.clear_cache()
-        blocked = cl.cluster_embeddings(pts, threshold=0.8)
+        cl._block_topk.clear_cache()
+        sparse = cl.cluster_embeddings(pts, threshold=0.8)
     finally:
-        cl._DENSE_MAX, cl._BLOCK = orig_dense_max, orig_block
-        cl._propagate_labels_blocked.clear_cache()
+        cl._DENSE_MAX, cl._BLOCK, cl._QBLOCK = orig
+        cl._block_topk.clear_cache()
 
     # identical partitions (labels themselves are smallest-member indices)
-    assert (dense == blocked).all()
+    assert (dense == sparse).all()
     assert len(set(dense.tolist())) == 3
+
+
+def test_knn_graph_projection_rescore_matches_dense():
+    """The >131k-row tier (random-projection candidates + exact re-score)
+    must reproduce the dense partition on separable data."""
+    import numpy as np
+
+    import kakveda_tpu.ops.clustering as cl
+
+    pts = _clustered_points(np.random.default_rng(1), dim=512, per=30)
+    dense = cl.cluster_embeddings(pts, threshold=0.8)
+
+    orig = (cl._DENSE_MAX, cl._EXACT_SWEEP_MAX, cl._MINE_DIM)
+    cl._DENSE_MAX, cl._EXACT_SWEEP_MAX, cl._MINE_DIM = 0, 0, 64
+    try:
+        cl._block_topk.clear_cache()
+        sparse = cl.cluster_embeddings(pts, threshold=0.8)
+    finally:
+        cl._DENSE_MAX, cl._EXACT_SWEEP_MAX, cl._MINE_DIM = orig
+        cl._block_topk.clear_cache()
+    assert (dense == sparse).all()
+
+
+def test_knn_graph_hub_star_stays_connected():
+    """A hub with more above-threshold neighbors than k: spokes still reach
+    the hub through THEIR top-k (symmetric union), so the component
+    matches the dense threshold graph."""
+    import numpy as np
+
+    import kakveda_tpu.ops.clustering as cl
+
+    rng = np.random.default_rng(2)
+    hub = rng.normal(size=64)
+    hub /= np.linalg.norm(hub)
+    # 20 spokes close to the hub; pairwise spoke-spoke sim also high — use
+    # tight noise so dense graph is one component.
+    pts = np.concatenate([[hub], hub + 0.02 * rng.normal(size=(20, 64))])
+    pts /= np.linalg.norm(pts, axis=1, keepdims=True)
+
+    dense = cl.cluster_embeddings(pts, threshold=0.9)
+    orig = cl._DENSE_MAX
+    cl._DENSE_MAX = 0
+    try:
+        sparse = cl.cluster_embeddings(pts, threshold=0.9, knn_k=2)
+    finally:
+        cl._DENSE_MAX = orig
+    assert (dense == sparse).all()
 
 
 def test_tiered_classifier_uses_batch_judging():
@@ -325,21 +374,20 @@ def test_tiered_classifier_uses_batch_judging():
     assert all(s is not None for s in out)
 
 
-def test_blocked_clustering_threshold_zero_ignores_padding():
+def test_knn_graph_threshold_zero_ignores_padding():
     import numpy as np
 
     import kakveda_tpu.ops.clustering as cl
 
     vecs = np.eye(8, dtype=np.float32)[:5]  # 5 mutually-orthogonal rows
     orig_dense_max = cl._DENSE_MAX
-    cl._DENSE_MAX = 0  # force blocked path (pads 5 -> _BLOCK)
+    cl._DENSE_MAX = 0  # force sparse path (pads 5 -> _BLOCK)
     try:
-        cl._propagate_labels_blocked.clear_cache()
         labels = cl.cluster_embeddings(vecs, threshold=0.0)
     finally:
         cl._DENSE_MAX = orig_dense_max
-        cl._propagate_labels_blocked.clear_cache()
     # threshold 0 links cos>=0 pairs; orthogonal rows all have cos==0 so
-    # they all connect to each other — but via REAL rows, matching dense
+    # they all connect to each other — but via REAL rows (pad rows are
+    # masked to -inf and filtered), matching dense
     dense = cl.cluster_embeddings(vecs, threshold=0.0)
     assert (labels == dense).all()
