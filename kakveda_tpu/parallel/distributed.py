@@ -66,6 +66,21 @@ def multihost_config() -> Optional[dict]:
     return None
 
 
+def put_global(x, sharding):
+    """Place a host-global array onto a (possibly multi-process) sharding.
+
+    Single-process shardings take the fast device_put path; on a
+    multi-controller mesh each process materializes only its addressable
+    shards via ``make_array_from_callback`` (every process holds the same
+    host-global ``x`` — checkpoint loads and log replays are replicated
+    host work in this architecture)."""
+    import jax
+
+    if sharding.is_fully_addressable:
+        return jax.device_put(x, sharding)
+    return jax.make_array_from_callback(x.shape, sharding, lambda idx: x[idx])
+
+
 def initialize_multihost() -> bool:
     """Initialize jax.distributed when configured; returns True when the
     process joined a multi-host world. Must run before the first device

