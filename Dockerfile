@@ -24,7 +24,7 @@ COPY kakveda_tpu ./kakveda_tpu
 COPY config ./config
 COPY scripts ./scripts
 
-RUN pip install --no-cache-dir . \
+RUN pip install --no-cache-dir ".[postgres]" \
     && make -C kakveda_tpu/native
 
 ENV KAKVEDA_DATA_DIR=/app/data \

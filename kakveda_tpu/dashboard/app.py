@@ -17,10 +17,11 @@ from kakveda_tpu.core.runtime import get_runtime_config
 from kakveda_tpu.dashboard.core import (
     CTX_KEY,
     DashboardContext,
+    csrf_middleware,
     security_headers_middleware,
     user_middleware,
 )
-from kakveda_tpu.dashboard.db import Database
+from kakveda_tpu.dashboard.db import make_database
 from kakveda_tpu.models.runtime import ModelRuntime, get_runtime
 from kakveda_tpu.platform import Platform
 from kakveda_tpu.service.app import request_context_middleware
@@ -41,7 +42,7 @@ def make_dashboard_app(
         )
 
     plat = platform or Platform(**platform_kw)
-    db = Database(db_path or (Path(cfg.data_dir) / "dashboard.db"))
+    db = make_database(db_path or (Path(cfg.data_dir) / "dashboard.db"))
     # Demo accounts carry published credentials and self-repair to them on
     # every start — never in production (KAKVEDA_DEMO_USERS=1 overrides for
     # an explicit opt-in).
@@ -60,7 +61,12 @@ def make_dashboard_app(
 
     from kakveda_tpu.core import otel
 
-    middlewares = [request_context_middleware, user_middleware, security_headers_middleware]
+    middlewares = [
+        request_context_middleware,
+        user_middleware,
+        security_headers_middleware,
+        csrf_middleware,
+    ]
     if otel.setup_otel("dashboard"):
         middlewares.insert(0, otel.otel_middleware())
     app = web.Application(middlewares=middlewares)

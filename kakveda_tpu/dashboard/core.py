@@ -161,9 +161,57 @@ async def security_headers_middleware(request: web.Request, handler):
         response = await handler(request)
     except web.HTTPException as exc:
         _stamp_security_headers(exc)
+        _stamp_csrf_cookie(request, exc)
         raise
     _stamp_security_headers(response)
+    _stamp_csrf_cookie(request, response)
     return response
+
+
+CSRF_COOKIE = "csrf_token"
+
+
+def _stamp_csrf_cookie(request: web.Request, response) -> None:
+    """Issue the double-submit CSRF token cookie when absent — the
+    reference sets it even with enforcement disabled
+    (reference: services/dashboard/app.py:655-663), so clients are primed
+    before enforcement is switched on."""
+    if request.cookies.get(CSRF_COOKIE):
+        return
+    import secrets
+
+    try:
+        response.set_cookie(
+            CSRF_COOKIE,
+            secrets.token_urlsafe(32),
+            httponly=False,  # double-submit: JS must read it back
+            samesite="Lax",
+            secure=get_runtime_config(service_name="dashboard").env == "production",
+        )
+    except (AttributeError, RuntimeError):  # prepared/streamed responses
+        pass
+
+
+@web.middleware
+async def csrf_middleware(request: web.Request, handler):
+    """Double-submit CSRF check on mutating methods, enforcement gated on
+    ``KAKVEDA_CSRF_ENFORCE=1`` (the reference ships with enforcement
+    disabled too; the cookie issuance above keeps clients ready)."""
+    import os
+
+    if request.method in ("POST", "PUT", "PATCH", "DELETE") and os.environ.get(
+        "KAKVEDA_CSRF_ENFORCE", ""
+    ).lower() in ("1", "true", "yes"):
+        # /api/* authenticates by API key/bearer, not cookies — exempt.
+        if not request.path.startswith("/api/"):
+            cookie = request.cookies.get(CSRF_COOKIE, "")
+            sent = request.headers.get("X-CSRF-Token", "")
+            if not sent and request.content_type == "application/x-www-form-urlencoded":
+                form = await request.post()
+                sent = str(form.get("csrf_token", ""))
+            if not cookie or sent != cookie:
+                raise web.HTTPForbidden(text="CSRF token missing or mismatched")
+    return await handler(request)
 
 
 # --- shared rate limiter ---------------------------------------------------

@@ -1,18 +1,24 @@
-"""Dashboard persistence: stdlib sqlite3 with idempotent migrations.
+"""Dashboard persistence: sqlite (default) or Postgres behind one DAO.
 
 Table-for-table parity with the reference's 22 SQLAlchemy models + its
 hand-rolled ALTER-based migrate_db (reference: services/dashboard/db.py:
-25-362 models, 364-644 migrations). sqlite3 with WAL journaling and a thin
-row-dict DAO keeps the layer dependency-free; Postgres support can ride the
-same SQL later.
+25-362 models, 364-644 migrations). The default backend is stdlib sqlite3
+with WAL journaling; setting ``KAKVEDA_DB_URL=postgresql://…`` routes the
+SAME route-layer SQL through Postgres (the reference's prod compose runs
+Postgres, docker-compose.prod.yml) — the thin dialect shim below rewrites
+the three divergences (qmark params, AUTOINCREMENT, INSERT OR IGNORE)
+instead of dragging in an ORM.
 
-Connections are per-call (sqlite3 is cheap to open and this avoids
-cross-thread sharing issues under aiohttp's executor).
+Connections are per-call (cheap for sqlite, and it avoids cross-thread
+sharing issues under aiohttp's executor; Postgres callers who need more
+than the dashboard's modest QPS can front it with pgbouncer).
 """
 
 from __future__ import annotations
 
 import json
+import os
+import re
 import sqlite3
 import time
 import uuid
@@ -361,6 +367,142 @@ class Database:
             " VALUES (?,?,?,?,?,?)",
             (trace_id, parent_id, name, start_ts, end_ts, json.dumps(meta or {})),
         )
+
+
+# --- Postgres backend ------------------------------------------------------
+
+# Tables without a surrogate ``id`` column — INSERTs into these skip the
+# RETURNING clause the Postgres path uses in place of sqlite's lastrowid.
+_IDLESS_TABLES = frozenset(
+    {"user_roles", "project_members", "experiment_runs", "project_budgets",
+     "password_reset_tokens"}
+)
+
+_INSERT_RE = re.compile(r"^\s*INSERT\s+(OR\s+IGNORE\s+)?INTO\s+(\w+)", re.IGNORECASE)
+
+
+def pg_translate(sql: str) -> str:
+    """Route-layer (sqlite-flavored) SQL → Postgres. Only the constructs
+    this codebase uses: qmark params and INSERT OR IGNORE."""
+    m = _INSERT_RE.match(sql)
+    ignore = bool(m and m.group(1))
+    if ignore:
+        sql = re.sub(
+            r"INSERT\s+OR\s+IGNORE\s+INTO", "INSERT INTO", sql, count=1, flags=re.IGNORECASE
+        )
+    out = sql.replace("?", "%s")
+    if ignore:
+        out += " ON CONFLICT DO NOTHING"
+    return out
+
+
+def pg_schema(schema_sql: str) -> List[str]:
+    """The shared DDL → Postgres statements (AUTOINCREMENT → BIGSERIAL)."""
+    ddl = schema_sql.replace("INTEGER PRIMARY KEY AUTOINCREMENT", "BIGSERIAL PRIMARY KEY")
+    return [s.strip() for s in ddl.split(";") if s.strip()]
+
+
+class PgDatabase:
+    """Same DAO surface as :class:`Database`, speaking Postgres.
+
+    Gated on psycopg2 being importable — the driver is not vendored; the
+    prod compose image installs it (docker-compose.prod.yml)."""
+
+    def __init__(self, url: str):
+        try:
+            import psycopg2  # noqa: F401
+            import psycopg2.extras  # noqa: F401
+        except ImportError as e:  # pragma: no cover - driver present in prod image
+            raise RuntimeError(
+                "KAKVEDA_DB_URL points at Postgres but psycopg2 is not "
+                "installed; pip install psycopg2-binary (the prod compose "
+                "image does) or unset KAKVEDA_DB_URL for sqlite"
+            ) from e
+        self.url = url
+        self.path = url  # parity with Database.path for logs/doctor
+        self.init()
+
+    def connect(self):
+        import psycopg2
+        import psycopg2.extras
+
+        return psycopg2.connect(self.url, cursor_factory=psycopg2.extras.RealDictCursor)
+
+    def init(self) -> None:
+        conn = self.connect()
+        try:
+            with conn.cursor() as cur:
+                for stmt in pg_schema(_SCHEMA):
+                    cur.execute(stmt)
+            conn.commit()
+            for stmt in _MIGRATIONS:
+                try:
+                    with conn.cursor() as cur:
+                        cur.execute(pg_translate(stmt))
+                    conn.commit()
+                except Exception:  # noqa: BLE001 — column exists: idempotent
+                    conn.rollback()
+        finally:
+            conn.close()
+
+    def execute(self, sql: str, params: Iterable[Any] = ()) -> int:
+        tr = pg_translate(sql)
+        m = _INSERT_RE.match(sql)
+        want_id = bool(m) and m.group(2).lower() not in _IDLESS_TABLES and "RETURNING" not in tr.upper()
+        if want_id:
+            tr += " RETURNING id"
+        conn = self.connect()
+        try:
+            with conn.cursor() as cur:
+                cur.execute(tr, tuple(params))
+                rid = 0
+                if want_id:
+                    row = cur.fetchone()
+                    rid = int(row["id"]) if row else 0
+            conn.commit()
+            return rid
+        finally:
+            conn.close()
+
+    def execute_rowcount(self, sql: str, params: Iterable[Any] = ()) -> int:
+        conn = self.connect()
+        try:
+            with conn.cursor() as cur:
+                cur.execute(pg_translate(sql), tuple(params))
+                rc = cur.rowcount
+            conn.commit()
+            return rc
+        finally:
+            conn.close()
+
+    def query(self, sql: str, params: Iterable[Any] = ()) -> List[Dict[str, Any]]:
+        conn = self.connect()
+        try:
+            with conn.cursor() as cur:
+                cur.execute(pg_translate(sql), tuple(params))
+                return [dict(r) for r in cur.fetchall()]
+        finally:
+            conn.close()
+
+    def one(self, sql: str, params: Iterable[Any] = ()) -> Optional[Dict[str, Any]]:
+        rows = self.query(sql, params)
+        return rows[0] if rows else None
+
+    # Shared helpers are identical SQL-wise — reuse Database's implementations.
+    bootstrap = Database.bootstrap
+    user_by_email = Database.user_by_email
+    user_roles = Database.user_roles
+    audit = Database.audit
+    add_span = Database.add_span
+
+
+def make_database(path: str | Path):
+    """sqlite at ``path`` unless KAKVEDA_DB_URL selects Postgres — one env
+    var flips the whole dashboard, no route changes."""
+    url = os.environ.get("KAKVEDA_DB_URL", "").strip()
+    if url.startswith(("postgres://", "postgresql://")):
+        return PgDatabase(url)
+    return Database(path)
 
 
 def new_trace_id() -> str:
