@@ -310,6 +310,71 @@ def _measure_decode(preset: str, bsz: int, steps: int) -> dict:
     }
 
 
+def _measure_mixed(n: int, dim: int) -> dict:
+    """Warn latency under concurrent streaming ingest — the decoupling
+    claim: match dispatches serialize only on microsecond-scale lock holds,
+    never on ingest's host-side embedding or growth re-embeds. Reports
+    warn p50 idle vs p50 with a background ingest_batch storm."""
+    import asyncio
+    import tempfile
+    import threading
+    from datetime import datetime, timezone
+    from pathlib import Path
+
+    from kakveda_tpu.core.schemas import TracePayload, WarningRequest
+    from kakveda_tpu.platform import Platform
+
+    tmp = Path(tempfile.mkdtemp(prefix="kakveda-mixed-"))
+    plat = Platform(data_dir=tmp, capacity=max(n, 1 << 15), dim=dim)
+
+    def mk_traces(m: int, tag: str):
+        ts = datetime.now(timezone.utc)
+        return [
+            TracePayload(
+                trace_id=f"t-{tag}-{i}", ts=ts, app_id=f"app-{i % 7}", agent_id="bench",
+                prompt=f"Summarize report {tag}-{i} with citations for every claim.",
+                response=f"Done [{i}] (Smith 2021).", tools=[], env={"os": "linux"},
+            )
+            for i in range(m)
+        ]
+
+    reqs = [
+        WarningRequest(app_id="app-0", agent_id="bench",
+                       prompt=f"Explain document {i} and include citations", tools=[], env={})
+        for i in range(64)
+    ]
+    # Seed + warm both compiled paths.
+    asyncio.run(plat.ingest_batch(mk_traces(512, "seed")))
+    plat.warn_batch(reqs)
+
+    def warn_p50(rounds: int) -> float:
+        lat = []
+        for _ in range(rounds):
+            t0 = time.perf_counter()
+            plat.warn_batch(reqs)
+            lat.append((time.perf_counter() - t0) * 1000.0 / len(reqs))
+        return float(np.percentile(lat, 50))
+
+    idle_p50 = warn_p50(30)
+
+    stop = threading.Event()
+
+    def ingest_storm():
+        i = 0
+        while not stop.is_set():
+            asyncio.run(plat.ingest_batch(mk_traces(512, f"s{i}")))
+            i += 1
+
+    t = threading.Thread(target=ingest_storm)
+    t.start()
+    try:
+        loaded_p50 = warn_p50(30)
+    finally:
+        stop.set()
+        t.join()
+    return {"idle_p50_ms": idle_p50, "loaded_p50_ms": loaded_p50}
+
+
 def _measure_reference(dim_corpus: int, n_queries: int, target_n: int) -> float:
     """Reference algorithm (TF-IDF refit per query) on this host, timed at
     ``dim_corpus`` rows and linearly extrapolated to ``target_n`` rows."""
@@ -408,20 +473,42 @@ def _bench_decode(backend: str) -> dict:
     }
 
 
+def _bench_mixed(backend: str) -> dict:
+    n = int(os.environ.get("KAKVEDA_BENCH_MIXED_N", 1 << 15))
+    dim = int(os.environ.get("KAKVEDA_BENCH_DIM", 2048))
+    print(f"bench[mixed]: backend={backend} n={n} dim={dim}", file=sys.stderr)
+    r = _measure_mixed(n, dim)
+    print(
+        f"bench[mixed]: warn p50 idle {r['idle_p50_ms']:.3f} ms vs under-ingest "
+        f"{r['loaded_p50_ms']:.3f} ms",
+        file=sys.stderr,
+    )
+    return {
+        "metric": "warn_p50_ms_under_concurrent_ingest",
+        "value": round(r["loaded_p50_ms"], 3),
+        "unit": "ms",
+        "vs_baseline": round(r["idle_p50_ms"] / r["loaded_p50_ms"], 2)
+        if r["loaded_p50_ms"] > 0
+        else 0.0,
+        "idle_p50_ms": round(r["idle_p50_ms"], 3),
+    }
+
+
 def main() -> int:
     import jax
 
     backend = jax.default_backend()
     which = os.environ.get("KAKVEDA_BENCH_METRIC", "all")
 
-    if which in ("warn", "ingest", "decode"):
-        print(json.dumps({"warn": _bench_warn, "ingest": _bench_ingest, "decode": _bench_decode}[which](backend)))
+    if which in ("warn", "ingest", "decode", "mixed"):
+        fns = {"warn": _bench_warn, "ingest": _bench_ingest, "decode": _bench_decode, "mixed": _bench_mixed}
+        print(json.dumps(fns[which](backend)))
         return 0
 
     # Default: every metric in one run, one JSON line — the driver records
     # the whole object, so warn + ingest + decode all land in BENCH_r{N}.json.
     results = []
-    for fn in (_bench_warn, _bench_ingest, _bench_decode):
+    for fn in (_bench_warn, _bench_ingest, _bench_decode, _bench_mixed):
         try:
             results.append(fn(backend))
         except Exception as e:  # noqa: BLE001 — one failed metric must not hide the others
