@@ -103,7 +103,14 @@ class GFKB:
         self._records: List[CanonicalFailureRecord] = []
         self._slot_by_key: Dict[Tuple[str, str], int] = {}
         self._slot_by_id: Dict[str, int] = {}
-        self._patterns: Dict[str, PatternEntity] = {}  # name -> latest
+        # Pattern store: set-backed mutable state per name. The log is
+        # DELTA-append — each line carries only the failure_ids/apps new in
+        # that upsert, and replay unions lines — because re-appending the
+        # full membership per upsert (the reference's model,
+        # services/gfkb/app.py:168-198) makes both the log and the per-batch
+        # serialize cost O(N²) over a failure stream. Full-record lines from
+        # older logs replay identically (union of growing prefixes).
+        self._pattern_state: Dict[str, dict] = {}  # name -> mutable state
         self._snapshot_write_lock = threading.Lock()
         # Bumped by reload(); snapshot() aborts if it changed mid-write so a
         # purge (external log rewrite + reload) can't race a snapshot into
@@ -220,7 +227,7 @@ class GFKB:
                 if not line.strip():
                     continue
                 p = PatternEntity.model_validate(json.loads(line))
-                self._patterns[p.name] = p
+                self._merge_pattern_line(p)
 
     # --- snapshot / restore --------------------------------------------
 
@@ -402,7 +409,7 @@ class GFKB:
             self._records = []
             self._slot_by_key = {}
             self._slot_by_id = {}
-            self._patterns = {}
+            self._pattern_state = {}
             self._ids_by_type = {}
             self._apps_by_type = {}
             if self.persist:
@@ -704,7 +711,11 @@ class GFKB:
             if len(self._records) > self._knn.capacity:
                 self._grow_and_reembed()
                 return
-            vecs = self.featurizer.encode_batch(texts)
+            # Sparse path: hashed-ngram rows are ~98% zeros; shipping (idx,
+            # val) pairs instead of dense [B, dim] keeps streaming ingest off
+            # the host→device wire bottleneck (the dense transfer dominated
+            # the whole pipeline at 10k traces/sec rates).
+            sp_idx, sp_val = self.featurizer.encode_batch_sparse(texts)
             arr_slots = np.asarray(slots, dtype=np.int32)
             arr_tids = np.asarray(tids, dtype=np.int32)
             with self._lock:
@@ -715,10 +726,9 @@ class GFKB:
                 else:
                     need_growth = False
                     with profiling.annotate("gfkb.insert"):
-                        self._emb, self._valid = self._knn.insert(
-                            self._emb, self._valid, vecs, arr_slots
+                        self._emb, self._valid, self._types = self._knn.insert_sparse(
+                            self._emb, self._valid, self._types, sp_idx, sp_val, arr_slots, arr_tids
                         )
-                        self._types = self._knn.scatter_i32(self._types, arr_slots, arr_tids)
                     self._publish()
             if need_growth:
                 self._grow_and_reembed()
@@ -824,11 +834,52 @@ class GFKB:
     # patterns
     # ------------------------------------------------------------------
 
+    def _merge_pattern_line(self, p: PatternEntity) -> None:
+        """Union one log line into the in-memory state (replay path). Works
+        for both delta lines and legacy full-membership lines."""
+        st = self._pattern_state.get(p.name)
+        if st is None:
+            self._pattern_state[p.name] = {
+                "pattern_id": p.pattern_id,
+                "name": p.name,
+                "created_at": p.created_at,
+                "fid_list": list(dict.fromkeys(p.failure_ids)),
+                "fid_set": set(p.failure_ids),
+                "app_list": list(dict.fromkeys(p.affected_apps)),
+                "app_set": set(p.affected_apps),
+                "description": p.description,
+            }
+            return
+        for f in p.failure_ids:
+            if f not in st["fid_set"]:
+                st["fid_set"].add(f)
+                st["fid_list"].append(f)
+        for a in p.affected_apps:
+            if a not in st["app_set"]:
+                st["app_set"].add(a)
+                st["app_list"].append(a)
+        if p.description:
+            st["description"] = p.description
+
+    def _pattern_view(self, st: dict) -> PatternEntity:
+        """Materialized read view. Lists are copied so callers can't mutate
+        live state; membership order is insertion order (first-seen), not
+        lexicographic — sorting N ids per upsert is exactly the O(N log N)
+        per-batch cost the delta design removes."""
+        return PatternEntity.model_construct(
+            pattern_id=st["pattern_id"],
+            name=st["name"],
+            created_at=st["created_at"],
+            failure_ids=list(st["fid_list"]),
+            affected_apps=list(st["app_list"]),
+            description=st["description"],
+        )
+
     def list_patterns(self) -> List[PatternEntity]:
-        """Latest record per pattern (dedup-for-presentation, like the
+        """Latest state per pattern (dedup-for-presentation, like the
         reference's GET /patterns, services/gfkb/app.py:150-157)."""
         with self._lock:
-            return list(self._patterns.values())
+            return [self._pattern_view(st) for st in self._pattern_state.values()]
 
     def upsert_pattern(
         self,
@@ -839,26 +890,45 @@ class GFKB:
         description: Optional[str] = None,
     ) -> Tuple[PatternEntity, bool]:
         """Identity-by-name pattern upsert with set-union merge
-        (reference: services/gfkb/app.py:168-198)."""
+        (reference: services/gfkb/app.py:168-198).
+
+        Streaming-safe: the in-memory union is set-backed (O(delta) per
+        call), only the *new* members are appended to the log, and a no-op
+        upsert (nothing new) skips the append entirely."""
         with self._lock:
-            existing = self._patterns.get(name)
-            if existing is None:
-                p = PatternEntity(
-                    pattern_id=f"FP-{len(self._patterns) + 1:04d}",
-                    name=name,
-                    created_at=utcnow(),
-                    failure_ids=sorted(set(failure_ids)),
-                    affected_apps=sorted(set(affected_apps)),
-                    description=description,
-                )
-                created = True
-            else:
-                p = existing.model_copy(deep=True)
-                p.failure_ids = sorted(set(list(p.failure_ids) + list(failure_ids)))
-                p.affected_apps = sorted(set(list(p.affected_apps) + list(affected_apps)))
-                p.description = description or p.description
-                created = False
-            self._patterns[name] = p
-            self._append_jsonl(self.patterns_path, p.model_dump(mode="json"))
+            st = self._pattern_state.get(name)
+            created = st is None
+            if created:
+                st = {
+                    "pattern_id": f"FP-{len(self._pattern_state) + 1:04d}",
+                    "name": name,
+                    "created_at": utcnow(),
+                    "fid_list": [],
+                    "fid_set": set(),
+                    "app_list": [],
+                    "app_set": set(),
+                    "description": description,
+                }
+                self._pattern_state[name] = st
+            new_f = [f for f in dict.fromkeys(failure_ids) if f not in st["fid_set"]]
+            new_a = [a for a in dict.fromkeys(affected_apps) if a not in st["app_set"]]
+            desc_changed = bool(description) and description != st["description"]
+            if not created and not new_f and not new_a and not desc_changed:
+                return self._pattern_view(st), False
+            st["fid_list"].extend(new_f)
+            st["fid_set"].update(new_f)
+            st["app_list"].extend(new_a)
+            st["app_set"].update(new_a)
+            if description:
+                st["description"] = description
+            delta = PatternEntity.model_construct(
+                pattern_id=st["pattern_id"],
+                name=name,
+                created_at=st["created_at"],
+                failure_ids=new_f,
+                affected_apps=new_a,
+                description=st["description"],
+            )
+            self._append_line(self.patterns_path, delta.model_dump_json())
             self._flush_logs()
-            return p, created
+            return self._pattern_view(st), created

@@ -1,0 +1,299 @@
+"""Fused attention kernels for the Llama runtime.
+
+The reference never runs a model forward itself (it HTTP-calls Ollama;
+reference: services/dashboard/app.py:1182-1258) — this module is the
+TPU-native replacement's hot path. Two tiers over one contract:
+
+``gqa_cache_attention(q, k, v, pos0, kv_valid)``
+    q            [B, S, H, D]    queries (prefill chunk or decode step)
+    k, v         [B, KV, L, D]   KV cache, head-major so each head's rows
+                                 are contiguous for DMA streaming
+    pos0         scalar int32    cache slot of q[:, 0] (cache["pos"])
+    kv_valid     [B, L] bool     optional per-slot validity (left-pad batching)
+    -> [B, S, H, D]
+
+* **XLA path** (`_gqa_xla`): grouped einsum that keeps the GQA group axis
+  explicit — K/V are *never* repeated to H heads, so the cache is read once
+  per step instead of ``n_rep`` (=8 for Llama-3/TinyLlama) times. At 1B
+  scale, repeat-materialization was ~1.5 GB of HBM traffic per decode step
+  — more than the weights.
+* **Pallas flash path** (`flash_gqa_cache`): blockwise online-softmax
+  attention (flash attention) — scores live only in VMEM tiles, never a
+  ``[B, H, S, L]`` f32 HBM tensor. GQA-native: the group's ``R`` query
+  heads are folded into the q-row axis so each (batch, kv-head) program is
+  one ``[S·R, D] @ [D, L_blk]`` MXU matmul per cache tile. Dispatched for
+  long-context inference shapes (see `_flash_wins`) where the XLA path's
+  transient score scratch gets into the gigabytes; at short serving shapes
+  the batched einsum is faster because the Pallas grid serializes over
+  B·KV small programs. Training always uses the XLA path (it
+  differentiates).
+
+Both paths produce identical logits (tested to ~1e-5 in f32; see
+tests/test_attention.py). One documented don't-care divergence: a query row
+with NO visible slot (a left-pad position earlier than every valid cache
+slot) softmaxes to a uniform average in the XLA paths but emits zeros from
+the flash kernel; such rows are pad positions whose activations can't reach
+any real token's logits (their K/V slots are themselves masked).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+# ---------------------------------------------------------------------------
+# XLA grouped path (differentiable; CPU + fallback)
+# ---------------------------------------------------------------------------
+
+
+def _gqa_xla(q, k, v, pos0, kv_valid):
+    b, s, h, d = q.shape
+    _, kv, l, _ = k.shape
+    r = h // kv
+    scale = d**-0.5
+    # [B,S,H,D] -> [B,KV,S,R,D]; group axis stays explicit so XLA batches
+    # the matmul over KV instead of materializing repeated K/V.
+    q5 = q.reshape(b, s, kv, r, d).transpose(0, 2, 1, 3, 4)
+    scores = jnp.einsum("bgsrd,bgld->bgsrl", q5, k).astype(jnp.float32) * scale
+    q_pos = pos0 + jnp.arange(s)
+    l_pos = jnp.arange(l)
+    mask = q_pos[:, None] >= l_pos[None, :]  # [S, L]
+    if kv_valid is not None:
+        full = mask[None, :, :] & kv_valid[:, None, :]  # [B, S, L]
+        scores = jnp.where(full[:, None, :, None, :], scores, _NEG_INF)
+    else:
+        scores = jnp.where(mask[None, None, :, None, :], scores, _NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    out = jnp.einsum("bgsrl,bgld->bgsrd", probs, v)
+    return out.transpose(0, 2, 1, 3, 4).reshape(b, s, h, d).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Pallas flash kernel
+# ---------------------------------------------------------------------------
+
+
+def _flash_kernel(
+    pos0_ref,  # SMEM [1, 1]
+    q_ref,  # VMEM [1, q_blk, D]
+    k_ref,  # VMEM [1, l_blk, D]
+    v_ref,  # VMEM [1, l_blk, D]
+    valid_ref,  # VMEM [1, 1, l_blk] f32
+    o_ref,  # VMEM [1, q_blk, D]
+    m_scr,  # VMEM [q_blk, 128] f32
+    l_scr,  # VMEM [q_blk, 128] f32
+    acc_scr,  # VMEM [q_blk, D] f32
+    *,
+    r: int,
+    q_blk: int,
+    l_blk: int,
+    n_l: int,
+    scale: float,
+):
+    lb = pl.program_id(2)
+    qb = pl.program_id(1)
+
+    @pl.when(lb == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    q = q_ref[0]
+    k = k_ref[0]
+    # [q_blk, l_blk] scores on the MXU, f32 accumulation.
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale
+
+    # Causal + validity mask. Query rows fold (seq, group-head): row i is
+    # sequence position (qb*q_blk + i) // r.
+    rows = jax.lax.broadcasted_iota(jnp.int32, (q_blk, l_blk), 0)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (q_blk, l_blk), 1)
+    q_pos = pos0_ref[0, 0] + (qb * q_blk + rows) // r
+    l_pos = lb * l_blk + cols
+    keep = (q_pos >= l_pos) & (valid_ref[0, 0][None, :] > 0.5)
+    s = jnp.where(keep, s, _NEG_INF)
+
+    m_prev = m_scr[:, :1]  # [q_blk, 1] (all lanes equal; col 0 is truth)
+    m_cur = jnp.max(s, axis=1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    # Re-mask after exp: on an all-masked tile, s - m_new == 0 would exp to 1.
+    p = jnp.where(keep, jnp.exp(s - m_new), 0.0)
+    corr = jnp.exp(m_prev - m_new)  # [q_blk, 1]
+    l_new = l_scr[:, :1] * corr + jnp.sum(p, axis=1, keepdims=True)
+    pv = jax.lax.dot_general(
+        p.astype(v_ref.dtype),
+        v_ref[0],
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    acc_scr[:] = acc_scr[:] * corr + pv
+    m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+    l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(lb == n_l - 1)
+    def _emit():
+        denom = jnp.maximum(l_scr[:, :1], 1e-20)
+        o_ref[0] = (acc_scr[:] / denom).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("q_blk", "l_blk", "interpret"))
+def flash_gqa_cache(
+    q: jax.Array,  # [B, S, H, D]
+    k: jax.Array,  # [B, KV, L, D]
+    v: jax.Array,  # [B, KV, L, D]
+    pos0: jax.Array,
+    kv_valid: jax.Array | None,
+    *,
+    q_blk: int = 512,
+    l_blk: int = 512,
+    interpret: bool = False,
+) -> jax.Array:
+    b, s, h, d = q.shape
+    _, kv, l, _ = k.shape
+    r = h // kv
+    sr = s * r
+    q_blk = min(q_blk, sr)
+    l_blk = min(l_blk, l)
+    if sr % q_blk or l % l_blk:
+        raise ValueError(f"flash layout: SR={sr} q_blk={q_blk} L={l} l_blk={l_blk}")
+
+    # Fold (seq, group-head) into the q-row axis: [B*KV, S*R, D].
+    qf = (
+        q.reshape(b, s, kv, r, d)
+        .transpose(0, 2, 1, 3, 4)
+        .reshape(b * kv, sr, d)
+        .astype(k.dtype)
+    )
+    kf = k.reshape(b * kv, l, d)
+    vf = v.reshape(b * kv, l, d)
+    valid = (
+        jnp.ones((b, 1, l), jnp.float32)
+        if kv_valid is None
+        else kv_valid.astype(jnp.float32).reshape(b, 1, l)
+    )
+    pos = jnp.asarray(pos0, jnp.int32).reshape(1, 1)
+    n_q = sr // q_blk
+    n_l = l // l_blk
+
+    out = pl.pallas_call(
+        functools.partial(
+            _flash_kernel,
+            r=r,
+            q_blk=q_blk,
+            l_blk=l_blk,
+            n_l=n_l,
+            scale=d**-0.5,
+        ),
+        grid=(b * kv, n_q, n_l),
+        in_specs=[
+            pl.BlockSpec((1, 1), lambda bg, qb, lb: (0, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, q_blk, d), lambda bg, qb, lb: (bg, qb, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, l_blk, d), lambda bg, qb, lb: (bg, lb, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, l_blk, d), lambda bg, qb, lb: (bg, lb, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, l_blk), lambda bg, qb, lb, _kv=kv: (bg // _kv, 0, lb), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, q_blk, d), lambda bg, qb, lb: (bg, qb, 0), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((b * kv, sr, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((q_blk, 128), jnp.float32),
+            pltpu.VMEM((q_blk, 128), jnp.float32),
+            pltpu.VMEM((q_blk, d), jnp.float32),
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=4 * b * kv * sr * l * d,
+            bytes_accessed=(b * kv * (sr + 2 * l) * d * k.dtype.itemsize),
+            transcendentals=b * kv * sr * l,
+        ),
+        interpret=interpret,
+    )(pos, qf, kf, vf, valid)
+
+    # [B*KV, S*R, D] -> [B, S, H, D]
+    return (
+        out.reshape(b, kv, s, r, d).transpose(0, 2, 1, 3, 4).reshape(b, s, h, d)
+    ).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# dispatch
+# ---------------------------------------------------------------------------
+
+
+def _flash_ok(s: int, h: int, kv: int, l: int, d: int) -> bool:
+    """Layout gate: q rows fold to S·R which must tile by 8 (f32 sublane),
+    the cache length must tile by the l-block, and lanes want d % 128 == 0
+    or d == 64 (Mosaic pads 64-lane tiles acceptably)."""
+    r = h // kv
+    sr = s * r
+    return (
+        h % kv == 0
+        and sr % 8 == 0
+        and l % 128 == 0
+        and (d % 128 == 0 or d == 64)
+    )
+
+
+def _flash_wins(s: int, h: int, kv: int, l: int) -> bool:
+    """Profitability gate, measured on v5e (see docs/performance.md): the
+    Pallas grid serializes over B·KV programs, so at short S·R / short cache
+    the batched XLA einsum is faster (its [B,KV,S,R,L] f32 scratch is small
+    and transient). Flash wins where that scratch gets big — long-context
+    prefill and long caches — and is mandatory where XLA's scratch would
+    not fit HBM at all (S and L in the thousands)."""
+    r = h // kv
+    return (s * r) * l >= 1024 * 2048
+
+
+def _pick_block(n: int, cap: int, step: int) -> int:
+    """Largest divisor of ``n`` that is ≤ cap and a multiple of ``step``."""
+    best = step
+    c = step
+    while c <= min(n, cap):
+        if n % c == 0:
+            best = c
+        c += step
+    return best
+
+
+def gqa_cache_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    pos0: jax.Array,
+    kv_valid: jax.Array | None = None,
+    *,
+    use_flash: bool | None = None,
+) -> jax.Array:
+    """Cached GQA attention — dispatches to the Pallas flash kernel on TPU
+    (inference shapes that fit its tiling), XLA grouped einsum otherwise.
+    ``KAKVEDA_FLASH=0`` forces the XLA path."""
+    b, s, h, d = q.shape
+    _, kv, l, _ = k.shape
+    if use_flash is None:
+        env = os.environ.get("KAKVEDA_FLASH", "auto")
+        use_flash = (
+            env != "0"
+            and jax.default_backend() == "tpu"
+            and _flash_ok(s, h, kv, l, d)
+            and (env == "1" or _flash_wins(s, h, kv, l))
+        )
+    if use_flash:
+        r = h // kv
+        sr = s * r
+        return flash_gqa_cache(
+            q, k, v, pos0, kv_valid,
+            q_blk=_pick_block(sr, 512, 8),
+            l_blk=_pick_block(l, 512, 128),
+        )
+    return _gqa_xla(q, k, v, pos0, kv_valid)

@@ -35,9 +35,9 @@ from kakveda_tpu.models.runtime import GenerateResult
 from kakveda_tpu.models.tokenizer import ByteTokenizer
 
 
-@partial(jax.jit, static_argnames=("cfg",))
-def _decode_jit(params, cfg: LlamaConfig, tokens, cache):
-    return decode_step(params, cfg, tokens, cache)
+@partial(jax.jit, static_argnames=("cfg", "last_only"))
+def _decode_jit(params, cfg: LlamaConfig, tokens, cache, last_only=False):
+    return decode_step(params, cfg, tokens, cache, last_only=last_only)
 
 
 def _last_logits(logits: jax.Array, cfg: LlamaConfig) -> jax.Array:
@@ -96,7 +96,7 @@ def generate_tokens(
         rng = jax.random.PRNGKey(0)
 
     prompt = jnp.asarray([prompt_ids], jnp.int32)
-    logits, cache = _decode_jit(params, cfg, prompt, cache)
+    logits, cache = _decode_jit(params, cfg, prompt, cache, last_only=True)
     last = _last_logits(logits, cfg)
 
     out: list[int] = []
@@ -120,9 +120,11 @@ def generate_tokens(
     return out
 
 
-@partial(jax.jit, static_argnames=("cfg",))
-def _decode_batch_jit(params, cfg: LlamaConfig, tokens, cache, kv_valid, pos_offset):
-    return decode_step(params, cfg, tokens, cache, kv_valid=kv_valid, pos_offset=pos_offset)
+@partial(jax.jit, static_argnames=("cfg", "last_only"))
+def _decode_batch_jit(params, cfg: LlamaConfig, tokens, cache, kv_valid, pos_offset, last_only=False):
+    return decode_step(
+        params, cfg, tokens, cache, kv_valid=kv_valid, pos_offset=pos_offset, last_only=last_only
+    )
 
 
 def generate_tokens_batch(
@@ -179,7 +181,9 @@ def generate_tokens_batch(
     if rng is None:
         rng = jax.random.PRNGKey(0)
 
-    logits, cache = _decode_batch_jit(params, cfg, jnp.asarray(toks), cache, kv_valid, pos_offset)
+    logits, cache = _decode_batch_jit(
+        params, cfg, jnp.asarray(toks), cache, kv_valid, pos_offset, last_only=True
+    )
     last = _last_logits(logits, cfg)
 
     outs: list[list[int]] = [[] for _ in range(bsz)]
@@ -222,7 +226,9 @@ def _generate_fused_jit(
     max_new_tokens: int,
     greedy: bool,
 ):
-    logits, cache = decode_step(params, cfg, prompt, cache, kv_valid=kv_valid, pos_offset=pos_offset)
+    logits, cache = decode_step(
+        params, cfg, prompt, cache, kv_valid=kv_valid, pos_offset=pos_offset, last_only=True
+    )
     last = logits[:, -1, :]
     if cfg.effective_vocab is not None:
         last = last.at[:, cfg.effective_vocab :].set(-jnp.inf)

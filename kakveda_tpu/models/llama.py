@@ -187,7 +187,11 @@ def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
 
 
 def _repeat_kv(x: jax.Array, n_rep: int) -> jax.Array:
-    """[B, S, KV, D] -> [B, S, KV*n_rep, D] (GQA broadcast)."""
+    """[B, S, KV, D] -> [B, S, KV*n_rep, D] (GQA broadcast).
+
+    Only the reference-oracle `causal_attention` and the ring fallback use
+    this — the production paths keep the group axis explicit
+    (models/attention.py) so K/V are never materialized ``n_rep``-wide."""
     if n_rep == 1:
         return x
     b, s, kv, d = x.shape
@@ -195,9 +199,11 @@ def _repeat_kv(x: jax.Array, n_rep: int) -> jax.Array:
 
 
 def causal_attention(q: jax.Array, k: jax.Array, v: jax.Array, q_off: jax.Array | int = 0) -> jax.Array:
-    """Plain causal attention. q: [B,Sq,H,D], k/v: [B,Sk,H,D] (already
-    GQA-repeated). ``q_off`` is the global position of q[0] relative to k[0]
-    (for cached decode). Returns [B,Sq,H,D]."""
+    """Plain causal attention — the readable O(S²)-memory reference oracle
+    that the fused paths are parity-tested against (tests/test_llama.py).
+    q: [B,Sq,H,D], k/v: [B,Sk,H,D] (already GQA-repeated). ``q_off`` is the
+    global position of q[0] relative to k[0] (for cached decode). Returns
+    [B,Sq,H,D]."""
     scale = q.shape[-1] ** -0.5
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
     q_pos = jnp.arange(q.shape[1]) + q_off
@@ -222,24 +228,29 @@ def ring_attention_local(
     (ppermute over ICI). FLOP-pattern equivalent to blockwise flash
     attention across devices; no device ever holds the full sequence.
 
-    q/k/v: [B, S_local, H_local, D] (kv already GQA-repeated).
+    q: [B, S_local, H_local, D]; k/v: [B, S_local, KV_local, D] —
+    **un-repeated** GQA heads, so each ring hop moves the raw KV chunk
+    (n_rep× less ICI traffic than rotating repeated heads).
     """
     b, s_l, h, d = q.shape
+    kv = k.shape[2]
+    r = h // kv
     scale = d**-0.5
     me = jax.lax.axis_index(axis_name)
 
+    q5 = q.reshape(b, s_l, kv, r, d)
     q_pos = me * s_l + jnp.arange(s_l)  # global positions of local queries
-    m = jnp.full((b, h, s_l), _NEG_INF, jnp.float32)
-    l = jnp.zeros((b, h, s_l), jnp.float32)
-    acc = jnp.zeros((b, h, s_l, d), jnp.float32)
+    m = jnp.full((b, kv, r, s_l), _NEG_INF, jnp.float32)
+    l = jnp.zeros((b, kv, r, s_l), jnp.float32)
+    acc = jnp.zeros((b, kv, r, s_l, d), jnp.float32)
 
     perm = [(j, (j + 1) % n_chunks) for j in range(n_chunks)]
     k_cur, v_cur = k, v
     for i in range(n_chunks):  # static unroll: n_chunks is a mesh constant
         src = (me - i) % n_chunks  # whose chunk we hold this step
         k_pos = src * s_l + jnp.arange(s_l)
-        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_cur).astype(jnp.float32) * scale
-        mask = (q_pos[:, None] >= k_pos[None, :])[None, None]
+        scores = jnp.einsum("bqgrd,bkgd->bgrqk", q5, k_cur).astype(jnp.float32) * scale
+        mask = (q_pos[:, None] >= k_pos[None, :])[None, None, None]
         scores = jnp.where(mask, scores, _NEG_INF)
 
         chunk_max = jnp.max(scores, axis=-1)
@@ -250,7 +261,7 @@ def ring_attention_local(
         corr = jnp.exp(m - m_new)
         l = l * corr + jnp.sum(p, axis=-1)
         acc = acc * corr[..., None] + jnp.einsum(
-            "bhqk,bkhd->bhqd", p.astype(v_cur.dtype), v_cur
+            "bgrqk,bkgd->bgrqd", p.astype(v_cur.dtype), v_cur
         ).astype(jnp.float32)
         m = m_new
 
@@ -259,7 +270,8 @@ def ring_attention_local(
             v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
 
     out = acc / jnp.maximum(l[..., None], 1e-20)
-    return jnp.einsum("bhqd->bqhd", out).astype(q.dtype)
+    # [B, KV, R, S, D] -> [B, S, H, D]
+    return out.transpose(0, 3, 1, 2, 4).reshape(b, s_l, h, d).astype(q.dtype)
 
 
 # ---------------------------------------------------------------------------
@@ -287,13 +299,15 @@ def _attention_block(
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
 
-    n_rep = cfg.n_heads // cfg.n_kv_heads
-    k = _repeat_kv(k, n_rep)
-    v = _repeat_kv(v, n_rep)
-
     if mesh is not None and cp_axis is not None and mesh.shape[cp_axis] > 1:
         n_cp = mesh.shape[cp_axis]
         tp = "tp" if "tp" in mesh.axis_names else None
+        tp_size = mesh.shape[tp] if tp else 1
+        if cfg.n_kv_heads % tp_size:
+            # TP shards the head axis; grouped ring needs whole KV groups
+            # per shard, so fall back to rotating repeated heads.
+            k = _repeat_kv(k, cfg.n_heads // cfg.n_kv_heads)
+            v = _repeat_kv(v, cfg.n_heads // cfg.n_kv_heads)
         spec = P("dp", cp_axis, tp, None)
         attn = jax.shard_map(
             partial(ring_attention_local, axis_name=cp_axis, n_chunks=n_cp),
@@ -303,7 +317,11 @@ def _attention_block(
             check_vma=False,
         )(q, k, v)
     else:
-        attn = causal_attention(q, k, v)
+        # Grouped attention over the whole sequence: K/V head-major, no
+        # GQA repeat, differentiable XLA path (training runs through here).
+        from kakveda_tpu.models.attention import _gqa_xla
+
+        attn = _gqa_xla(q, k.transpose(0, 2, 1, 3), v.transpose(0, 2, 1, 3), 0, None)
 
     return attn.reshape(b, s, cfg.n_heads * hd) @ layer["wo"].astype(dt)
 
@@ -354,14 +372,16 @@ def forward(
 
 
 def init_cache(cfg: LlamaConfig, batch: int, max_len: Optional[int] = None) -> Params:
-    """Per-layer K/V buffer lists. Each layer's [B, max_len, KV, hd] buffer
-    is dynamic-update-sliced independently, which XLA turns into in-place
-    row writes — one stacked [L, ...] array (whether rebuilt with jnp.stack
-    or updated with a leading-dim DUS) either rewrites the whole cache per
-    decode step or compiles pathologically at 1B scale."""
+    """Per-layer K/V buffer lists, **head-major** [B, KV, max_len, hd]: each
+    kv-head's rows are contiguous, so the flash kernel DMA-streams
+    [l_blk, hd] tiles without striding over the head axis. Each layer's
+    buffer is dynamic-update-sliced independently, which XLA turns into
+    in-place row writes — one stacked [L, ...] array (whether rebuilt with
+    jnp.stack or updated with a leading-dim DUS) either rewrites the whole
+    cache per decode step or compiles pathologically at 1B scale."""
     ml = max_len or cfg.max_seq_len
     hd = cfg.head_dim
-    shape = (batch, ml, cfg.n_kv_heads, hd)
+    shape = (batch, cfg.n_kv_heads, ml, hd)
     return {
         "pos": jnp.zeros((), jnp.int32),
         "k": [jnp.zeros(shape, cfg.dtype) for _ in range(cfg.n_layers)],
@@ -376,6 +396,7 @@ def decode_step(
     cache: Params,
     kv_valid: Optional[jax.Array] = None,  # [B, max_len] — False masks pad slots
     pos_offset: Optional[jax.Array] = None,  # [B] — logical-position shift (left-pad)
+    last_only: bool = False,
 ) -> Tuple[jax.Array, Params]:
     """Incremental forward with KV cache; returns (logits [B, S, V], cache).
 
@@ -384,7 +405,15 @@ def decode_step(
     slot − offset_b (so they match the unpadded sequence), and attention
     never reads a pad slot. Both default to the unpadded single-stream
     behavior.
+
+    ``last_only=True`` computes final-norm + lm_head for the last position
+    only (logits [B, 1, V]) — sampling never reads the others, and at
+    serving shapes the full-prefill vocab projection
+    (2·B·S·d_model·vocab FLOPs) costs more than the entire rest of the
+    prefill.
     """
+    from kakveda_tpu.models.attention import gqa_cache_attention
+
     b, s = tokens.shape
     pos0 = cache["pos"]
     positions = jnp.broadcast_to(jnp.arange(s) + pos0, (b, s))
@@ -392,8 +421,6 @@ def decode_step(
         positions = positions - pos_offset[:, None]
     cos, sin = _rope_freqs(cfg, positions)
     hd = cfg.head_dim
-    n_rep = cfg.n_heads // cfg.n_kv_heads
-    max_len = cache["k"][0].shape[1]
 
     x = params["embed"].astype(cfg.dtype)[tokens]
     new_k: list = []
@@ -407,34 +434,27 @@ def decode_step(
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
 
+        # Head-major cache writes: [B, S, KV, D] -> [B, KV, S, D] slab.
         k_all = jax.lax.dynamic_update_slice(
-            cache["k"][li], k.astype(cfg.dtype), (0, pos0, 0, 0)
+            cache["k"][li], k.transpose(0, 2, 1, 3).astype(cfg.dtype), (0, 0, pos0, 0)
         )
         v_all = jax.lax.dynamic_update_slice(
-            cache["v"][li], v.astype(cfg.dtype), (0, pos0, 0, 0)
+            cache["v"][li], v.transpose(0, 2, 1, 3).astype(cfg.dtype), (0, 0, pos0, 0)
         )
         new_k.append(k_all)
         new_v.append(v_all)
 
-        kr = _repeat_kv(k_all, n_rep)
-        vr = _repeat_kv(v_all, n_rep)
-        scale = hd**-0.5
-        scores = jnp.einsum("bqhd,bkhd->bhqk", q, kr).astype(jnp.float32) * scale
-        q_pos = pos0 + jnp.arange(s)
-        k_pos = jnp.arange(max_len)
-        mask = q_pos[:, None] >= k_pos[None, :]  # causal + excludes unwritten slots
-        if kv_valid is not None:
-            full = mask[None, :, :] & kv_valid[:, None, :]  # [B, S, max_len]
-            scores = jnp.where(full[:, None, :, :], scores, _NEG_INF)
-        else:
-            scores = jnp.where(mask[None, None], scores, _NEG_INF)
-        probs = jax.nn.softmax(scores, axis=-1).astype(dt)
-        attn = jnp.einsum("bhqk,bkhd->bqhd", probs, vr)
+        # Fused cached attention: Pallas flash on TPU, grouped XLA einsum
+        # elsewhere — either way K/V are read once, not n_rep times, and
+        # the causal mask (q_pos >= slot) also excludes unwritten slots.
+        attn = gqa_cache_attention(q, k_all, v_all, pos0, kv_valid)
         x = x + attn.reshape(b, s, cfg.n_heads * hd) @ layer["wo"].astype(dt)
 
         h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
         x = x + _mlp_block(h, layer)
 
+    if last_only:
+        x = x[:, -1:, :]
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     logits = (x @ params["lm_head"].astype(cfg.dtype)).astype(jnp.float32)
     new_cache = {"pos": pos0 + s, "k": new_k, "v": new_v}

@@ -57,7 +57,14 @@ def load() -> Optional[ctypes.CDLL]:
     env = os.environ.get("KAKVEDA_NATIVE", "auto").lower()
     if env in ("0", "false", "off"):
         return None
-    if not _LIB_PATH.exists() and not _build():
+    # Rebuild when the source is newer than the .so (a stale library would
+    # be missing newly added symbols); a source-less artifact deployment
+    # (built .so, no src/) is simply never stale.
+    src = _DIR / "src" / "native.cc"
+    stale = not _LIB_PATH.exists() or (
+        src.exists() and src.stat().st_mtime > _LIB_PATH.stat().st_mtime
+    )
+    if stale and not _build() and not _LIB_PATH.exists():
         if env == "require":
             raise RuntimeError("KAKVEDA_NATIVE=require but the native library cannot be built")
         return None
@@ -69,6 +76,20 @@ def load() -> Optional[ctypes.CDLL]:
         log.debug("native load failed: %s", e)
         return None
 
+    try:
+        _bind(lib)
+    except AttributeError as e:
+        # A stale prebuilt .so (rebuild unavailable) lacking newly added
+        # symbols must degrade to the Python fallback, not crash load().
+        if env == "require":
+            raise
+        log.warning("native library is stale and cannot be rebuilt (%s); using Python fallback", e)
+        return None
+    _lib = lib
+    return _lib
+
+
+def _bind(lib: ctypes.CDLL) -> None:
     lib.kkv_crc32.argtypes = [ctypes.c_char_p, ctypes.c_int]
     lib.kkv_crc32.restype = ctypes.c_uint32
     lib.kkv_encode_batch.argtypes = [
@@ -79,6 +100,16 @@ def load() -> Optional[ctypes.CDLL]:
         ctypes.c_char_p,
     ]
     lib.kkv_encode_batch.restype = ctypes.c_int
+    lib.kkv_encode_sparse_batch.argtypes = [
+        ctypes.POINTER(ctypes.c_char_p),
+        ctypes.c_int,
+        ctypes.c_int,
+        ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_float),
+        ctypes.c_char_p,
+    ]
+    lib.kkv_encode_sparse_batch.restype = ctypes.c_int
     lib.kkv_log_open.argtypes = [ctypes.c_char_p, ctypes.c_long]
     lib.kkv_log_open.restype = ctypes.c_void_p
     lib.kkv_log_append.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_long]
@@ -87,8 +118,6 @@ def load() -> Optional[ctypes.CDLL]:
     lib.kkv_log_flush.restype = ctypes.c_int
     lib.kkv_log_close.argtypes = [ctypes.c_void_p]
     lib.kkv_log_close.restype = None
-    _lib = lib
-    return _lib
 
 
 def available() -> bool:

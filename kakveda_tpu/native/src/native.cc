@@ -229,6 +229,38 @@ int kkv_encode_batch(const char** texts, int n, int dim, float* out,
   return 0;
 }
 
+// Sparse encode: same features as kkv_encode_batch, emitted as (idx, val)
+// pairs for the device-side scatter-add (hashed rows are ~98% zeros, so the
+// dense [n, dim] form wastes host→device bandwidth). idx: [n, k] caller-
+// filled with `dim` (the scatter drop sentinel); val: [n, k] caller-zeroed.
+// Returns 0 on success, or the required k when some row has more than k
+// nonzeros (caller re-allocs and retries), or -1 on bad dim.
+int kkv_encode_sparse_batch(const char** texts, int n, int dim, int k,
+                            int32_t* idx, float* val, const char* spec_str) {
+  if (dim <= 0 || (dim & (dim - 1)) != 0) return -1;
+  std::vector<FieldSpec> specs = parse_spec(spec_str);
+  std::vector<float> row(static_cast<size_t>(dim));
+  int need = 0;
+  for (int i = 0; i < n; i++) {
+    std::memset(row.data(), 0, sizeof(float) * dim);
+    encode_one(texts[i], dim, row.data(), specs);
+    int m = 0;
+    int32_t* irow = idx + static_cast<size_t>(i) * k;
+    float* vrow = val + static_cast<size_t>(i) * k;
+    for (int j = 0; j < dim; j++) {
+      if (row[j] != 0.0f) {
+        if (m < k) {
+          irow[m] = j;
+          vrow[m] = row[j];
+        }
+        m++;
+      }
+    }
+    if (m > need) need = m;
+  }
+  return need > k ? need : 0;
+}
+
 // Append-only log: open(append mode) -> handle.
 void* kkv_log_open(const char* path, long flush_bytes) {
   int fd = open(path, O_WRONLY | O_APPEND | O_CREAT, 0644);

@@ -173,3 +173,68 @@ class HashedNGramFeaturizer:
 
     def encode_signatures(self, sigs: Iterable[str]) -> np.ndarray:
         return self.encode_batch(list(sigs))
+
+    def encode_batch_sparse(
+        self, texts: Sequence[str]
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Sparse form of :meth:`encode_batch`: ``(idx [B,K] int32, val
+        [B,K] f32)`` with rows padded to a power-of-two K (pad idx=dim → the
+        device scatter drops it).
+
+        A signature text touches ~30 of the ``dim`` buckets, so the dense
+        [B, dim] form is ~98% zeros — shipping it host→device made insert
+        transfer-bound (4 MB per 512-batch at dim=2048). The sparse pair is
+        ~60× smaller; the index rows are densified *on device* by a
+        scatter-add (ShardedKnn.insert_sparse). The C++ encoder emits the
+        pairs directly; the Python fallback densifies then np.nonzero's.
+        """
+        from kakveda_tpu import native
+
+        lib = native.load()
+        if lib is not None and all(isinstance(t, str) and t.isascii() for t in texts):
+            out = self._encode_sparse_native(lib, texts)
+            if out is not None:
+                return out
+        dense = self.encode_batch(texts)
+        b = dense.shape[0]
+        rows, cols = np.nonzero(dense)
+        counts = np.bincount(rows, minlength=b)
+        kmax = int(counts.max()) if b else 0
+        k = 8
+        while k < kmax:
+            k <<= 1
+        idx = np.full((b, k), self.dim, dtype=np.int32)  # dim == drop sentinel
+        val = np.zeros((b, k), dtype=np.float32)
+        # Positions within each row: nonzero() walks row-major, so the
+        # running offset of each (row, col) pair within its row is its rank.
+        offs = np.arange(len(rows)) - np.concatenate(([0], np.cumsum(counts)))[rows]
+        idx[rows, offs] = cols
+        val[rows, offs] = dense[rows, cols]
+        return idx, val
+
+    def _encode_sparse_native(
+        self, lib, texts: Sequence[str]
+    ) -> Tuple[np.ndarray, np.ndarray] | None:
+        import ctypes
+
+        b = len(texts)
+        arr = (ctypes.c_char_p * b)(*[t.encode("ascii") for t in texts])
+        k = 64
+        while True:
+            idx = np.full((b, k), self.dim, dtype=np.int32)
+            val = np.zeros((b, k), dtype=np.float32)
+            rc = lib.kkv_encode_sparse_batch(
+                arr,
+                b,
+                self.dim,
+                k,
+                idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                val.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                self._native_spec().encode("ascii"),
+            )
+            if rc == 0:
+                return idx, val
+            if rc < 0:
+                return None  # bad layout; fall back to Python
+            while k < rc:  # rc = required K; re-encode with room
+                k <<= 1

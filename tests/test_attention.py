@@ -1,0 +1,130 @@
+"""Fused-attention parity: the Pallas flash kernel (interpret mode on CPU)
+and the grouped XLA path must both reproduce the plain O(S²) oracle
+(`causal_attention`) bit-for-bit up to f32 tolerance, across GQA group
+sizes, cache offsets (decode), and left-pad validity masks."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kakveda_tpu.models.attention import _gqa_xla, flash_gqa_cache, gqa_cache_attention
+from kakveda_tpu.models.llama import _repeat_kv, causal_attention
+
+
+def _mk(b, s, h, kv, l, d, seed=0):
+    rng = np.random.default_rng(seed)
+    q = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, kv, l, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, kv, l, d)), jnp.float32)
+    return q, k, v
+
+
+def _oracle(q, k, v, pos0, kv_valid):
+    """causal_attention over the repeated, seq-major cache + explicit
+    validity masking (mirrors the pre-fusion decode_step math)."""
+    b, s, h, d = q.shape
+    kv = k.shape[1]
+    ks = k.transpose(0, 2, 1, 3)  # [B, L, KV, D]
+    vs = v.transpose(0, 2, 1, 3)
+    kr = _repeat_kv(ks, h // kv)
+    vr = _repeat_kv(vs, h // kv)
+    l = kr.shape[1]
+    scale = d**-0.5
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, kr).astype(jnp.float32) * scale
+    q_pos = pos0 + jnp.arange(s)
+    mask = q_pos[:, None] >= jnp.arange(l)[None, :]
+    if kv_valid is not None:
+        full = mask[None, :, :] & kv_valid[:, None, :]
+        scores = jnp.where(full[:, None], scores, -1e30)
+    else:
+        scores = jnp.where(mask[None, None], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, vr)
+
+
+CASES = [
+    # (B, S, H, KV, L, D, pos0, with_valid)   — prefill, decode, MQA, MHA
+    (2, 8, 4, 2, 32, 16, 0, False),
+    (2, 1, 4, 2, 32, 16, 7, False),     # single-token decode mid-cache
+    (1, 4, 8, 1, 16, 8, 3, False),      # MQA (kv=1)
+    (2, 8, 4, 4, 32, 16, 0, False),     # MHA (no grouping)
+    (2, 8, 4, 2, 32, 16, 0, True),      # left-pad validity mask
+    (3, 1, 8, 2, 64, 32, 20, True),     # batched decode with pads
+]
+
+
+@pytest.mark.parametrize("b,s,h,kv,l,d,pos0,with_valid", CASES)
+def test_grouped_xla_matches_oracle(b, s, h, kv, l, d, pos0, with_valid):
+    q, k, v = _mk(b, s, h, kv, l, d, seed=b + s)
+    valid = None
+    if with_valid:
+        rng = np.random.default_rng(99)
+        off = rng.integers(0, 4, size=(b,))
+        valid = jnp.asarray(np.arange(l)[None, :] >= off[:, None])
+    want = np.asarray(_oracle(q, k, v, pos0, valid))
+    got = np.asarray(_gqa_xla(q, k, v, jnp.asarray(pos0), valid))
+    np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)
+
+
+@pytest.mark.parametrize("b,s,h,kv,l,d,pos0,with_valid", CASES)
+def test_flash_kernel_matches_oracle(b, s, h, kv, l, d, pos0, with_valid):
+    q, k, v = _mk(b, s, h, kv, l, d, seed=b * 7 + s)
+    valid = None
+    if with_valid:
+        rng = np.random.default_rng(7)
+        off = rng.integers(0, 4, size=(b,))
+        valid = jnp.asarray(np.arange(l)[None, :] >= off[:, None])
+    want = np.asarray(_oracle(q, k, v, pos0, valid))
+    got = np.asarray(
+        flash_gqa_cache(
+            q, k, v, jnp.asarray(pos0), valid, q_blk=8, l_blk=16, interpret=True
+        )
+    )
+    # Fully-masked query rows (pad positions before any valid slot) are
+    # don't-care: softmax gives a uniform average, flash gives zeros.
+    if valid is not None:
+        q_pos = pos0 + np.arange(s)
+        visible = (q_pos[None, :, None] >= np.arange(l)[None, None, :]) & np.asarray(
+            valid
+        )[:, None, :]
+        live = visible.any(-1)  # [B, S]
+        got = got[live]
+        want = want[live]
+    np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)
+
+
+def test_flash_kernel_multiblock_streaming():
+    """Cache longer than one l-block: online-softmax accumulation across
+    tiles must agree with the oracle, including a fully-masked leading tile
+    (pos0 far into the cache) and an empty trailing tile."""
+    b, s, h, kv, l, d = 2, 4, 4, 2, 64, 16
+    q, k, v = _mk(b, s, h, kv, l, d, seed=5)
+    for pos0 in (0, 17, 59):
+        want = np.asarray(_oracle(q, k, v, pos0, None))
+        got = np.asarray(
+            flash_gqa_cache(q, k, v, jnp.asarray(pos0), None, q_blk=8, l_blk=16, interpret=True)
+        )
+        np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5, err_msg=f"pos0={pos0}")
+
+
+def test_dispatch_uses_xla_on_cpu():
+    """On a CPU backend the dispatcher must take the XLA path (flash is
+    TPU-only outside interpret mode) and still match the oracle."""
+    q, k, v = _mk(2, 4, 4, 2, 32, 16, seed=11)
+    got = np.asarray(gqa_cache_attention(q, k, v, jnp.asarray(2), None))
+    want = np.asarray(_oracle(q, k, v, 2, None))
+    np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)
+
+
+def test_flash_bf16_close_to_f32_oracle():
+    """bf16 inputs (the production dtype): flash kernel accumulates in f32,
+    so it should sit within bf16 rounding of the f32 oracle."""
+    b, s, h, kv, l, d = 2, 8, 8, 2, 32, 64
+    q, k, v = _mk(b, s, h, kv, l, d, seed=3)
+    qb, kb, vb = (x.astype(jnp.bfloat16) for x in (q, k, v))
+    want = np.asarray(_oracle(q, k, v, 0, None))
+    got = np.asarray(
+        flash_gqa_cache(qb, kb, vb, jnp.asarray(0), None, q_blk=16, l_blk=16, interpret=True)
+    ).astype(np.float32)
+    np.testing.assert_allclose(got, want, atol=0.04, rtol=0.04)

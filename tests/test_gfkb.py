@@ -219,15 +219,42 @@ def test_capacity_growth(tmp_path):
 
 
 def test_pattern_upsert_merges(tmp_path):
+    """Membership is set-union; order is first-seen (insertion), NOT sorted —
+    the delta-append pattern store never re-sorts the full id list on the
+    streaming path."""
     kb = _mk(tmp_path)
     p1, created = kb.upsert_pattern(name="N", failure_ids=["F-2", "F-1"], affected_apps=["b"])
     assert created and p1.pattern_id == "FP-0001"
-    assert p1.failure_ids == ["F-1", "F-2"]
-    p2, created2 = kb.upsert_pattern(name="N", failure_ids=["F-3"], affected_apps=["a"], description="d")
+    assert p1.failure_ids == ["F-2", "F-1"]
+    p2, created2 = kb.upsert_pattern(name="N", failure_ids=["F-3", "F-1"], affected_apps=["a"], description="d")
     assert not created2
-    assert p2.failure_ids == ["F-1", "F-2", "F-3"]
-    assert p2.affected_apps == ["a", "b"]
+    assert p2.failure_ids == ["F-2", "F-1", "F-3"]
+    assert p2.affected_apps == ["b", "a"]
     assert p2.description == "d"
+    # No-op upsert (nothing new): no growth, not created.
+    p3, created3 = kb.upsert_pattern(name="N", failure_ids=["F-1"], affected_apps=["a"], description="d")
+    assert not created3 and p3.failure_ids == p2.failure_ids
+
+
+def test_pattern_delta_log_replays(tmp_path):
+    """The patterns log is delta-append; a fresh GFKB over the same dir must
+    union the deltas back into the full membership."""
+    kb = _mk(tmp_path)
+    kb.upsert_pattern(name="N", failure_ids=["F-1"], affected_apps=["a"])
+    kb.upsert_pattern(name="N", failure_ids=["F-2"], affected_apps=["b"], description="d")
+    kb.upsert_pattern(name="M", failure_ids=["F-9"], affected_apps=["c"])
+    kb.close()
+
+    from kakveda_tpu.index.gfkb import GFKB
+
+    kb2 = GFKB(data_dir=kb.data_dir, capacity=64, dim=256)
+    by_name = {p.name: p for p in kb2.list_patterns()}
+    assert set(by_name) == {"N", "M"}
+    assert by_name["N"].failure_ids == ["F-1", "F-2"]
+    assert by_name["N"].affected_apps == ["a", "b"]
+    assert by_name["N"].description == "d"
+    assert by_name["N"].pattern_id == "FP-0001"
+    assert by_name["M"].failure_ids == ["F-9"]
 
 
 def test_concurrent_upserts_and_match(tmp_path):

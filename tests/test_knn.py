@@ -90,3 +90,45 @@ def test_capacity_rounds_to_shard_multiple():
     knn = ShardedKnn(mesh, capacity=100, dim=128, k=5)
     assert knn.capacity % mesh.shape["data"] == 0
     assert knn.capacity >= 100
+
+
+def test_insert_sparse_matches_dense():
+    """Sparse (idx,val) insert must produce the same index rows and type
+    table as the dense path, including ragged tail batches that get padded
+    to the batch bucket."""
+    import jax
+
+    from kakveda_tpu.ops.featurizer import HashedNGramFeaturizer
+    from kakveda_tpu.parallel.mesh import create_mesh
+
+    mesh = create_mesh("data:2")
+    feat = HashedNGramFeaturizer(dim=256)
+    texts = [
+        f"intent_tags:intent:citations_required | prompt_hint:summarize doc {i} | tools: | env_keys:os"
+        for i in range(5)  # odd count → bucket padding exercised
+    ]
+    dense = feat.encode_batch(texts)
+    idx, val = feat.encode_batch_sparse(texts)
+    assert idx.shape == val.shape and idx.shape[0] == 5
+
+    slots = np.arange(5, dtype=np.int32)
+    tids = np.asarray([0, 1, 0, 2, 1], np.int32)
+
+    kd = ShardedKnn(mesh, capacity=64, dim=256, k=3)
+    e1, v1 = kd.insert(*kd.alloc(), dense, slots)
+    t1 = kd.scatter_i32(kd.alloc_i32(), slots, tids)
+
+    ks = ShardedKnn(mesh, capacity=64, dim=256, k=3)
+    e2, v2 = ks.alloc()
+    e2, v2, t2 = ks.insert_sparse(e2, v2, ks.alloc_i32(), idx, val, slots, tids)
+
+    np.testing.assert_allclose(np.asarray(e1), np.asarray(e2), atol=1e-6)
+    np.testing.assert_array_equal(np.asarray(v1), np.asarray(v2))
+    np.testing.assert_array_equal(np.asarray(t1), np.asarray(t2))
+
+    # Matches flow identically through either index.
+    q = dense[:2]
+    s1, i1 = kd.topk(e1, v1, q)
+    s2, i2 = ks.topk(e2, v2, q)
+    np.testing.assert_allclose(s1, s2, atol=1e-5)
+    np.testing.assert_array_equal(i1, i2)
