@@ -152,32 +152,152 @@ def _cmd_reset(args: argparse.Namespace) -> int:
     return 0
 
 
+def _pid_path(root: Path) -> Path:
+    return root / "server.pid"
+
+
+def _log_path(root: Path) -> Path:
+    return root / "server.log"
+
+
+def _read_pid(root: Path) -> int | None:
+    p = _pid_path(root)
+    try:
+        return int(p.read_text().strip())
+    except (OSError, ValueError):
+        return None
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+
+
 def _cmd_status(args: argparse.Namespace) -> int:
-    data = Path(args.dir) / "data"
+    root = Path(args.dir)
+    data = root / "data"
     status = {"data_dir": str(data), "exists": data.exists()}
     for name in ("failures", "patterns", "health"):
         f = data / f"{name}.jsonl"
         status[name] = sum(1 for ln in f.read_text().splitlines() if ln.strip()) if f.exists() else 0
+    pid = _read_pid(root)
+    status["server"] = (
+        {"pid": pid, "running": _pid_alive(pid)} if pid else {"pid": None, "running": False}
+    )
     print(json.dumps(status, indent=2))
     return 0
 
 
 def _cmd_up(args: argparse.Namespace) -> int:
+    root = Path(args.dir)
+    pid = _read_pid(root)
+    # pid == os.getpid(): we ARE the detached child (the parent recorded
+    # our pid before exec'ing us) — not a conflict.
+    if pid and pid != os.getpid() and _pid_alive(pid):
+        print(f"server already running (pid {pid}); `kakveda-tpu down` first", file=sys.stderr)
+        return 1
+
+    if getattr(args, "detach", False):
+        # Background mode, the reference's `up` semantics
+        # (reference: kakveda_cli/cli.py:104-123 detaches via compose):
+        # re-exec the foreground verb with stdout/err into server.log and
+        # record the child pid for down/logs.
+        import subprocess
+
+        cmd = [
+            sys.executable, "-m", "kakveda_tpu.cli", "up",
+            "--dir", str(root), "--host", args.host, "--port", str(args.port),
+            "--dashboard-port", str(args.dashboard_port),
+        ]
+        logf = open(_log_path(root), "ab")
+        proc = subprocess.Popen(
+            cmd, stdout=logf, stderr=subprocess.STDOUT, start_new_session=True
+        )
+        _pid_path(root).write_text(str(proc.pid))
+        print(f"server starting (pid {proc.pid}); logs: {_log_path(root)}")
+        return 0
+
     try:
         from kakveda_tpu.service.main import run_server
     except ImportError:
         print("the HTTP service layer is not available in this build", file=sys.stderr)
         return 1
-    return run_server(host=args.host, port=args.port, data_dir=str(Path(args.dir) / "data"))
+    _pid_path(root).write_text(str(os.getpid()))
+    try:
+        return run_server(
+            host=args.host,
+            port=args.port,
+            data_dir=str(root / "data"),
+            dashboard_port=args.dashboard_port or None,
+        )
+    finally:
+        try:
+            if _read_pid(root) == os.getpid():
+                _pid_path(root).unlink()
+        except OSError:
+            pass
 
 
 def _cmd_down(args: argparse.Namespace) -> int:
-    print("kakveda-tpu runs in the foreground; stop it with Ctrl-C or your process manager")
-    return 0
+    """Stop the server recorded in server.pid (SIGTERM, bounded wait) —
+    real process management, matching the operational intent of the
+    reference's compose-backed `down` (reference: kakveda_cli/cli.py:124-136)."""
+    import signal
+    import time
+
+    root = Path(args.dir)
+    pid = _read_pid(root)
+    if pid is None:
+        print("no server.pid — nothing to stop")
+        return 0
+    if not _pid_alive(pid):
+        print(f"stale server.pid (pid {pid} not running); cleaning up")
+        _pid_path(root).unlink(missing_ok=True)
+        return 0
+    os.kill(pid, signal.SIGTERM)
+    deadline = time.time() + args.timeout
+    while time.time() < deadline:
+        if not _pid_alive(pid):
+            _pid_path(root).unlink(missing_ok=True)
+            print(f"stopped (pid {pid})")
+            return 0
+        time.sleep(0.2)
+    print(f"pid {pid} did not exit within {args.timeout}s (still running)", file=sys.stderr)
+    return 1
 
 
 def _cmd_logs(args: argparse.Namespace) -> int:
-    print("logs stream to stdout of the `up` process (KAKVEDA_LOG_FORMAT=json|text)")
+    """Tail server.log (written by `up --detach`), optionally following —
+    the reference's `logs` verb over a file instead of docker-compose
+    (reference: kakveda_cli/cli.py:167-181)."""
+    import time
+
+    root = Path(args.dir)
+    logp = _log_path(root)
+    if not logp.exists():
+        print(f"no log file at {logp} (start with `kakveda-tpu up --detach`)", file=sys.stderr)
+        return 1
+    lines = logp.read_text(encoding="utf-8", errors="replace").splitlines()
+    for ln in (lines[-args.tail :] if args.tail > 0 else []):
+        print(ln)
+    if not args.follow:
+        return 0
+    with logp.open("r", encoding="utf-8", errors="replace") as f:
+        f.seek(0, os.SEEK_END)
+        try:
+            while True:
+                ln = f.readline()
+                if ln:
+                    print(ln, end="")
+                else:
+                    time.sleep(0.5)
+        except KeyboardInterrupt:
+            pass
     return 0
 
 
@@ -196,9 +316,13 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--dir", default=".")
     sp.add_argument("--host", default="127.0.0.1")
     sp.add_argument("--port", type=int, default=8100)
+    sp.add_argument("--dashboard-port", type=int, default=8110, help="0 disables the dashboard")
+    sp.add_argument("-d", "--detach", action="store_true", help="run in the background (server.pid/server.log)")
     sp.set_defaults(fn=_cmd_up)
 
-    sp = sub.add_parser("down", help="how to stop the server")
+    sp = sub.add_parser("down", help="stop the server recorded in server.pid")
+    sp.add_argument("--dir", default=".")
+    sp.add_argument("--timeout", type=float, default=30.0)
     sp.set_defaults(fn=_cmd_down)
 
     sp = sub.add_parser("status", help="show data-store row counts")
@@ -210,7 +334,10 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--yes", action="store_true")
     sp.set_defaults(fn=_cmd_reset)
 
-    sp = sub.add_parser("logs", help="where logs go")
+    sp = sub.add_parser("logs", help="tail server.log")
+    sp.add_argument("--dir", default=".")
+    sp.add_argument("-n", "--tail", type=int, default=50)
+    sp.add_argument("-f", "--follow", action="store_true")
     sp.set_defaults(fn=_cmd_logs)
 
     sp = sub.add_parser("doctor", help="check the runtime environment")

@@ -87,30 +87,123 @@ def setup(app: web.Application) -> None:
         events = ctx.db.query("SELECT * FROM audit_events ORDER BY ts DESC LIMIT 200")
         return ctx.render(request, "admin_audit.html", events=events)
 
+    _DEMO_APPS = {"app-A", "app-B"}
+
+    def _demo_counts():
+        """Per-store (demo rows, total rows) for the purge preview.
+        Patterns count from the in-memory union — the delta-append log's
+        raw lines don't carry full membership."""
+        out = []
+        data_dir = plat.gfkb.data_dir
+        for name in ("failures.jsonl", "health.jsonl"):
+            p = data_dir / name
+            demo = total = 0
+            if p.exists():
+                for line in p.read_text(encoding="utf-8").splitlines():
+                    if not line.strip():
+                        continue
+                    total += 1
+                    try:
+                        row = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue
+                    apps = set(row.get("affected_apps") or [])
+                    if row.get("app_id") in _DEMO_APPS or (apps and apps <= _DEMO_APPS):
+                        demo += 1
+            out.append({"store": name, "demo": demo, "total": total})
+        pats = plat.gfkb.list_patterns()
+        demo_pats = sum(
+            1 for p in pats if p.affected_apps and set(p.affected_apps) <= _DEMO_APPS
+        )
+        out.append({"store": "patterns", "demo": demo_pats, "total": len(pats)})
+        for table in ("trace_runs", "warning_events", "scenario_runs"):
+            demo = sum(
+                ctx.db.one(f"SELECT COUNT(*) AS n FROM {table} WHERE app_id=?", (a,))["n"]
+                for a in _DEMO_APPS
+            )
+            total = ctx.db.one(f"SELECT COUNT(*) AS n FROM {table}")["n"]
+            out.append({"store": f"db:{table}", "demo": demo, "total": total})
+        return out
+
+    def _backups():
+        data_dir = plat.gfkb.data_dir
+        return sorted(
+            (
+                {"name": p.name, "size": p.stat().st_size}
+                for p in data_dir.glob("*.bak-*")
+            ),
+            key=lambda b: b["name"],
+            reverse=True,
+        )
+
+    @require_roles("admin")
+    async def admin_purge_demo_page(request):
+        """Preview + confirm flow before the destructive purge
+        (reference: services/dashboard/app.py:811-830 + its
+        admin_purge_demo.html): shows what will be removed and the existing
+        timestamped backups; the POST requires an explicit confirmation."""
+        return ctx.render(
+            request,
+            "admin_purge_demo.html",
+            apps=sorted(_DEMO_APPS),
+            counts=_demo_counts(),
+            backups=_backups(),
+            message=request.query.get("message") or "",
+            error=request.query.get("error") or "",
+        )
+
     @require_roles("admin")
     async def admin_purge_demo(request):
         """Backup then purge demo apps app-A/app-B from JSONL + DB
-        (reference: services/dashboard/app.py:811-867)."""
-        demo_apps = {"app-A", "app-B"}
+        (reference: services/dashboard/app.py:833-867)."""
+        form = await request.post()
+        if form.get("confirm") != "yes":
+            raise web.HTTPFound("/admin/purge-demo?error=confirmation%20required")
+        demo_apps = _DEMO_APPS
         stamp = datetime.now(timezone.utc).strftime("%Y%m%d-%H%M%S")
         data_dir = plat.gfkb.data_dir
         for name in ("failures.jsonl", "patterns.jsonl", "health.jsonl"):
             p = data_dir / name
             if p.exists():
                 shutil.copy2(p, p.with_suffix(f".jsonl.bak-{stamp}"))
-        # JSONL purge: rewrite without demo-app rows
-        fpath = plat.gfkb.failures_path
-        if fpath.exists():
+        # JSONL purge (reference: services/dashboard/app.py:330-375 purges
+        # all three stores). failures/health filter line-by-line; a corrupt
+        # line (crash mid-append) is skipped, not fatal — the preview
+        # already tolerates it and a purge must not 500 after backing up.
+        def _purge_jsonl(path):
+            if not path.exists():
+                return
             kept = []
-            for line in fpath.read_text(encoding="utf-8").splitlines():
+            for line in path.read_text(encoding="utf-8").splitlines():
                 if not line.strip():
                     continue
-                row = json.loads(line)
-                apps = set(row.get("affected_apps", []))
-                if apps and apps <= demo_apps:
+                try:
+                    row = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                apps = set(row.get("affected_apps") or [])
+                if row.get("app_id") in demo_apps or (apps and apps <= demo_apps):
                     continue
                 kept.append(line)
-            fpath.write_text("\n".join(kept) + ("\n" if kept else ""), encoding="utf-8")
+            path.write_text("\n".join(kept) + ("\n" if kept else ""), encoding="utf-8")
+
+        _purge_jsonl(plat.gfkb.failures_path)
+        _purge_jsonl(plat.health.health_path)
+        # The patterns log is DELTA-append (each line carries only that
+        # upsert's new members), so line filtering can't remove an app from
+        # a pattern. Rewrite it CONSOLIDATED from the in-memory union:
+        # full-membership lines minus demo apps; patterns spanning only
+        # demo apps disappear. (Replay unions full lines identically.)
+        kept_lines = []
+        for pat in plat.gfkb.list_patterns():
+            apps = [a for a in pat.affected_apps if a not in demo_apps]
+            if not apps:
+                continue
+            cleaned = pat.model_copy(update={"affected_apps": apps})
+            kept_lines.append(cleaned.model_dump_json())
+        plat.gfkb.patterns_path.write_text(
+            "\n".join(kept_lines) + ("\n" if kept_lines else ""), encoding="utf-8"
+        )
         for app_id in demo_apps:
             ctx.db.execute("DELETE FROM trace_runs WHERE app_id=?", (app_id,))
             ctx.db.execute("DELETE FROM warning_events WHERE app_id=?", (app_id,))
@@ -119,7 +212,10 @@ def setup(app: web.Application) -> None:
         # log — replay the rewritten files so queries and id minting agree.
         plat.gfkb.reload()
         ctx.db.audit(request["user"].email, "admin.purge_demo", {"apps": sorted(demo_apps)})
-        raise web.HTTPFound("/")
+        from urllib.parse import quote
+
+        msg = f"Purged demo apps {sorted(demo_apps)}; backups stamped {stamp}."
+        raise web.HTTPFound(f"/admin/purge-demo?message={quote(msg)}")
 
     # ------------------------------------------------------------------
     # agent registry
@@ -129,6 +225,43 @@ def setup(app: web.Application) -> None:
     async def agents_page(request):
         agents = ctx.db.query("SELECT * FROM agent_registry ORDER BY name")
         return ctx.render(request, "agents.html", agents=agents, test_result=None)
+
+    @require_roles("admin")
+    async def admin_agents_page(request):
+        """Dedicated agent-management page (reference:
+        services/dashboard/app.py:949-1087 + admin_agents.html): full
+        register/update form, enable toggle, health test, removal."""
+        agents = ctx.db.query("SELECT * FROM agent_registry ORDER BY name")
+        return ctx.render(request, "admin_agents.html", agents=agents, test_result=None)
+
+    @require_roles("admin")
+    async def admin_agent_delete(request):
+        form = await request.post()
+        name = str(form.get("name") or "")
+        ctx.db.execute("DELETE FROM agent_registry WHERE name=?", (name,))
+        ctx.db.audit(request["user"].email, "agent.delete", {"name": name})
+        raise web.HTTPFound("/admin/agents")
+
+    @require_roles("admin")
+    async def admin_agent_test(request):
+        """Health test rendered back into the admin page."""
+        name = request.match_info["name"]
+        agent = ctx.db.one("SELECT * FROM agent_registry WHERE name=?", (name,))
+        if agent is None:
+            raise web.HTTPNotFound(text="agent not found")
+        import httpx
+
+        from kakveda_tpu.dashboard.routes_main import off_loop
+
+        try:
+            r = await off_loop(httpx.get, f"{agent['base_url']}/health", timeout=5.0)
+            result = {"status": r.status_code, "body": r.json()}
+        except Exception as e:  # noqa: BLE001
+            result = {"status": 0, "body": {"error": f"{type(e).__name__}: {e}"}}
+        agents = ctx.db.query("SELECT * FROM agent_registry ORDER BY name")
+        return ctx.render(
+            request, "admin_agents.html", agents=agents, test_result={"name": name, **result}
+        )
 
     @require_roles("admin")
     async def agent_register(request):
@@ -150,14 +283,17 @@ def setup(app: web.Application) -> None:
             ),
         )
         ctx.db.audit(request["user"].email, "agent.register", {"name": name})
-        raise web.HTTPFound("/agents")
+        nxt = str(form.get("next") or "/agents")
+        # Reject protocol-relative //host targets, not just absolute URLs.
+        raise web.HTTPFound(nxt if nxt.startswith("/") and not nxt.startswith("//") else "/agents")
 
     @require_roles("admin")
     async def agent_toggle(request):
         form = await request.post()
         name = str(form.get("name") or "")
         ctx.db.execute("UPDATE agent_registry SET enabled = 1 - enabled WHERE name=?", (name,))
-        raise web.HTTPFound("/agents")
+        nxt = str(form.get("next") or "/agents")
+        raise web.HTTPFound(nxt if nxt.startswith("/") and not nxt.startswith("//") else "/agents")
 
     @require_login
     async def agent_test(request):
@@ -380,7 +516,11 @@ def setup(app: web.Application) -> None:
             web.post("/admin/users/toggle", admin_toggle_active),
             web.post("/admin/impersonate", admin_impersonate),
             web.get("/admin/audit", admin_audit),
+            web.get("/admin/purge-demo", admin_purge_demo_page),
             web.post("/admin/purge-demo", admin_purge_demo),
+            web.get("/admin/agents", admin_agents_page),
+            web.post("/admin/agents/delete", admin_agent_delete),
+            web.get("/admin/agents/{name}/test", admin_agent_test),
             web.get("/agents", agents_page),
             web.post("/agents/register", agent_register),
             web.post("/agents/toggle", agent_toggle),

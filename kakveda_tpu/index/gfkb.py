@@ -264,6 +264,17 @@ class GFKB:
         # serializes concurrent snapshot() calls (endpoint + shutdown).
         if not self.persist:
             raise SnapshotError("snapshot requires a persistent GFKB (persist=True)")
+        # Multi-host discipline: under multi-controller JAX, snapshot() is a
+        # COLLECTIVE — the slot gather over the globally-sharded buffer
+        # needs every process to run the same program, so every process
+        # must call snapshot(), and every process writes to ITS OWN
+        # data_dir. Symmetric writes are load-bearing, not redundancy: a
+        # host that restored from a snapshot runs different insert programs
+        # at startup than a host that full-replayed, which desynchronizes
+        # the SPMD lockstep (observed as gloo size-mismatch aborts). The
+        # deployment contract is per-host data dirs — a shared data_dir
+        # across processes is already invalid (every host would
+        # double-append the same log lines).
         with self._snapshot_write_lock:
             with self._lock:
                 self._drain_pending_embeds()

@@ -67,6 +67,37 @@ def _sample_top_p(rng, logits, temperature, top_p):
     return jax.random.categorical(rng, masked, axis=-1)
 
 
+
+def _bucket_len(need: int, cap: int) -> int:
+    """Power-of-two cache window ≥ need (capped): the window is part of the
+    compiled program signature, so exact-fit lengths would recompile for
+    every distinct prompt length."""
+    ml = 64
+    while ml < need:
+        ml <<= 1
+    return min(ml, cap)
+
+
+def _pack_prompts(prompts: list[list[int]], ml: int):
+    """Left-pad a ragged prompt batch into the shared convention used by
+    every batched decode path: (tokens [B, plen] i32, kv_valid [B, ml]
+    bool, pos_offset [B] i32, plen). Sequence i's real tokens occupy
+    columns [off_i, plen); its cache rows [off_i, …) are valid and its
+    RoPE positions are slot − off_i."""
+    import numpy as onp
+
+    plen = max(len(p) for p in prompts)
+    toks = onp.zeros((len(prompts), plen), onp.int32)
+    valid = onp.zeros((len(prompts), ml), bool)
+    offsets = onp.zeros((len(prompts),), onp.int32)
+    for i, p in enumerate(prompts):
+        off = plen - len(p)
+        toks[i, off:] = p
+        offsets[i] = off
+        valid[i, off:] = True  # real prompt slots + all future decode slots
+    return toks, valid, offsets, plen
+
+
 def generate_tokens(
     params: Params,
     cfg: LlamaConfig,
@@ -81,14 +112,7 @@ def generate_tokens(
 ) -> list[int]:
     """Autoregressive decode; returns only the newly generated ids."""
     if max_len is None:
-        # Bucket the cache length to a power of two: the cache shape is part
-        # of the compiled program signature, so an exact-fit length would
-        # recompile prefill+decode for every distinct prompt length.
-        need = len(prompt_ids) + max_new_tokens + 1
-        ml = 64
-        while ml < need:
-            ml <<= 1
-        ml = min(ml, cfg.max_seq_len)
+        ml = _bucket_len(len(prompt_ids) + max_new_tokens + 1, cfg.max_seq_len)
     else:
         ml = max_len
     cache = init_cache(cfg, batch=1, max_len=ml)
@@ -160,21 +184,8 @@ def generate_tokens_batch(
             f"longest prompt ({plen} tokens) leaves no room in the cache window "
             f"(max_seq_len={cfg.max_seq_len}); truncate prompts before calling"
         )
-    need = plen + max_new_tokens + 1
-    ml = 64
-    while ml < need:
-        ml <<= 1
-    ml = min(ml, cfg.max_seq_len)
-
-    toks = onp.zeros((bsz, plen), onp.int32)
-    valid = onp.zeros((bsz, ml), bool)
-    offsets = onp.zeros((bsz,), onp.int32)
-    for i, p in enumerate(prompts):
-        off = plen - len(p)
-        toks[i, off:] = p
-        offsets[i] = off
-        valid[i, off:] = True  # real prompt slots + all future decode slots
-
+    ml = _bucket_len(plen + max_new_tokens + 1, cfg.max_seq_len)
+    toks, valid, offsets, _ = _pack_prompts(prompts, ml)
     cache = init_cache(cfg, batch=bsz, max_len=ml)
     kv_valid = jnp.asarray(valid)
     pos_offset = jnp.asarray(offsets)
@@ -213,7 +224,57 @@ def generate_tokens_batch(
     return outs
 
 
-@partial(jax.jit, static_argnames=("cfg", "max_new_tokens", "greedy"))
+@partial(jax.jit, static_argnames=("cfg", "n_steps", "greedy"))
+def _decode_chunk_jit(
+    params,
+    cfg: LlamaConfig,
+    last,  # [B, V] logits of the previous position (vocab-masked)
+    cache,
+    kv_valid,
+    pos_offset,
+    rng,
+    temperature,
+    n_steps: int,
+    greedy: bool,
+):
+    """``n_steps`` sampled decode steps as one compiled scan, resumable:
+    returns (tokens [B, n_steps], last, cache, rng) so the caller can chain
+    chunks. Chunked dispatch is what lets pre-flight warn batches interleave
+    with generation on the same chip — a whole-generation program is a
+    multi-hundred-ms device-queue block (SURVEY §7 'interleaving generate
+    steps with match batches')."""
+
+    def body(carry, _):
+        last, cache, rng = carry
+        if greedy:
+            nxt = jnp.argmax(last, axis=-1)
+        else:
+            rng, sub = jax.random.split(rng)
+            nxt = jax.random.categorical(sub, last / temperature, axis=-1)
+        logits, cache = decode_step(
+            params, cfg, nxt[:, None].astype(jnp.int32), cache,
+            kv_valid=kv_valid, pos_offset=pos_offset,
+        )
+        nl = logits[:, -1, :]
+        if cfg.effective_vocab is not None:
+            nl = nl.at[:, cfg.effective_vocab :].set(-jnp.inf)
+        return (nl, cache, rng), nxt
+
+    (last, cache, rng), toks = jax.lax.scan(body, (last, cache, rng), None, length=n_steps)
+    return toks.T, last, cache, rng  # toks: [B, n_steps]
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def _prefill_jit(params, cfg: LlamaConfig, prompt, cache, kv_valid, pos_offset):
+    logits, cache = decode_step(
+        params, cfg, prompt, cache, kv_valid=kv_valid, pos_offset=pos_offset, last_only=True
+    )
+    last = logits[:, -1, :]
+    if cfg.effective_vocab is not None:
+        last = last.at[:, cfg.effective_vocab :].set(-jnp.inf)
+    return last, cache
+
+
 def _generate_fused_jit(
     params,
     cfg: LlamaConfig,
@@ -226,30 +287,15 @@ def _generate_fused_jit(
     max_new_tokens: int,
     greedy: bool,
 ):
-    logits, cache = decode_step(
-        params, cfg, prompt, cache, kv_valid=kv_valid, pos_offset=pos_offset, last_only=True
+    """Whole generation in two dispatches (prefill + one decode scan).
+    Kept as the throughput path; the chunked path (DecodeSession) trades a
+    few dispatches for device-queue preemption points."""
+    last, cache = _prefill_jit(params, cfg, prompt, cache, kv_valid, pos_offset)
+    toks, _, _, _ = _decode_chunk_jit(
+        params, cfg, last, cache, kv_valid, pos_offset, rng, temperature,
+        max_new_tokens, greedy,
     )
-    last = logits[:, -1, :]
-    if cfg.effective_vocab is not None:
-        last = last.at[:, cfg.effective_vocab :].set(-jnp.inf)
-
-    def body(carry, _):
-        last, cache, rng = carry
-        if greedy:
-            nxt = jnp.argmax(last, axis=-1)
-        else:
-            rng, sub = jax.random.split(rng)
-            nxt = jax.random.categorical(sub, last / temperature, axis=-1)
-        logits, cache = decode_step(
-            params, cfg, nxt[:, None].astype(jnp.int32), cache, kv_valid=kv_valid, pos_offset=pos_offset
-        )
-        nl = logits[:, -1, :]
-        if cfg.effective_vocab is not None:
-            nl = nl.at[:, cfg.effective_vocab :].set(-jnp.inf)
-        return (nl, cache, rng), nxt
-
-    (_, _, _), toks = jax.lax.scan(body, (last, cache, rng), None, length=max_new_tokens)
-    return toks.T  # [B, max_new_tokens]
+    return toks
 
 
 def generate_tokens_fused(
@@ -286,21 +332,9 @@ def generate_tokens_fused(
             f"longest prompt ({plen} tokens) leaves no room in the cache window "
             f"(max_seq_len={cfg.max_seq_len}); truncate prompts before calling"
         )
-    ml = 64
-    while ml < plen + max_new_tokens + 1:
-        ml <<= 1
-    ml = min(ml, cfg.max_seq_len)
+    ml = _bucket_len(plen + max_new_tokens + 1, cfg.max_seq_len)
     steps = min(max_new_tokens, ml - plen - 1)
-
-    toks = onp.zeros((bsz, plen), onp.int32)
-    valid = onp.zeros((bsz, ml), bool)
-    offsets = onp.zeros((bsz,), onp.int32)
-    for i, p in enumerate(prompts):
-        off = plen - len(p)
-        toks[i, off:] = p
-        offsets[i] = off
-        valid[i, off:] = True
-
+    toks, valid, offsets, _ = _pack_prompts(prompts, ml)
     cache = init_cache(cfg, batch=bsz, max_len=ml)
     out = _generate_fused_jit(
         params,
@@ -324,6 +358,76 @@ def generate_tokens_fused(
     return outs
 
 
+class DecodeSession:
+    """Resumable chunked generation over one left-padded prompt batch.
+
+    ``step_chunk()`` dispatches the next ``chunk_steps`` decode steps as one
+    compiled program and fetches the sampled tokens. Bounding the per-
+    dispatch slice is the serving-side scheduling mechanism for sharing the
+    chip: the device queue gets a preemption point every chunk, so a
+    pre-flight warn batch waits at most ~chunk_steps·(per-step time) instead
+    of a whole generation (SURVEY §7 'interleaving generate steps with match
+    batches'). Token parity with :func:`generate_tokens_fused` is exact for
+    greedy decoding and RNG-exact for sampling (the rng threads through
+    chunks in the same split order).
+    """
+
+    def __init__(
+        self,
+        params: Params,
+        cfg: LlamaConfig,
+        prompts: list[list[int]],
+        *,
+        chunk_steps: int = 8,
+        max_len: Optional[int] = None,
+        temperature: float = 0.0,
+        rng: Optional[jax.Array] = None,
+    ):
+        import numpy as onp
+
+        if not prompts:
+            raise ValueError("empty prompt batch")
+        self.params, self.cfg = params, cfg
+        self.chunk_steps = chunk_steps
+        self.greedy = temperature <= 0.0
+        self.temperature = jnp.asarray(max(temperature, 1e-6), jnp.float32)
+        plen = max(len(p) for p in prompts)
+        ml = max_len or cfg.max_seq_len
+        if plen + 1 > ml:
+            raise ValueError(f"longest prompt ({plen}) leaves no room (max_len={ml})")
+        bsz = len(prompts)
+        toks, valid, offsets, _ = _pack_prompts(prompts, ml)
+        self.kv_valid = jnp.asarray(valid)
+        self.pos_offset = jnp.asarray(offsets)
+        self.rng = rng if rng is not None else jax.random.PRNGKey(0)
+        cache = init_cache(cfg, batch=bsz, max_len=ml)
+        self._last, self._cache = _prefill_jit(
+            params, cfg, jnp.asarray(toks), cache, self.kv_valid, self.pos_offset
+        )
+        self._pos = plen
+        self._max_len = ml
+
+    @property
+    def steps_left(self) -> int:
+        return max(0, self._max_len - 1 - self._pos)
+
+    def step_chunk(self, n: Optional[int] = None):
+        """Run the next min(n, steps_left) decode steps; returns the sampled
+        token matrix [B, steps] as a numpy array (None when the cache
+        window is exhausted)."""
+        import numpy as onp
+
+        steps = min(n or self.chunk_steps, self.steps_left)
+        if steps <= 0:
+            return None
+        toks, self._last, self._cache, self.rng = _decode_chunk_jit(
+            self.params, self.cfg, self._last, self._cache, self.kv_valid,
+            self.pos_offset, self.rng, self.temperature, steps, self.greedy,
+        )
+        self._pos += steps
+        return onp.asarray(toks)
+
+
 class LlamaRuntime:
     """`runtime=tpu`: on-device Llama generation with the shared meta shape."""
 
@@ -336,43 +440,72 @@ class LlamaRuntime:
         seed: int = 0,
         tokenizer=None,
         model_label: Optional[str] = None,
+        quant: Optional[str] = None,
     ):
         self.cfg = cfg or LlamaConfig.tiny()
         self.tokenizer = tokenizer if tokenizer is not None else ByteTokenizer()
         if self.cfg.vocab_size < self.tokenizer.vocab_size:
             raise ValueError("model vocab smaller than tokenizer vocab")
         self.params = params if params is not None else init_params(jax.random.PRNGKey(seed), self.cfg)
+        if quant == "int8":
+            # Weight-only int8 serving: halves the HBM weight stream that
+            # bounds decode throughput (models/quant.py).
+            from kakveda_tpu.models.quant import quantize_params_int8
+
+            self.params = quantize_params_int8(self.params)
+        elif quant not in (None, "none"):
+            raise ValueError(f"unknown quant mode {quant!r} (int8|none)")
+        self.quant = quant
         self.model_label = model_label or f"llama-{self.cfg.n_layers}L-{self.cfg.d_model}d"
 
     @classmethod
     def from_env(cls) -> "LlamaRuntime":
+        quant = os.environ.get("KAKVEDA_QUANT") or None
+        if quant not in (None, "none", "int8"):
+            raise ValueError(f"unknown KAKVEDA_QUANT={quant!r} (int8|none)")
         hf_ckpt = os.environ.get("KAKVEDA_HF_CKPT")
         if hf_ckpt:
-            return cls.from_hf(hf_ckpt)
+            return cls.from_hf(hf_ckpt, quant=quant)
         preset = os.environ.get("KAKVEDA_LLAMA_PRESET", "tiny").lower()
         cfg = LlamaConfig.llama3_8b() if preset in ("8b", "llama3-8b") else LlamaConfig.tiny()
         rt = cls(cfg=cfg)
         ckpt = os.environ.get("KAKVEDA_LLAMA_CKPT")
         if ckpt:
             rt.load_checkpoint(ckpt)
+        if quant == "int8":
+            from kakveda_tpu.models.quant import quantize_params_int8
+
+            rt.params = quantize_params_int8(rt.params)
+            rt.quant = quant
         return rt
 
     @classmethod
-    def from_hf(cls, path: str, *, mesh=None) -> "LlamaRuntime":
+    def from_hf(cls, path: str, *, mesh=None, quant: Optional[str] = None) -> "LlamaRuntime":
         """Real-weight serving: convert a local HF Llama checkpoint directory
         (weights + tokenizer files) and serve it on the TPU runtime. With a
-        ``mesh``, params are placed per the Megatron TP layout. Replaces the
-        reference's Ollama daemon hop
+        ``mesh``, params are placed per the Megatron TP layout; ``quant``
+        ("int8") applies weight-only quantization before placement.
+        Replaces the reference's Ollama daemon hop
         (reference: services/dashboard/app.py:1182-1258)."""
         from kakveda_tpu.models.hf_convert import load_hf_checkpoint, shard_params
         from kakveda_tpu.models.tokenizer import HFTokenizer
 
         params, cfg = load_hf_checkpoint(path)
+        if quant not in (None, "none", "int8"):
+            raise ValueError(f"unknown quant mode {quant!r} (int8|none)")
+        rt_quant = None
+        if quant == "int8":
+            from kakveda_tpu.models.quant import quantize_params_int8
+
+            params = quantize_params_int8(params)
+            rt_quant = quant
         if mesh is not None:
-            params = shard_params(params, cfg, mesh)
+            params = shard_params(params, cfg, mesh)  # handles int8 leaves
         tok = HFTokenizer(path)
         label = os.path.basename(os.path.normpath(path))
-        return cls(cfg=cfg, params=params, tokenizer=tok, model_label=label)
+        rt = cls(cfg=cfg, params=params, tokenizer=tok, model_label=label)
+        rt.quant = rt_quant
+        return rt
 
     def load_checkpoint(self, path: str) -> None:
         import orbax.checkpoint as ocp
@@ -382,6 +515,36 @@ class LlamaRuntime:
 
     def list_models(self) -> list:
         return [self.model_label]
+
+    def _generate_ids_chunked(self, ids: list[list[int]], max_tokens: int) -> list[list[int]]:
+        """Greedy decode via chunked dispatch (DecodeSession): ~chunk_steps
+        tokens per device program instead of one (the per-token host loop
+        pays a full dispatch RTT per token on remote-attached chips), with
+        EOS early-exit checked between chunks and the device queue left
+        preemptible for concurrent pre-flight matches."""
+        import numpy as onp
+
+        plen = max(len(p) for p in ids)
+        ml = _bucket_len(plen + max_tokens + 1, self.cfg.max_seq_len)
+        sess = DecodeSession(self.params, self.cfg, ids, chunk_steps=16, max_len=ml)
+        eos = self.tokenizer.EOS
+        outs: list[list[int]] = [[] for _ in ids]
+        done = [False] * len(ids)
+        budget = min(max_tokens, sess.steps_left)
+        while budget > 0 and not all(done):
+            chunk = sess.step_chunk(min(16, budget))
+            if chunk is None:
+                break
+            budget -= chunk.shape[1]
+            for i, row in enumerate(onp.asarray(chunk)):
+                for t in row.tolist():
+                    if done[i]:
+                        break
+                    if t == eos:
+                        done[i] = True
+                    elif len(outs[i]) < max_tokens:
+                        outs[i].append(t)
+        return outs
 
     def generate_batch(
         self, prompts: list, *, model: Optional[str] = None, max_tokens: int = 64
@@ -393,9 +556,7 @@ class LlamaRuntime:
         from kakveda_tpu.core import profiling
 
         with profiling.annotate("llama.generate_batch"):
-            new_ids = generate_tokens_batch(
-                self.params, self.cfg, ids, max_new_tokens=max_tokens, eos_id=self.tokenizer.EOS
-            )
+            new_ids = self._generate_ids_chunked(ids, max_tokens)
         latency_ms = int((time.perf_counter() - started) * 1000)
         label = model or self.model_label
         return [
@@ -418,13 +579,7 @@ class LlamaRuntime:
         from kakveda_tpu.core import profiling
 
         with profiling.annotate("llama.generate"):
-            new_ids = generate_tokens(
-                self.params,
-                self.cfg,
-                ids,
-                max_new_tokens=max_tokens,
-                eos_id=self.tokenizer.EOS,
-            )
+            new_ids = self._generate_ids_chunked([ids], max_tokens)[0]
         text = self.tokenizer.decode(new_ids)
         return GenerateResult(
             text=text,

@@ -247,13 +247,13 @@ def load_hf_checkpoint(
 
 def shard_params(params: Params, cfg: LlamaConfig, mesh) -> Params:
     """Place a host param tree onto ``mesh`` per the Megatron TP layout
-    (llama.param_specs)."""
+    (llama.param_specs_like — also places int8 weight-only trees)."""
     from jax.sharding import NamedSharding
 
-    from kakveda_tpu.models.llama import param_specs
+    from kakveda_tpu.models.llama import param_specs_like
     from kakveda_tpu.parallel.distributed import put_global
 
-    specs = param_specs(cfg)
+    specs = param_specs_like(params, cfg)
     return jax.tree.map(
         lambda x, s: put_global(x, NamedSharding(mesh, s)),
         params,

@@ -143,10 +143,39 @@ def param_specs(cfg: LlamaConfig) -> Params:
     }
 
 
+def _is_quant_leaf(x) -> bool:
+    return isinstance(x, dict) and set(x.keys()) == {"q", "s"}
+
+
+def param_specs_like(params: Params, cfg: LlamaConfig) -> Params:
+    """Spec tree matching ``params``' structure — handles int8 weight-only
+    leaves (models/quant.py): the int8 matrix shards like the original
+    weight and the per-output-channel scale follows the OUT axis's placement
+    (sharded for column-parallel projections, replicated for row-parallel)."""
+    base = param_specs(cfg)
+
+    def expand(w, spec):
+        if _is_quant_leaf(w):
+            return {"q": spec, "s": P(spec[1] if len(spec) > 1 else None)}
+        return spec
+
+    return jax.tree.map(expand, params, base, is_leaf=_is_quant_leaf)
+
+
 # ---------------------------------------------------------------------------
 # building blocks
 # ---------------------------------------------------------------------------
 
+
+
+def wmat(w, dt) -> jax.Array:
+    """Materialize a dense weight at compute dtype. Accepts a raw array or
+    an int8 weight-only pair ``{"q", "s"}`` (models/quant.py) — the dequant
+    multiply fuses into the consuming matmul, so quantized weights stream
+    from HBM at int8 width."""
+    if isinstance(w, dict):
+        return w["q"].astype(dt) * w["s"].astype(dt)[None, :]
+    return w.astype(dt)
 
 def rms_norm(x: jax.Array, w: jax.Array, eps: float) -> jax.Array:
     x32 = x.astype(jnp.float32)
@@ -292,9 +321,9 @@ def _attention_block(
     hd = cfg.head_dim
     dt = x.dtype
 
-    q = (x @ layer["wq"].astype(dt)).reshape(b, s, cfg.n_heads, hd)
-    k = (x @ layer["wk"].astype(dt)).reshape(b, s, cfg.n_kv_heads, hd)
-    v = (x @ layer["wv"].astype(dt)).reshape(b, s, cfg.n_kv_heads, hd)
+    q = (x @ wmat(layer["wq"], dt)).reshape(b, s, cfg.n_heads, hd)
+    k = (x @ wmat(layer["wk"], dt)).reshape(b, s, cfg.n_kv_heads, hd)
+    v = (x @ wmat(layer["wv"], dt)).reshape(b, s, cfg.n_kv_heads, hd)
 
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
@@ -323,14 +352,14 @@ def _attention_block(
 
         attn = _gqa_xla(q, k.transpose(0, 2, 1, 3), v.transpose(0, 2, 1, 3), 0, None)
 
-    return attn.reshape(b, s, cfg.n_heads * hd) @ layer["wo"].astype(dt)
+    return attn.reshape(b, s, cfg.n_heads * hd) @ wmat(layer["wo"], dt)
 
 
 def _mlp_block(x: jax.Array, layer: Params) -> jax.Array:
     dt = x.dtype
-    gate = jax.nn.silu(x @ layer["w_gate"].astype(dt))
-    up = x @ layer["w_up"].astype(dt)
-    return (gate * up) @ layer["w_down"].astype(dt)
+    gate = jax.nn.silu(x @ wmat(layer["w_gate"], dt))
+    up = x @ wmat(layer["w_up"], dt)
+    return (gate * up) @ wmat(layer["w_down"], dt)
 
 
 def forward(
@@ -363,7 +392,7 @@ def forward(
         x = x + _mlp_block(h, layer)
 
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    return (x @ params["lm_head"].astype(cfg.dtype)).astype(jnp.float32)
+    return (x @ wmat(params["lm_head"], cfg.dtype)).astype(jnp.float32)
 
 
 # ---------------------------------------------------------------------------
@@ -428,9 +457,9 @@ def decode_step(
     for li, layer in enumerate(params["layers"]):
         h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
         dt = h.dtype
-        q = (h @ layer["wq"].astype(dt)).reshape(b, s, cfg.n_heads, hd)
-        k = (h @ layer["wk"].astype(dt)).reshape(b, s, cfg.n_kv_heads, hd)
-        v = (h @ layer["wv"].astype(dt)).reshape(b, s, cfg.n_kv_heads, hd)
+        q = (h @ wmat(layer["wq"], dt)).reshape(b, s, cfg.n_heads, hd)
+        k = (h @ wmat(layer["wk"], dt)).reshape(b, s, cfg.n_kv_heads, hd)
+        v = (h @ wmat(layer["wv"], dt)).reshape(b, s, cfg.n_kv_heads, hd)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
 
@@ -448,7 +477,7 @@ def decode_step(
         # elsewhere — either way K/V are read once, not n_rep times, and
         # the causal mask (q_pos >= slot) also excludes unwritten slots.
         attn = gqa_cache_attention(q, k_all, v_all, pos0, kv_valid)
-        x = x + attn.reshape(b, s, cfg.n_heads * hd) @ layer["wo"].astype(dt)
+        x = x + attn.reshape(b, s, cfg.n_heads * hd) @ wmat(layer["wo"], dt)
 
         h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
         x = x + _mlp_block(h, layer)
@@ -456,6 +485,6 @@ def decode_step(
     if last_only:
         x = x[:, -1:, :]
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    logits = (x @ params["lm_head"].astype(cfg.dtype)).astype(jnp.float32)
+    logits = (x @ wmat(params["lm_head"], cfg.dtype)).astype(jnp.float32)
     new_cache = {"pos": pos0 + s, "k": new_k, "v": new_v}
     return logits, new_cache

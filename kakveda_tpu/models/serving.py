@@ -1,0 +1,296 @@
+"""Continuous batching for the serving path (design note + prototype).
+
+The playground, eval runner and LLM-judge tier all call generate. Static
+batching (`generate_tokens_batch`/`_fused`) decodes a fixed cohort to the
+longest member: every finished (EOS) sequence leaves its batch slot idle
+until the whole cohort drains, and new requests wait for the next cohort.
+Under mixed-length traffic that wastes both slots and latency.
+
+**Design.** A `ContinuousBatcher` owns a fixed [B, KV, max_len, D] KV-cache
+(static shapes — nothing ever retraces) and treats the batch axis as B
+independent *slots*:
+
+  * **admit**: a new prompt prefills into one free slot — a [1, P] prefill
+    whose cache rows are scattered into the batch cache at that slot
+    (`_admit_jit`). Other slots are untouched; admission interleaves with
+    decoding chunks.
+  * **step_chunk**: ONE bounded decode program advances every active slot
+    by up to `chunk_steps` tokens (same chunked-dispatch scheduling that
+    lets pre-flight warn batches share the chip — models/generate.py
+    `DecodeSession`). Inactive slots decode garbage into their own slot
+    positions that admission later overwrites — masked out by per-slot
+    `kv_valid`, never visible to active slots.
+  * **retire**: EOS/length-exhausted slots free on the host between
+    chunks; their results return to callers and the slot re-enters the
+    free list.
+
+Throughput model: with static batching a cohort of B requests whose decode
+lengths are L_i costs max(L_i) steps of B-wide compute; continuous
+batching costs ~mean(L_i) per request at steady state — the delta grows
+with length variance (bench: `KAKVEDA_BENCH_METRIC=continuous python
+bench.py`, reported in docs/performance.md).
+
+Capability replaced: the reference serves generations through sequential
+per-request Ollama HTTP calls (services/dashboard/app.py:1182-1258) — no
+batching at all; eval loops run one example at a time
+(app.py:2315-2393).
+
+Use the class directly (``ContinuousBatcher(params, cfg, ...)``); it
+accepts the same param trees as every other forward path, including int8
+weight-only quantized ones (llama.wmat). Prototype status: greedy
+decoding; per-request temperature would thread a [B] vector through the
+chunk body.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kakveda_tpu.models.llama import LlamaConfig, Params, decode_step, init_cache
+
+
+@partial(jax.jit, static_argnames=("cfg",), donate_argnums=(2,))
+def _admit_jit(params, cfg: LlamaConfig, cache, last, prompt, slot, kv_valid, pos_offset):
+    """Prefill ``prompt`` [1, P] into batch slot ``slot`` of ``cache``.
+
+    The single-sequence prefill runs with its own [1, ...] scratch cache
+    (so its attention sees only this prompt), then its K/V rows scatter
+    into the batch cache at ``slot``. `last` [B, V] gets the slot's
+    next-token logits.
+    """
+    b = last.shape[0]
+    p = prompt.shape[1]
+    scratch = init_cache(cfg, batch=1, max_len=cache["k"][0].shape[2])
+    logits, scratch = decode_step(
+        params, cfg, prompt, scratch,
+        kv_valid=kv_valid[slot][None],
+        pos_offset=pos_offset[slot][None],
+        last_only=True,
+    )
+    new_k = [
+        jax.lax.dynamic_update_slice(ck, sk, (slot, 0, 0, 0))
+        for ck, sk in zip(cache["k"], scratch["k"])
+    ]
+    new_v = [
+        jax.lax.dynamic_update_slice(cv, sv, (slot, 0, 0, 0))
+        for cv, sv in zip(cache["v"], scratch["v"])
+    ]
+    nl = logits[:, -1, :]
+    if cfg.effective_vocab is not None:
+        nl = nl.at[:, cfg.effective_vocab :].set(-jnp.inf)
+    last = jax.lax.dynamic_update_slice(last, nl, (slot, 0))
+    # cache["pos"] is managed per-slot on host (slot positions differ);
+    # the batch cache carries pos=0 and step passes explicit positions.
+    return {"pos": cache["pos"], "k": new_k, "v": new_v}, last
+
+
+@partial(jax.jit, static_argnames=("cfg", "n_steps"), donate_argnums=(2,))
+def _step_chunk_jit(params, cfg: LlamaConfig, cache, last, slot_pos, kv_valid, pos_offset, n_steps: int):
+    """Advance every slot by ``n_steps`` greedy tokens in one program.
+
+    ``slot_pos`` [B] — per-slot NEXT cache index (prompt length + tokens
+    decoded so far). decode_step's scalar `pos` can't express per-slot
+    positions, so the chunk body re-implements the cached step with a
+    per-slot write index: token t of slot b lands at cache[b, :, slot_pos[b]+t].
+    """
+    from kakveda_tpu.models.attention import gqa_cache_attention
+    from kakveda_tpu.models.llama import _mlp_block, _rope_freqs, apply_rope, rms_norm, wmat
+
+    b = last.shape[0]
+    hd = cfg.head_dim
+    max_len = cache["k"][0].shape[2]
+
+    def one_step(carry, _):
+        cache_k, cache_v, last, slot_pos = carry
+        nxt = jnp.argmax(last, axis=-1)  # [B]
+        tokens = nxt[:, None].astype(jnp.int32)
+        positions = (slot_pos - pos_offset)[:, None]  # logical positions
+        cos, sin = _rope_freqs(cfg, positions)
+        x = params["embed"].astype(cfg.dtype)[tokens]
+        new_k, new_v = [], []
+        # Validity for reads this step: slots < own write index, plus self.
+        col = jnp.arange(max_len)[None, :]
+        step_valid = kv_valid & (col <= slot_pos[:, None])
+        for li in range(cfg.n_layers):
+            layer = params["layers"][li]
+            h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+            dt = h.dtype
+            q = (h @ wmat(layer["wq"], dt)).reshape(b, 1, cfg.n_heads, hd)
+            k = (h @ wmat(layer["wk"], dt)).reshape(b, 1, cfg.n_kv_heads, hd)
+            v = (h @ wmat(layer["wv"], dt)).reshape(b, 1, cfg.n_kv_heads, hd)
+            q = apply_rope(q, cos, sin)
+            k = apply_rope(k, cos, sin)
+            # Per-slot scatter: k[b] -> cache_k[li][b, :, slot_pos[b]] —
+            # a real scatter (in-place row writes), not a whole-cache
+            # rewrite via one-hot blending.
+            kh = k.transpose(0, 2, 1, 3).astype(cfg.dtype)[:, :, 0, :]  # [B, KV, D]
+            vh = v.transpose(0, 2, 1, 3).astype(cfg.dtype)[:, :, 0, :]
+            rows = jnp.arange(b)
+            k_all = cache_k[li].at[rows, :, slot_pos, :].set(kh, mode="drop")
+            v_all = cache_v[li].at[rows, :, slot_pos, :].set(vh, mode="drop")
+            new_k.append(k_all)
+            new_v.append(v_all)
+            # Attention over the slot's valid prefix. pos0=max_len makes the
+            # kernel's scalar causal mask a no-op; step_valid does the work.
+            attn = gqa_cache_attention(q, k_all, v_all, jnp.asarray(max_len), step_valid)
+            x = x + attn.reshape(b, 1, cfg.n_heads * hd) @ wmat(layer["wo"], dt)
+            h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+            x = x + _mlp_block(h, layer)
+        x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+        logits = (x @ wmat(params["lm_head"], cfg.dtype)).astype(jnp.float32)[:, -1, :]
+        if cfg.effective_vocab is not None:
+            logits = logits.at[:, cfg.effective_vocab :].set(-jnp.inf)
+        return (new_k, new_v, logits, slot_pos + 1), nxt
+
+    (ck, cv, last, slot_pos), toks = jax.lax.scan(
+        one_step, (cache["k"], cache["v"], last, slot_pos), None, length=n_steps
+    )
+    return {"pos": cache["pos"], "k": ck, "v": cv}, last, slot_pos, toks.T  # [B, n_steps]
+
+
+@dataclass
+class _Slot:
+    req_id: int
+    prompt_len: int
+    max_new: int
+    out: List[int] = field(default_factory=list)
+    done: bool = False
+
+
+class ContinuousBatcher:
+    """Admit-as-you-go generation over a fixed slot pool (greedy)."""
+
+    def __init__(
+        self,
+        params: Params,
+        cfg: LlamaConfig,
+        *,
+        batch_slots: int = 8,
+        max_len: int = 512,
+        chunk_steps: int = 8,
+        eos_id: Optional[int] = None,
+    ):
+        self.params, self.cfg = params, cfg
+        self.B, self.max_len = batch_slots, max_len
+        self.chunk_steps = chunk_steps
+        self.eos_id = eos_id
+        self.cache = init_cache(cfg, batch=batch_slots, max_len=max_len)
+        self.last = jnp.full((batch_slots, cfg.vocab_size), -1e30, jnp.float32)
+        # Host-side mirrors of the per-slot bookkeeping: step() would
+        # otherwise pay per-slot device syncs (int(dev_arr[slot])) and
+        # per-slot scatter dispatches between chunks — on remote-attached
+        # chips that host bookkeeping can exceed the chunk's compute. The
+        # device copies are rebuilt from the mirrors once per call.
+        self._kv_np = np.zeros((batch_slots, max_len), bool)
+        self._off_np = np.zeros((batch_slots,), np.int32)
+        self._pos_np = np.zeros((batch_slots,), np.int32)
+        self.slots: Dict[int, _Slot] = {}
+        self.free = list(range(batch_slots))
+        self.results: Dict[int, List[int]] = {}
+        self._next_id = 0
+
+    @property
+    def has_capacity(self) -> bool:
+        return bool(self.free)
+
+    @property
+    def active(self) -> int:
+        return len(self.slots)
+
+    def admit(self, prompt_ids: List[int], max_new_tokens: int = 64) -> int:
+        """Prefill into a free slot; returns a request id.
+
+        Prompts are LEFT-padded to a power-of-two bucket so admission hits
+        a handful of compiled prefill programs under mixed-length traffic
+        instead of retracing per distinct length; pad slots are masked by
+        kv_valid and pos_offset exactly as in generate_tokens_batch."""
+        if not self.free:
+            raise RuntimeError("no free slot; call step() until one retires")
+        p = len(prompt_ids)
+        if p + 1 >= self.max_len:
+            raise ValueError("prompt too long for the slot window")
+        bucket = 8
+        while bucket < p:
+            bucket <<= 1
+        bucket = min(bucket, self.max_len - 1)
+        off = bucket - p
+        slot = self.free.pop()
+        rid = self._next_id
+        self._next_id += 1
+        # Slot validity: the real prompt rows [off, bucket), growing per step.
+        ar = np.arange(self.max_len)
+        self._kv_np[slot] = (ar >= off) & (ar < bucket)
+        self._off_np[slot] = off
+        self._pos_np[slot] = bucket
+        padded = [0] * off + list(prompt_ids)
+        self.cache, self.last = _admit_jit(
+            self.params, self.cfg, self.cache, self.last,
+            jnp.asarray([padded], jnp.int32), jnp.asarray(slot),
+            jnp.asarray(self._kv_np), jnp.asarray(self._off_np),
+        )
+        self.slots[slot] = _Slot(req_id=rid, prompt_len=bucket, max_new=max_new_tokens)
+        return rid
+
+    def step(self) -> List[int]:
+        """One decode chunk for every active slot; returns req_ids finished
+        in this chunk (their token lists land in ``results``)."""
+        if not self.slots:
+            return []
+        # Grow validity on the host mirror (vectorized over slots): each
+        # active slot may read its next chunk of rows as it writes them
+        # (enforced per-step by step_valid inside the chunk program). The
+        # left-pad region [0, pos_offset) stays invalid. One [B, L] upload
+        # per chunk replaces per-slot device scatters.
+        ar = np.arange(self.max_len)[None, :]
+        active = np.zeros((self.B,), bool)
+        active[list(self.slots)] = True
+        limit = (self._pos_np + self.chunk_steps)[:, None]
+        grow = active[:, None] & (ar >= self._off_np[:, None]) & (ar < limit)
+        self._kv_np |= grow
+
+        self.cache, self.last, _, toks = _step_chunk_jit(
+            self.params, self.cfg, self.cache, self.last, jnp.asarray(self._pos_np),
+            jnp.asarray(self._kv_np), jnp.asarray(self._off_np), self.chunk_steps,
+        )
+        self._pos_np += self.chunk_steps  # every slot advances in lockstep
+        toks_h = np.asarray(toks)
+        finished = []
+        for slot, st in list(self.slots.items()):
+            for t in toks_h[slot]:
+                if st.done:
+                    break
+                t = int(t)
+                if self.eos_id is not None and t == self.eos_id:
+                    st.done = True
+                    break
+                st.out.append(t)
+                if len(st.out) >= st.max_new or st.prompt_len + len(st.out) + 1 >= self.max_len:
+                    st.done = True
+            if st.done:
+                self.results[st.req_id] = st.out
+                finished.append(st.req_id)
+                del self.slots[slot]
+                self.free.append(slot)
+                self._kv_np[slot] = False
+        return finished
+
+    def run_all(self, prompts: List[List[int]], max_new_tokens: int = 64) -> List[List[int]]:
+        """Drain a whole request list through the slot pool (admitting as
+        slots free up); returns outputs in request order."""
+        pending = list(enumerate(prompts))
+        order: Dict[int, int] = {}
+        while pending or self.slots:
+            while pending and self.free:
+                idx, p = pending.pop(0)
+                order[self.admit(p, max_new_tokens)] = idx
+            self.step()
+        outs: List[List[int]] = [[] for _ in prompts]
+        for rid, toks in self.results.items():
+            outs[order[rid]] = toks
+        return outs

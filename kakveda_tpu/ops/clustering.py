@@ -115,15 +115,22 @@ def _project(v: jax.Array, out_dim: int) -> jax.Array:
 
 
 def build_knn_edges(
-    vecs: np.ndarray, *, k: int = _KNN_K, threshold: float = 0.6
+    vecs: np.ndarray, *, k: int = _KNN_K, threshold: float = 0.6,
+    force_projection: bool = False,
 ) -> tuple[np.ndarray, np.ndarray]:
     """(rows, cols) of the symmetric-union kNN graph restricted to exact
-    cosine ≥ threshold. One blocked sweep; O(N·k) edges out."""
+    cosine ≥ threshold. One blocked sweep; O(N·k) edges out.
+
+    ``force_projection`` activates the random-projection candidate tier
+    below its natural _EXACT_SWEEP_MAX switch-over — the recall tests use
+    it to observe projection-tier behavior at CI-tractable sizes."""
     v = jnp.asarray(vecs, jnp.float32)
     n, d = v.shape
     kk = min(k + 1, n)  # +1: each row's own top-1 is itself
 
-    exact = n <= _EXACT_SWEEP_MAX or d <= _MINE_DIM
+    exact = (n <= _EXACT_SWEEP_MAX or d <= _MINE_DIM) and not (
+        force_projection and d > _MINE_DIM
+    )
     vc = v if exact else _project(v, _MINE_DIM)
 
     pad = (-n) % _BLOCK
@@ -166,8 +173,10 @@ def build_knn_edges(
 
     if not exact:
         # Candidates came from the projection; re-score exactly, in chunks
-        # that bound the gather memory.
-        chunk = 1 << 20
+        # that bound the gather memory (two [chunk, d] f32 gathers live per
+        # dispatch — 128k × 2048 ≈ 1 GB each; 1M-pair chunks OOMed a 16 GB
+        # chip).
+        chunk = 1 << 17
         exact_sims = np.empty_like(sims)
         for s in range(0, len(rows), chunk):
             e = min(s + chunk, len(rows))
@@ -208,7 +217,8 @@ def _sparse_components(n: int, rows: np.ndarray, cols: np.ndarray) -> np.ndarray
 
 
 def cluster_embeddings(
-    vecs: np.ndarray, threshold: float = 0.6, *, knn_k: int = _KNN_K
+    vecs: np.ndarray, threshold: float = 0.6, *, knn_k: int = _KNN_K,
+    force_projection: bool = False,
 ) -> np.ndarray:
     """Connected-component labels for L2-normalized embeddings [N, d].
 
@@ -219,12 +229,14 @@ def cluster_embeddings(
     n = v.shape[0]
     if n == 0:
         return np.zeros(0, np.int32)
-    if n <= _DENSE_MAX:
+    if n <= _DENSE_MAX and not force_projection:
         sims = v @ v.T
         adj = sims >= threshold
         # Ensure self-edges so isolated rows keep their own label.
         adj = jnp.logical_or(adj, jnp.eye(n, dtype=bool))
         return np.asarray(_propagate_labels(adj))
 
-    rows, cols = build_knn_edges(vecs, k=knn_k, threshold=threshold)
+    rows, cols = build_knn_edges(
+        vecs, k=knn_k, threshold=threshold, force_projection=force_projection
+    )
     return _sparse_components(n, rows, cols)

@@ -60,6 +60,20 @@ def run_server(
     setup_logging(service_name="kakveda-tpu")
     cfg = get_runtime_config(service_name="kakveda-tpu")
 
+    # Honor JAX_PLATFORMS even on images whose sitecustomize pins the
+    # platform through jax.config (where the env var alone is ignored) —
+    # operators use it to run the service on CPU for dev/tests.
+    import os as _os
+
+    plat_env = _os.environ.get("JAX_PLATFORMS")
+    if plat_env:
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", plat_env)
+        except Exception as e:  # noqa: BLE001 — best effort, never fatal
+            log.warning("could not apply JAX_PLATFORMS=%s: %s", plat_env, e)
+
     # Join the multi-host world (if configured) BEFORE the Platform builds
     # its mesh — jax.devices() must already span the pod.
     from kakveda_tpu.parallel.distributed import initialize_multihost

@@ -161,3 +161,67 @@ def test_wizard_rejects_invalid_choice(tmp_path):
     ])
     path = run_wizard(tmp_path, input_fn=lambda _: next(answers), print_fn=lambda s: None)
     assert "KAKVEDA_ENV=production" in path.read_text()
+
+
+def test_up_detach_status_logs_down(tmp_path):
+    """Real process management: up --detach spawns a background server with
+    server.pid + server.log, status reports it running, logs tails output,
+    down SIGTERMs it and cleans the pid file."""
+    import json
+    import os
+    import socket
+    import subprocess
+    import sys
+    import time
+    import urllib.request
+
+    def free_port():
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    port = free_port()
+    env = dict(os.environ, JAX_PLATFORMS="cpu", KAKVEDA_LOG_FORMAT="text")
+
+    def cli(*argv, timeout=60):
+        return subprocess.run(
+            [sys.executable, "-m", "kakveda_tpu.cli", *argv],
+            capture_output=True, text=True, env=env, timeout=timeout,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+
+    r = cli("up", "--detach", "--dir", str(tmp_path), "--port", str(port),
+            "--dashboard-port", "0")
+    assert r.returncode == 0, r.stderr
+    pid_file = tmp_path / "server.pid"
+    assert pid_file.exists()
+
+    try:
+        # Wait for the server to come up (first jit compile is slow).
+        deadline = time.time() + 120
+        up = False
+        while time.time() < deadline:
+            try:
+                with urllib.request.urlopen(f"http://127.0.0.1:{port}/healthz", timeout=2) as resp:
+                    up = resp.status == 200
+                    break
+            except OSError:
+                time.sleep(1.0)
+        assert up, (tmp_path / "server.log").read_text()[-2000:]
+
+        # Double-up refuses while running.
+        r = cli("up", "--dir", str(tmp_path), "--port", str(port))
+        assert r.returncode == 1 and "already running" in r.stderr
+
+        r = cli("status", "--dir", str(tmp_path))
+        st = json.loads(r.stdout)
+        assert st["server"]["running"] is True
+
+        r = cli("logs", "--dir", str(tmp_path))
+        assert r.returncode == 0 and "platform API" in r.stdout
+    finally:
+        r = cli("down", "--dir", str(tmp_path), timeout=60)
+    assert r.returncode == 0, r.stderr
+    assert not pid_file.exists()
+    st = json.loads(cli("status", "--dir", str(tmp_path)).stdout)
+    assert st["server"]["running"] is False

@@ -323,7 +323,9 @@ def test_purge_demo_reloads_gfkb(tmp_path):
                     allow_redirects=False,
                 )
             assert plat.gfkb.count > 0
-            r = await client.post("/admin/purge-demo", allow_redirects=False)
+            r = await client.post(
+                "/admin/purge-demo", data={"confirm": "yes"}, allow_redirects=False
+            )
             assert r.status == 302
             # device index + metadata must reflect the rewritten log
             assert plat.gfkb.count == 0
@@ -604,3 +606,94 @@ def test_make_database_respects_env(tmp_path, monkeypatch):
     monkeypatch.setenv("KAKVEDA_DB_URL", "postgresql://u:p@nowhere:5432/d")
     with pytest.raises(RuntimeError, match="psycopg2"):
         make_database(tmp_path / "x.db")
+
+
+def test_admin_purge_demo_confirm_flow(tmp_path):
+    """Purge-demo ships a preview page + explicit confirm: GET shows counts
+    and backups, a POST without confirmation refuses, the confirmed POST
+    backs up, rewrites stores, and reports via the message banner."""
+
+    async def go():
+        app = _mk_app(tmp_path)
+        client = await _client(app)
+        try:
+            await _login(client)
+            # Seed demo + non-demo failures through the platform.
+            from datetime import datetime, timezone
+
+            from kakveda_tpu.core.schemas import TracePayload
+            from kakveda_tpu.dashboard.core import CTX_KEY
+
+            ctx_plat = app[CTX_KEY].platform
+            # Distinct prompts → distinct canonical records per app (a
+            # shared signature would canonicalize into one record spanning
+            # demo + prod apps, which purge rightly keeps).
+            for app_id in ("app-A", "app-B", "prod-app"):
+                await ctx_plat.ingest_batch(
+                    [
+                        TracePayload(
+                            trace_id=f"t-{app_id}", ts=datetime.now(timezone.utc),
+                            app_id=app_id, agent_id="t",
+                            prompt=f"Summarize the {app_id} report with citations even if not provided",
+                            response="Done [1] (Smith 2021)", tools=[], env={},
+                        )
+                    ]
+                )
+            assert len(ctx_plat.failures()) >= 1
+
+            r = await client.get("/admin/purge-demo")
+            page = await r.text()
+            assert r.status == 200 and "app-A" in page and "failures.jsonl" in page
+
+            # Unconfirmed POST refuses.
+            r = await client.post("/admin/purge-demo", data={}, allow_redirects=False)
+            assert r.status == 302 and "error" in r.headers["Location"]
+
+            # Confirmed POST purges, backs up, redirects with a message.
+            r = await client.post(
+                "/admin/purge-demo", data={"confirm": "yes"}, allow_redirects=False
+            )
+            assert r.status == 302 and "message=" in r.headers["Location"]
+            r = await client.get(r.headers["Location"])
+            page = await r.text()
+            assert "Purged demo apps" in page and ".bak-" in page
+            # Non-demo rows survive the purge.
+            apps = ctx_plat.apps()
+            assert "prod-app" in apps and "app-A" not in apps
+        finally:
+            await client.close()
+
+    run(go())
+
+
+def test_admin_agents_page(tmp_path):
+    """Dedicated admin agent-management page: register lands back on
+    /admin/agents, listing shows the secret-env column, delete removes."""
+
+    async def go():
+        client = await _client(_mk_app(tmp_path))
+        try:
+            await _login(client)
+            r = await client.post(
+                "/agents/register",
+                data={
+                    "name": "probe", "base_url": "http://127.0.0.1:9",
+                    "auth_kind": "bearer_env", "auth_secret_env": "PROBE_TOKEN",
+                    "next": "/admin/agents",
+                },
+                allow_redirects=False,
+            )
+            assert r.status == 302 and r.headers["Location"] == "/admin/agents"
+            r = await client.get("/admin/agents")
+            page = await r.text()
+            assert "probe" in page and "PROBE_TOKEN" in page
+            r = await client.post(
+                "/admin/agents/delete", data={"name": "probe"}, allow_redirects=False
+            )
+            assert r.status == 302
+            page = await (await client.get("/admin/agents")).text()
+            assert "probe" not in page
+        finally:
+            await client.close()
+
+    run(go())

@@ -167,3 +167,24 @@ def test_rejects_non_llama_and_unknown_scaling(tmp_path):
                 "rope_scaling": {"rope_type": "yarn", "factor": 2.0},
             }
         )
+
+
+def test_runtime_from_hf_sharded_serving(tmp_path):
+    """Real-weight serving on a mesh: from_hf(..., mesh=) places params per
+    the TP layout and generates the same greedy text as unsharded serving."""
+    from kakveda_tpu.models.generate import LlamaRuntime
+    from kakveda_tpu.models.llama import param_specs
+    from kakveda_tpu.parallel.mesh import create_mesh
+
+    _make_hf_checkpoint(tmp_path, vocab=256)
+    _write_tokenizer(tmp_path)
+    plain = LlamaRuntime.from_hf(str(tmp_path))
+    expected = plain.generate("the quick brown", max_tokens=6).text
+
+    mesh = create_mesh("dp:1,tp:2")
+    rt = LlamaRuntime.from_hf(str(tmp_path), mesh=mesh)
+    wq = rt.params["layers"][0]["wq"]
+    assert wq.sharding.spec == param_specs(rt.cfg)["layers"][0]["wq"]
+    got = rt.generate("the quick brown", max_tokens=6)
+    assert got.text == expected
+    assert got.meta["provider"] == "tpu"

@@ -243,3 +243,51 @@ def test_runtime_generate_batch():
     batch = rt.generate_batch(["hello", "a longer prompt here"], max_tokens=6)
     assert [r.text for r in batch] == solo
     assert batch[0].meta["batched"] == 2
+
+
+def test_decode_session_chunked_parity():
+    """Chunked decode (DecodeSession) must emit exactly the fused whole-
+    generation tokens — greedy, across uneven chunk boundaries — and honor
+    the cache window."""
+    import numpy as np
+
+    from kakveda_tpu.models.generate import DecodeSession, generate_tokens_fused
+    from kakveda_tpu.models.llama import init_params
+
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    prompts = [[5, 6, 7], [10, 11, 12, 13, 14, 15, 16], [42]]
+    fused = generate_tokens_fused(params, CFG, prompts, max_new_tokens=12)
+
+    sess = DecodeSession(params, CFG, prompts, chunk_steps=5, max_len=64)
+    chunks = []
+    while (c := sess.step_chunk()) is not None and sum(x.shape[1] for x in chunks) < 12:
+        chunks.append(c)
+    toks = np.concatenate(chunks, axis=1)[:, :12]
+    for i in range(len(prompts)):
+        assert toks[i].tolist() == fused[i][:12]
+
+    # Window exhaustion: session stops at max_len-1 total positions.
+    small = DecodeSession(params, CFG, [[5, 6, 7]], chunk_steps=64, max_len=16)
+    out = small.step_chunk()
+    assert out is not None and out.shape[1] == 16 - 1 - 3
+    assert small.step_chunk() is None
+
+
+def test_tp_sharded_generation_matches_single():
+    """Fused generation with Megatron-TP-sharded params on a tp:2 mesh must
+    emit exactly the single-device greedy tokens (XLA inserts the tp
+    collectives from the param shardings; batch stays replicated)."""
+    from kakveda_tpu.models.generate import generate_tokens_fused
+    from kakveda_tpu.models.hf_convert import shard_params
+    from kakveda_tpu.models.llama import init_params
+
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    prompts = [[5, 6, 7], [10, 11, 12, 13]]
+    single = generate_tokens_fused(params, CFG, prompts, max_new_tokens=8)
+
+    mesh = create_mesh("dp:1,tp:2")
+    sharded = shard_params(params, CFG, mesh)
+    wq = sharded["layers"][0]["wq"]
+    assert wq.sharding.spec == param_specs(CFG)["layers"][0]["wq"]
+    tp_out = generate_tokens_fused(sharded, CFG, prompts, max_new_tokens=8)
+    assert tp_out == single

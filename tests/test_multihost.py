@@ -86,7 +86,37 @@ host_params = jax.tree.map(lambda x: np.asarray(x), init_params(jax.random.PRNGK
 placed = shard_params(host_params, cfg, tmesh)
 assert not placed["layers"][0]["wq"].sharding.is_fully_addressable
 
-print(f"MULTIHOST_OK p{jax.process_index()} loss={loss_val:.6f} top1={float(scores[0,0]):.4f}")
+# --- GFKB snapshot discipline: collective gather, symmetric writes -------
+# Per-host data dirs (the deployment contract: a shared dir would double-
+# append the log). snapshot() is collective — EVERY process calls it and
+# writes its own dir — so a later restore runs IDENTICAL insert programs
+# on every host (a restored-vs-replayed mix desynchronizes SPMD lockstep).
+from kakveda_tpu.core.schemas import Severity
+from kakveda_tpu.index.gfkb import GFKB
+
+data_dir = os.environ["KAKVEDA_TEST_DATA_DIR"] + f"/host-{jax.process_index()}"
+kb = GFKB(data_dir=data_dir, capacity=64, dim=256)
+for i in range(6):
+    kb.upsert_failure(
+        failure_type="T",
+        signature_text=f"sig number {i} about topic {i * 3}",
+        app_id=f"app-{i % 2}",
+        impact_severity=Severity.low,
+    )
+sd = kb.snapshot()  # collective: both processes participate + write
+assert (sd / "manifest.json").exists(), f"p{jax.process_index()} missing snapshot"
+kb.upsert_failure(  # post-snapshot tail, must replay on restore
+    failure_type="T", signature_text="tail sig after snapshot", app_id="app-9",
+    impact_severity=Severity.low,
+)
+kb.close()
+kb2 = GFKB(data_dir=data_dir, capacity=64, dim=256)  # restore + tail replay
+assert kb2.count == 7, kb2.count
+m = kb2.match("tail sig after snapshot")
+assert m and m[0].score > 0.99, m
+snap_ok = "snap-restored"
+
+print(f"MULTIHOST_OK p{jax.process_index()} loss={loss_val:.6f} top1={float(scores[0,0]):.4f} snap={snap_ok}")
 """
 
 
@@ -109,6 +139,7 @@ def test_two_process_cluster(tmp_path):
             KAKVEDA_COORDINATOR=f"127.0.0.1:{port}",
             KAKVEDA_NUM_PROCESSES="2",
             KAKVEDA_PROCESS_ID=str(pid),
+            KAKVEDA_TEST_DATA_DIR=str(tmp_path / "data"),
             PYTHONPATH="/root/repo" + os.pathsep + env.get("PYTHONPATH", ""),
         )
         procs.append(

@@ -391,3 +391,42 @@ def test_knn_graph_threshold_zero_ignores_padding():
     # masked to -inf and filtered), matching dense
     dense = cl.cluster_embeddings(vecs, threshold=0.0)
     assert (labels == dense).all()
+
+
+def test_projection_tier_recall_on_separable_data():
+    """Random-projection candidate tier (the >131k-row production path,
+    forced on here at CI scale): on separable clustered data the projected
+    sweep must recover the exact partition — every edge is same-cluster
+    (precision 1.0 comes from exact re-scoring) and every cluster stays
+    fully connected (recall at the partition level)."""
+    import numpy as np
+
+    from kakveda_tpu.ops.clustering import build_knn_edges, cluster_embeddings
+
+    rng = np.random.default_rng(42)
+    C, per, dim = 24, 512, 2048  # 12,288 rows, 3 query-block dispatches
+    seeds = rng.standard_normal((C, dim)).astype(np.float32)
+    seeds /= np.linalg.norm(seeds, axis=1, keepdims=True)
+    truth = np.repeat(np.arange(C), per)
+    # Noise scaled so its NORM is ~0.3 (0.3/sqrt(dim) per component):
+    # within-cluster cosine ~1/1.09≈0.92, cross-cluster ~0 — separable at 0.6.
+    noise = (0.3 / np.sqrt(dim)) * rng.standard_normal((C * per, dim)).astype(np.float32)
+    vecs = seeds[truth] + noise
+    vecs /= np.linalg.norm(vecs, axis=1, keepdims=True)
+
+    rows, cols = build_knn_edges(vecs, threshold=0.6, force_projection=True)
+    assert len(rows) > 0
+    # Precision: exact re-scoring must kill every cross-cluster candidate.
+    assert np.all(truth[rows] == truth[cols])
+    # Row-level recall: every row keeps at least one same-cluster edge.
+    connected = np.zeros(len(vecs), bool)
+    connected[rows] = True
+    connected[cols] = True
+    assert connected.all()
+
+    labels = cluster_embeddings(vecs, threshold=0.6, force_projection=True)
+    # Partition-level recall: each true cluster is one component, and no
+    # component spans clusters.
+    for c in range(C):
+        assert len(np.unique(labels[truth == c])) == 1, f"cluster {c} split"
+    assert len(np.unique(labels)) == C
