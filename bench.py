@@ -152,7 +152,7 @@ def _measure_ours(n: int, dim: int, n_queries: int) -> float:
         ]
 
     # Warm both stages.
-    warm = knn.topk_async(emb, valid, feat.encode_batch(sig_batches[0]))
+    warm = knn.topk_async_sparse(emb, valid, *feat.encode_batch_sparse(sig_batches[0]))
     finish(warm)
 
     # Pipelined serving loop with a depth-D in-flight window: batch i's
@@ -165,8 +165,8 @@ def _measure_ours(n: int, dim: int, n_queries: int) -> float:
     inflight: deque = deque()
     t_prev = time.perf_counter()
     for sigs in sig_batches:
-        q = feat.encode_batch(sigs)
-        inflight.append(knn.topk_async(emb, valid, q))
+        q_idx, q_val = feat.encode_batch_sparse(sigs)
+        inflight.append(knn.topk_async_sparse(emb, valid, q_idx, q_val))
         if len(inflight) > depth:
             res = finish(inflight.popleft())
             assert len(res) == B
@@ -181,7 +181,7 @@ def _measure_ours(n: int, dim: int, n_queries: int) -> float:
     # is ~70 ms and is an environment artifact; locally-attached chips
     # fetch in microseconds.
     t0 = time.perf_counter()
-    finish(knn.topk_async(emb, valid, feat.encode_batch(sig_batches[0])))
+    finish(knn.topk_async_sparse(emb, valid, *feat.encode_batch_sparse(sig_batches[0])))
     single_ms = (time.perf_counter() - t0) * 1000.0
     print(f"bench: single-batch wall latency {single_ms:.1f} ms (incl. wire RTT)", file=sys.stderr)
 
@@ -490,14 +490,14 @@ def _measure_mixed_decode(n: int, dim: int, preset: str, chunk_steps: int) -> di
         signature_text(f"Summarize document {i} and include citations.", [], {"os": "linux"})
         for i in range(B)
     ]
-    q = feat.encode_batch(sigs)
-    knn.topk(emb, valid, q)  # warm
+    q_idx, q_val = feat.encode_batch_sparse(sigs)
+    knn.topk_result(knn.topk_async_sparse(emb, valid, q_idx, q_val))  # warm
 
     def warn_p50(rounds: int) -> float:
         lat = []
         for _ in range(rounds):
             t0 = time.perf_counter()
-            knn.topk(emb, valid, q)
+            knn.topk_result(knn.topk_async_sparse(emb, valid, q_idx, q_val))
             lat.append((time.perf_counter() - t0) * 1000.0 / B)
         return float(np.percentile(lat, 50))
 

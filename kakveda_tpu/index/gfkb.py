@@ -795,11 +795,12 @@ class GFKB:
         embedding work, capacity-growth re-embeds (both off-lock now), or
         other matches' result fetches.
         """
-        q = self.featurizer.encode_batch(list(signature_texts))
-        b = q.shape[0]
-        bb = batch_bucket(b)
-        if bb != b:
-            q = np.concatenate([q, np.zeros((bb - b, q.shape[1]), dtype=q.dtype)])
+        # Sparse query form: (idx, val) pairs ship ~60× fewer bytes per
+        # pre-flight check than dense rows; the device densifies before the
+        # same top-k (identical scores). topk_async_sparse buckets ragged
+        # batches internally.
+        q_idx, q_val = self.featurizer.encode_batch_sparse(list(signature_texts))
+        b = q_idx.shape[0]
 
         with self._lock:
             knn, emb, valid, types, records = self._view
@@ -814,7 +815,7 @@ class GFKB:
             with profiling.annotate("gfkb.match.dispatch"):
                 if tid is not None:
                     valid = knn.mask_valid(valid, types, tid)
-                packed = knn.topk_async(emb, valid, q)
+                packed = knn.topk_async_sparse(emb, valid, q_idx, q_val)
         with profiling.annotate("gfkb.match.fetch"):
             scores, slots = knn.topk_result(packed)
 

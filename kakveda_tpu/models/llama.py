@@ -249,6 +249,7 @@ def ring_attention_local(
     v: jax.Array,
     axis_name: str,
     n_chunks: int,
+    key_block: int = 2048,
 ) -> jax.Array:
     """Ring attention body — runs *inside* shard_map, sequence sharded over
     ``axis_name``. Each step attends the local queries against the currently
@@ -260,6 +261,13 @@ def ring_attention_local(
     q: [B, S_local, H_local, D]; k/v: [B, S_local, KV_local, D] —
     **un-repeated** GQA heads, so each ring hop moves the raw KV chunk
     (n_rep× less ICI traffic than rotating repeated heads).
+
+    Within each hop the held chunk is processed in ``key_block``-column
+    sub-blocks feeding the SAME online-softmax accumulators, so the
+    transient score tensor is [B,KV,R,S_l,key_block] f32 — never
+    [..., S_l, S_l]. At S_local = 8k that caps the per-hop scratch at
+    ~key_block/S_l of the unblocked cost (blockwise/flash structure at
+    the second level, after the ring's device level).
     """
     b, s_l, h, d = q.shape
     kv = k.shape[2]
@@ -273,26 +281,32 @@ def ring_attention_local(
     l = jnp.zeros((b, kv, r, s_l), jnp.float32)
     acc = jnp.zeros((b, kv, r, s_l, d), jnp.float32)
 
+    kb = min(key_block, s_l)
+
     perm = [(j, (j + 1) % n_chunks) for j in range(n_chunks)]
     k_cur, v_cur = k, v
     for i in range(n_chunks):  # static unroll: n_chunks is a mesh constant
         src = (me - i) % n_chunks  # whose chunk we hold this step
-        k_pos = src * s_l + jnp.arange(s_l)
-        scores = jnp.einsum("bqgrd,bkgd->bgrqk", q5, k_cur).astype(jnp.float32) * scale
-        mask = (q_pos[:, None] >= k_pos[None, :])[None, None, None]
-        scores = jnp.where(mask, scores, _NEG_INF)
+        for j in range(0, s_l, kb):  # sub-blocks (static ragged tail ok)
+            jb = min(kb, s_l - j)
+            k_sub = jax.lax.slice_in_dim(k_cur, j, j + jb, axis=1)
+            v_sub = jax.lax.slice_in_dim(v_cur, j, j + jb, axis=1)
+            k_pos = src * s_l + j + jnp.arange(jb)
+            scores = jnp.einsum("bqgrd,bkgd->bgrqk", q5, k_sub).astype(jnp.float32) * scale
+            mask = (q_pos[:, None] >= k_pos[None, :])[None, None, None]
+            scores = jnp.where(mask, scores, _NEG_INF)
 
-        chunk_max = jnp.max(scores, axis=-1)
-        m_new = jnp.maximum(m, chunk_max)
-        # Re-mask after the exp: if every score in this chunk is masked the
-        # subtraction would give exp(0)=1 on the first (all-masked) step.
-        p = jnp.where(mask, jnp.exp(scores - m_new[..., None]), 0.0)
-        corr = jnp.exp(m - m_new)
-        l = l * corr + jnp.sum(p, axis=-1)
-        acc = acc * corr[..., None] + jnp.einsum(
-            "bgrqk,bkgd->bgrqd", p.astype(v_cur.dtype), v_cur
-        ).astype(jnp.float32)
-        m = m_new
+            blk_max = jnp.max(scores, axis=-1)
+            m_new = jnp.maximum(m, blk_max)
+            # Re-mask after the exp: if every score in this block is masked
+            # the subtraction would give exp(0)=1 on the first such step.
+            p = jnp.where(mask, jnp.exp(scores - m_new[..., None]), 0.0)
+            corr = jnp.exp(m - m_new)
+            l = l * corr + jnp.sum(p, axis=-1)
+            acc = acc * corr[..., None] + jnp.einsum(
+                "bgrqk,bkgd->bgrqd", p.astype(v_sub.dtype), v_sub
+            ).astype(jnp.float32)
+            m = m_new
 
         if i < n_chunks - 1:
             k_cur = jax.lax.ppermute(k_cur, axis_name, perm)

@@ -229,10 +229,13 @@ class ContinuousBatcher:
         self._off_np[slot] = off
         self._pos_np[slot] = bucket
         padded = [0] * off + list(prompt_ids)
+        # .copy(): on the CPU backend jnp.asarray can alias the numpy
+        # buffer ZERO-COPY, and these mirrors keep mutating while the
+        # async program reads them — observed as flaky garbage logits.
         self.cache, self.last = _admit_jit(
             self.params, self.cfg, self.cache, self.last,
             jnp.asarray([padded], jnp.int32), jnp.asarray(slot),
-            jnp.asarray(self._kv_np), jnp.asarray(self._off_np),
+            jnp.asarray(self._kv_np.copy()), jnp.asarray(self._off_np.copy()),
         )
         self.slots[slot] = _Slot(req_id=rid, prompt_len=bucket, max_new=max_new_tokens)
         return rid
@@ -255,8 +258,8 @@ class ContinuousBatcher:
         self._kv_np |= grow
 
         self.cache, self.last, _, toks = _step_chunk_jit(
-            self.params, self.cfg, self.cache, self.last, jnp.asarray(self._pos_np),
-            jnp.asarray(self._kv_np), jnp.asarray(self._off_np), self.chunk_steps,
+            self.params, self.cfg, self.cache, self.last, jnp.asarray(self._pos_np.copy()),
+            jnp.asarray(self._kv_np.copy()), jnp.asarray(self._off_np.copy()), self.chunk_steps,
         )
         self._pos_np += self.chunk_steps  # every slot advances in lockstep
         toks_h = np.asarray(toks)

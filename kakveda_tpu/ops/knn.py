@@ -126,11 +126,17 @@ class ShardedKnn:
             self._valid_sharding = sharding
             self._repl = sharding
             self._topk = jax.jit(self._topk_single_impl)
+            self._topk_sparse = jax.jit(
+                lambda e, v, i, x: self._topk_single_impl(e, v, self._densify_q(i, x))
+            )
         else:
             self._emb_sharding = NamedSharding(mesh, P(shard_axis, None))
             self._valid_sharding = NamedSharding(mesh, P(shard_axis))
             self._repl = NamedSharding(mesh, P())
             self._topk = jax.jit(self._topk_impl)
+            self._topk_sparse = jax.jit(
+                lambda e, v, i, x: self._topk_impl(e, v, self._densify_q(i, x))
+            )
         self._insert = jax.jit(self._insert_impl, donate_argnums=(0, 1))
         self._insert_sparse = jax.jit(self._insert_sparse_impl, donate_argnums=(0, 1, 2))
         # Int32 side-table (per-slot failure-type ids) sharded like `valid`:
@@ -214,11 +220,9 @@ class ShardedKnn:
         return self._insert(emb, valid, vecs_d, self._replicate(phys))
 
     def _insert_sparse_impl(self, emb, valid, types, idx, val, phys_rows, tids):
-        b = idx.shape[0]
-        rows = jnp.zeros((b, self.dim), jnp.float32)
-        # Pad entries carry idx == dim → dropped; pad rows carry phys ==
-        # capacity → dropped by the row scatter below.
-        rows = rows.at[jnp.arange(b)[:, None], idx].add(val, mode="drop")
+        # Pad entries carry idx == dim → dropped by the densify scatter;
+        # pad rows carry phys == capacity → dropped by the row scatter.
+        rows = self._densify_q(idx, val)
         emb = emb.at[phys_rows].set(rows.astype(emb.dtype), mode="drop")
         valid = valid.at[phys_rows].set(True, mode="drop")
         types = types.at[phys_rows].set(tids, mode="drop")
@@ -348,6 +352,40 @@ class ShardedKnn:
         loop pipeline batch i's compute with batch i-1's fetch."""
         qd = jax.device_put(jnp.asarray(q, dtype=jnp.float32), self._repl)
         packed = self._topk(emb, valid, qd)
+        packed.copy_to_host_async()
+        return packed
+
+    def _densify_q(self, idx: jax.Array, val: jax.Array) -> jax.Array:
+        b = idx.shape[0]
+        q = jnp.zeros((b, self.dim), jnp.float32)
+        return q.at[jnp.arange(b)[:, None], idx].add(val, mode="drop")
+
+    def topk_async_sparse(
+        self, emb: jax.Array, valid: jax.Array, idx: np.ndarray, val: np.ndarray
+    ) -> jax.Array:
+        """Sparse-query dispatch: ships (idx, val) pairs — ~60× smaller
+        than dense hashed-ngram rows — and densifies on device before the
+        same top-k (identical results to ``topk_async``). The query upload
+        is part of every pre-flight check's wire cost, so this matters on
+        remote-attached chips the same way insert_sparse does for ingest.
+        The batch pads to a power-of-two bucket internally (pad rows carry
+        idx == dim, the densify drop sentinel) so ragged batches never
+        retrace — same contract as insert_sparse; result rows beyond the
+        caller's batch are the pad rows' (all-zero query → scores -2)."""
+        b = idx.shape[0]
+        bb = batch_bucket(max(b, 1))
+        if b != bb:
+            pad_i = np.full((bb, idx.shape[1]), self.dim, np.int32)
+            pad_v = np.zeros((bb, val.shape[1]), np.float32)
+            pad_i[:b] = idx
+            pad_v[:b] = val
+            idx, val = pad_i, pad_v
+        packed = self._topk_sparse(
+            emb,
+            valid,
+            self._replicate(np.ascontiguousarray(idx)),
+            self._replicate(np.ascontiguousarray(val)),
+        )
         packed.copy_to_host_async()
         return packed
 

@@ -132,3 +132,26 @@ def test_insert_sparse_matches_dense():
     s2, i2 = ks.topk(e2, v2, q)
     np.testing.assert_allclose(s1, s2, atol=1e-5)
     np.testing.assert_array_equal(i1, i2)
+
+
+def test_topk_sparse_query_matches_dense():
+    """Sparse (idx,val) query dispatch must produce identical scores/slots
+    to the dense path, on both single-device and sharded meshes."""
+    from kakveda_tpu.ops.featurizer import HashedNGramFeaturizer
+    from kakveda_tpu.parallel.mesh import create_mesh
+
+    feat = HashedNGramFeaturizer(dim=256)
+    corpus = [f"intent_tags:a,b | prompt_hint:doc {i} | tools: | env_keys:os" for i in range(9)]
+    queries = corpus[:3]
+    dense_rows = feat.encode_batch(corpus)
+    for spec in ("data:1", "data:4"):
+        knn = ShardedKnn(create_mesh(spec), capacity=32, dim=256, k=3)
+        emb, valid = knn.insert(*knn.alloc(), dense_rows, np.arange(9, dtype=np.int32))
+        dq = feat.encode_batch(queries)
+        s1, i1 = knn.topk_result(knn.topk_async(emb, valid, dq))
+        idx, val = feat.encode_batch_sparse(queries)
+        # Sparse dispatch buckets ragged batches internally — rows beyond
+        # the caller's batch are pad rows; slice them off.
+        s2, i2 = knn.topk_result(knn.topk_async_sparse(emb, valid, idx, val))
+        np.testing.assert_allclose(s1, s2[: len(queries)], atol=1e-6)
+        np.testing.assert_array_equal(i1, i2[: len(queries)])

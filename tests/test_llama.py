@@ -8,6 +8,7 @@ import pytest
 
 from kakveda_tpu.models.llama import (
     LlamaConfig,
+    _repeat_kv,
     causal_attention,
     decode_step,
     forward,
@@ -291,3 +292,34 @@ def test_tp_sharded_generation_matches_single():
     assert wq.sharding.spec == param_specs(CFG)["layers"][0]["wq"]
     tp_out = generate_tokens_fused(sharded, CFG, prompts, max_new_tokens=8)
     assert tp_out == single
+
+
+def test_ring_attention_key_blocking_matches_dense():
+    """Sub-blocked ring hops (key_block < S_local) must still reproduce
+    dense attention — the second-level online-softmax accumulation is
+    exact, not approximate."""
+    from functools import partial
+
+    from kakveda_tpu.models.llama import ring_attention_local
+
+    mesh = create_mesh("dp:1,cp:4,tp:1")
+    rng = np.random.default_rng(7)
+    b, s, h, kvh, d = 2, 32, 4, 2, 16
+    q = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, s, kvh, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, s, kvh, d)), jnp.float32)
+
+    from jax.sharding import PartitionSpec as P
+
+    def run(key_block):
+        spec = P("dp", "cp", None, None)
+        return jax.shard_map(
+            partial(ring_attention_local, axis_name="cp", n_chunks=4, key_block=key_block),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False,
+        )(q, k, v)
+
+    dense = np.asarray(causal_attention(q, _repeat_kv(k, 2), _repeat_kv(v, 2)))
+    blocked = np.asarray(run(key_block=4))  # S_local=8 → 2 sub-blocks/hop
+    unblocked = np.asarray(run(key_block=2048))
+    np.testing.assert_allclose(blocked, dense, atol=2e-5, rtol=1e-4)
+    np.testing.assert_allclose(blocked, unblocked, atol=1e-6)

@@ -13,7 +13,6 @@ CFG = LlamaConfig(
     d_ff=128, max_seq_len=128, dtype=__import__("jax.numpy", fromlist=["x"]).float32,
 )
 
-
 def test_continuous_batcher_parity_and_reuse():
     params = init_params(jax.random.PRNGKey(0), CFG)
     prompts = [[5, 6, 7], [10, 11, 12, 13, 14], [42], [9, 8], [100, 101, 102, 103]]
