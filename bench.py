@@ -145,10 +145,13 @@ def _measure_ours(n: int, dim: int, n_queries: int) -> float:
     ]
 
     def finish(packed):
+        # Sparse dispatch buckets ragged batches; rows past B are pad rows
+        # (all-zero queries — they score 0.0 against real rows, so slice,
+        # don't threshold).
         scores, slots = knn.topk_result(packed)
         return [
             [{**meta[int(s)], "score": float(v)} for v, s in zip(sr, tr) if v > -1.0 and int(s) < n]
-            for sr, tr in zip(scores, slots)
+            for sr, tr in zip(scores[:B], slots[:B])
         ]
 
     # Warm both stages.

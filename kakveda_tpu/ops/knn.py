@@ -370,8 +370,10 @@ class ShardedKnn:
         remote-attached chips the same way insert_sparse does for ingest.
         The batch pads to a power-of-two bucket internally (pad rows carry
         idx == dim, the densify drop sentinel) so ragged batches never
-        retrace — same contract as insert_sparse; result rows beyond the
-        caller's batch are the pad rows' (all-zero query → scores -2)."""
+        retrace — same contract as insert_sparse. Result rows beyond the
+        caller's batch belong to pad rows: an all-zero query scores 0.0
+        against every valid index row, so callers must SLICE results to
+        their batch size (a score threshold cannot identify pad rows)."""
         b = idx.shape[0]
         bb = batch_bucket(max(b, 1))
         if b != bb:
