@@ -865,9 +865,36 @@ def _bench_continuous(backend: str) -> dict:
 
 
 def main() -> int:
+    import threading
+
     import jax
 
-    backend = jax.default_backend()
+    # Backend-init watchdog: a wedged accelerator lease (e.g. a killed
+    # process still holding the remote chip) blocks jax.default_backend()
+    # in an indefinite claim loop — fail loudly after a bounded wait
+    # instead of hanging the whole bench run.
+    init_timeout = float(os.environ.get("KAKVEDA_BENCH_INIT_TIMEOUT", 600))
+    box: dict = {}
+
+    def _init():
+        try:
+            box["backend"] = jax.default_backend()
+        except Exception as e:  # noqa: BLE001
+            box["error"] = e
+
+    t = threading.Thread(target=_init, daemon=True)
+    t.start()
+    t.join(init_timeout)
+    if "error" in box:
+        raise box["error"]  # real init failure: propagate with traceback
+    if "backend" not in box:
+        print(
+            f"bench: accelerator backend still blocked after {init_timeout:.0f}s "
+            "(wedged device lease?); aborting",
+            file=sys.stderr,
+        )
+        return 1
+    backend = box["backend"]
     which = os.environ.get("KAKVEDA_BENCH_METRIC", "all")
 
     fns = {

@@ -41,3 +41,33 @@ def test_continuous_batcher_admit_mid_flight():
         cb.step()
     assert cb.results[ra] == solo_a
     assert cb.results[rb] == solo_b
+
+
+def test_batcher_and_warn_interleave_on_one_device():
+    """Chip-sharing integration: decode chunks and pre-flight matches
+    interleave on the same device without corrupting either — the batcher
+    emits exact solo tokens while warn batches run between chunks."""
+    import numpy as np
+
+    from kakveda_tpu.ops.featurizer import HashedNGramFeaturizer
+    from kakveda_tpu.ops.knn import ShardedKnn
+    from kakveda_tpu.parallel.mesh import create_mesh
+
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    prompts = [[5, 6, 7], [9, 8, 7, 6]]
+    solo = [generate_tokens(params, CFG, p, max_new_tokens=12, max_len=64) for p in prompts]
+
+    feat = HashedNGramFeaturizer(dim=256)
+    knn = ShardedKnn(create_mesh("data:1"), capacity=64, dim=256, k=3)
+    corpus = [f"intent_tags:a | prompt_hint:failure {i} | tools: | env_keys:os" for i in range(16)]
+    emb, valid = knn.insert(*knn.alloc(), feat.encode_batch(corpus), np.arange(16, dtype=np.int32))
+
+    cb = ContinuousBatcher(params, CFG, batch_slots=2, max_len=64, chunk_steps=4)
+    rids = [cb.admit(p, max_new_tokens=12) for p in prompts]
+    while cb.active:
+        cb.step()
+        # A warn batch between every chunk — shares the device queue.
+        idx, val = feat.encode_batch_sparse(corpus[:5])
+        scores, slots = knn.topk_result(knn.topk_async_sparse(emb, valid, idx, val))
+        assert scores[0, 0] > 0.99 and slots[0, 0] == 0  # self-match intact
+    assert [cb.results[r] for r in rids] == solo
