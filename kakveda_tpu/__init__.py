@@ -17,4 +17,4 @@ host service layer (aiohttp) keeps the reference's external REST/event
 contracts.
 """
 
-__version__ = "0.1.0"
+__version__ = "0.3.0"
