@@ -47,7 +47,7 @@ from kakveda_tpu.core.schemas import (
     Severity,
     utcnow,
 )
-from kakveda_tpu.ops.featurizer import HashedNGramFeaturizer
+from kakveda_tpu.ops.featurizer import HashedNGramFeaturizer, dense_rows_to_sparse
 from kakveda_tpu.ops.knn import ShardedKnn, batch_bucket
 from kakveda_tpu.parallel.mesh import create_mesh
 
@@ -213,13 +213,14 @@ class GFKB:
                     self._apps_by_type.setdefault(rec.failure_type, set()).update(
                         rec.affected_apps
                     )
-                vecs = self.featurizer.encode_batch([latest[k].signature_text for k in order])
                 self._ensure_capacity(len(self._records))
                 tids = np.asarray(
                     [self._type_id(latest[k].failure_type) for k in order], np.int32
                 )
-                self._insert_chunked(
-                    vecs, np.arange(base, base + len(order), dtype=np.int32), tids
+                self._insert_texts_chunked(
+                    [latest[k].signature_text for k in order],
+                    np.arange(base, base + len(order), dtype=np.int32),
+                    tids,
                 )
 
         if self.patterns_path.exists():
@@ -384,16 +385,31 @@ class GFKB:
         return offset
 
     def _insert_chunked(self, vecs: np.ndarray, slots: np.ndarray, tids: np.ndarray) -> None:
-        """Bulk insert in bounded chunks: insert inputs are replicated on
-        every device, so a million-row restore in one call would put the
-        whole matrix on each chip; 64k rows at a time bounds that."""
+        """Bulk insert of already-dense rows (snapshot restore) in bounded
+        chunks: insert inputs are replicated on every device, so a
+        million-row restore in one call would put the whole matrix on each
+        chip; 64k rows at a time bounds that. Rows re-sparsify before the
+        wire (hashed-ngram embeddings are ~98% zeros) — at 1M rows that is
+        ~250 MB of transfer instead of 8 GB."""
         chunk = 1 << 16
         for i in range(0, len(slots), chunk):
             sl = slots[i : i + chunk]
-            self._emb, self._valid = self._knn.insert(
-                self._emb, self._valid, vecs[i : i + chunk], sl
+            sp_i, sp_v = dense_rows_to_sparse(vecs[i : i + chunk], self._knn.dim)
+            self._emb, self._valid, self._types = self._knn.insert_sparse(
+                self._emb, self._valid, self._types, sp_i, sp_v, sl, tids[i : i + chunk]
             )
-            self._types = self._knn.scatter_i32(self._types, sl, tids[i : i + chunk])
+
+    def _insert_texts_chunked(self, texts: List[str], slots: np.ndarray, tids: np.ndarray) -> None:
+        """Bulk insert from signature TEXTS (replay/rebuild): encodes
+        sparse per chunk, so neither a full dense host matrix nor a dense
+        wire transfer ever materializes."""
+        chunk = 1 << 16
+        for i in range(0, len(slots), chunk):
+            sl = slots[i : i + chunk]
+            sp_i, sp_v = self.featurizer.encode_batch_sparse(texts[i : i + chunk])
+            self._emb, self._valid, self._types = self._knn.insert_sparse(
+                self._emb, self._valid, self._types, sp_i, sp_v, sl, tids[i : i + chunk]
+            )
 
     def reload(self) -> None:
         """Drop all in-memory/device state and replay the append logs.
@@ -514,10 +530,13 @@ class GFKB:
             tids = np.asarray([self._type_ids[r.failure_type] for r in records], np.int32)
             for i in range(0, len(records), chunk):
                 batch = records[i : i + chunk]
-                vecs = self.featurizer.encode_batch([r.signature_text for r in batch])
+                sp_i, sp_v = self.featurizer.encode_batch_sparse(
+                    [r.signature_text for r in batch]
+                )
                 slots = np.arange(i, i + len(batch), dtype=np.int32)
-                emb, valid = knn.insert(emb, valid, vecs, slots)
-                types = knn.scatter_i32(types, slots, tids[i : i + chunk])
+                emb, valid, types = knn.insert_sparse(
+                    emb, valid, types, sp_i, sp_v, slots, tids[i : i + chunk]
+                )
         return knn, emb, valid, types
 
     def _ensure_capacity(self, needed: int) -> None:
@@ -558,13 +577,16 @@ class GFKB:
                     continue  # appends outran the doubling; rebuild bigger
                 if len(self._records) > len(records):
                     delta = self._records[len(records) :]
-                    dvecs = self.featurizer.encode_batch([r.signature_text for r in delta])
+                    d_i, d_v = self.featurizer.encode_batch_sparse(
+                        [r.signature_text for r in delta]
+                    )
                     dslots = np.arange(len(records), len(self._records), dtype=np.int32)
-                    emb, valid = knn.insert(emb, valid, dvecs, dslots)
                     dtids = np.asarray(
                         [self._type_id(r.failure_type) for r in delta], np.int32
                     )
-                    types = knn.scatter_i32(types, dslots, dtids)
+                    emb, valid, types = knn.insert_sparse(
+                        emb, valid, types, d_i, d_v, dslots, dtids
+                    )
                 self._knn, self._emb, self._valid, self._types = knn, emb, valid, types
                 self._publish()
                 return

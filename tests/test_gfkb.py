@@ -331,13 +331,15 @@ def test_snapshot_restore_and_tail_replay(tmp_path, monkeypatch):
     import kakveda_tpu.ops.featurizer as feat_mod
 
     calls = []
-    orig = feat_mod.HashedNGramFeaturizer.encode_batch
+    orig = feat_mod.HashedNGramFeaturizer.encode_batch_sparse
 
     def counting(self, texts):
         calls.append(len(texts))
         return orig(self, texts)
 
-    monkeypatch.setattr(feat_mod.HashedNGramFeaturizer, "encode_batch", counting)
+    # Replay encodes through the sparse path; counting it proves snapshot
+    # rows skip re-embedding (they re-sparsify from stored dense vectors).
+    monkeypatch.setattr(feat_mod.HashedNGramFeaturizer, "encode_batch_sparse", counting)
     g2 = GFKB(data_dir=tmp_path, capacity=512, dim=1024)
     assert g2.count == n_total
     assert sum(calls) == 20, calls  # tail only
