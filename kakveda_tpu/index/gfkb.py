@@ -384,32 +384,33 @@ class GFKB:
             self._insert_chunked(vecs, np.arange(n, dtype=np.int32), tids)
         return offset
 
-    def _insert_chunked(self, vecs: np.ndarray, slots: np.ndarray, tids: np.ndarray) -> None:
-        """Bulk insert of already-dense rows (snapshot restore) in bounded
-        chunks: insert inputs are replicated on every device, so a
-        million-row restore in one call would put the whole matrix on each
-        chip; 64k rows at a time bounds that. Rows re-sparsify before the
-        wire (hashed-ngram embeddings are ~98% zeros) — at 1M rows that is
-        ~250 MB of transfer instead of 8 GB."""
+    def _bulk_insert_chunked(self, sparsify, slots: np.ndarray, tids: np.ndarray) -> None:
+        """Bulk insert in bounded 64k chunks: insert inputs are replicated
+        on every device, so a million-row restore in one call would put the
+        whole matrix on each chip. ``sparsify(i, j)`` yields the (idx, val)
+        pair for rows [i, j) — rows always ship sparse (hashed-ngram
+        embeddings are ~98% zeros; at 1M rows that is ~250 MB over the wire
+        instead of 8 GB)."""
         chunk = 1 << 16
         for i in range(0, len(slots), chunk):
-            sl = slots[i : i + chunk]
-            sp_i, sp_v = dense_rows_to_sparse(vecs[i : i + chunk], self._knn.dim)
+            j = min(i + chunk, len(slots))
+            sp_i, sp_v = sparsify(i, j)
             self._emb, self._valid, self._types = self._knn.insert_sparse(
-                self._emb, self._valid, self._types, sp_i, sp_v, sl, tids[i : i + chunk]
+                self._emb, self._valid, self._types, sp_i, sp_v, slots[i:j], tids[i:j]
             )
 
+    def _insert_chunked(self, vecs: np.ndarray, slots: np.ndarray, tids: np.ndarray) -> None:
+        """Already-dense rows (snapshot restore): re-sparsify per chunk."""
+        self._bulk_insert_chunked(
+            lambda i, j: dense_rows_to_sparse(vecs[i:j], self._knn.dim), slots, tids
+        )
+
     def _insert_texts_chunked(self, texts: List[str], slots: np.ndarray, tids: np.ndarray) -> None:
-        """Bulk insert from signature TEXTS (replay/rebuild): encodes
-        sparse per chunk, so neither a full dense host matrix nor a dense
-        wire transfer ever materializes."""
-        chunk = 1 << 16
-        for i in range(0, len(slots), chunk):
-            sl = slots[i : i + chunk]
-            sp_i, sp_v = self.featurizer.encode_batch_sparse(texts[i : i + chunk])
-            self._emb, self._valid, self._types = self._knn.insert_sparse(
-                self._emb, self._valid, self._types, sp_i, sp_v, sl, tids[i : i + chunk]
-            )
+        """Signature texts (replay/rebuild): encode sparse per chunk — no
+        dense host matrix ever materializes."""
+        self._bulk_insert_chunked(
+            lambda i, j: self.featurizer.encode_batch_sparse(texts[i:j]), slots, tids
+        )
 
     def reload(self) -> None:
         """Drop all in-memory/device state and replay the append logs.
@@ -527,7 +528,10 @@ class GFKB:
         types = knn.alloc_i32()
         if records:
             chunk = 1 << 16
-            tids = np.asarray([self._type_ids[r.failure_type] for r in records], np.int32)
+            # _type_id MINTS unseen ids — replay reaches here before any
+            # upsert has registered the types (raw dict access crashed a
+            # reopen whose log had outgrown the configured capacity).
+            tids = np.asarray([self._type_id(r.failure_type) for r in records], np.int32)
             for i in range(0, len(records), chunk):
                 batch = records[i : i + chunk]
                 sp_i, sp_v = self.featurizer.encode_batch_sparse(

@@ -37,9 +37,9 @@ batching at all; eval loops run one example at a time
 
 Use the class directly (``ContinuousBatcher(params, cfg, ...)``); it
 accepts the same param trees as every other forward path, including int8
-weight-only quantized ones (llama.wmat). Prototype status: greedy
-decoding; per-request temperature would thread a [B] vector through the
-chunk body.
+weight-only quantized ones (llama.wmat). Decoding is greedy by default;
+``admit(..., temperature=t)`` samples that slot only (a [B] temperature
+vector threads through the chunk body; greedy slots stay exact).
 """
 
 from __future__ import annotations
@@ -91,13 +91,16 @@ def _admit_jit(params, cfg: LlamaConfig, cache, last, prompt, slot, kv_valid, po
 
 
 @partial(jax.jit, static_argnames=("cfg", "n_steps"), donate_argnums=(2,))
-def _step_chunk_jit(params, cfg: LlamaConfig, cache, last, slot_pos, kv_valid, pos_offset, n_steps: int):
-    """Advance every slot by ``n_steps`` greedy tokens in one program.
+def _step_chunk_jit(params, cfg: LlamaConfig, cache, last, slot_pos, kv_valid, pos_offset, temps, rng, n_steps: int):
+    """Advance every slot by ``n_steps`` tokens in one program.
 
     ``slot_pos`` [B] — per-slot NEXT cache index (prompt length + tokens
     decoded so far). decode_step's scalar `pos` can't express per-slot
     positions, so the chunk body re-implements the cached step with a
     per-slot write index: token t of slot b lands at cache[b, :, slot_pos[b]+t].
+    ``temps`` [B] — per-slot sampling temperature; a slot with temp ≤ 0
+    decodes greedily, others sample categorically (one rng split per step,
+    shared across slots — rows are independent draws of the same key).
     """
     from kakveda_tpu.models.attention import gqa_cache_attention
     from kakveda_tpu.models.llama import _mlp_block, _rope_freqs, apply_rope, rms_norm, wmat
@@ -107,8 +110,12 @@ def _step_chunk_jit(params, cfg: LlamaConfig, cache, last, slot_pos, kv_valid, p
     max_len = cache["k"][0].shape[2]
 
     def one_step(carry, _):
-        cache_k, cache_v, last, slot_pos = carry
-        nxt = jnp.argmax(last, axis=-1)  # [B]
+        cache_k, cache_v, last, slot_pos, rng = carry
+        rng, sub = jax.random.split(rng)
+        sampled = jax.random.categorical(
+            sub, last / jnp.maximum(temps, 1e-6)[:, None], axis=-1
+        )
+        nxt = jnp.where(temps > 0.0, sampled, jnp.argmax(last, axis=-1))  # [B]
         tokens = nxt[:, None].astype(jnp.int32)
         positions = (slot_pos - pos_offset)[:, None]  # logical positions
         cos, sin = _rope_freqs(cfg, positions)
@@ -146,12 +153,12 @@ def _step_chunk_jit(params, cfg: LlamaConfig, cache, last, slot_pos, kv_valid, p
         logits = (x @ wmat(params["lm_head"], cfg.dtype)).astype(jnp.float32)[:, -1, :]
         if cfg.effective_vocab is not None:
             logits = logits.at[:, cfg.effective_vocab :].set(-jnp.inf)
-        return (new_k, new_v, logits, slot_pos + 1), nxt
+        return (new_k, new_v, logits, slot_pos + 1, rng), nxt
 
-    (ck, cv, last, slot_pos), toks = jax.lax.scan(
-        one_step, (cache["k"], cache["v"], last, slot_pos), None, length=n_steps
+    (ck, cv, last, slot_pos, rng), toks = jax.lax.scan(
+        one_step, (cache["k"], cache["v"], last, slot_pos, rng), None, length=n_steps
     )
-    return {"pos": cache["pos"], "k": ck, "v": cv}, last, slot_pos, toks.T  # [B, n_steps]
+    return {"pos": cache["pos"], "k": ck, "v": cv}, last, slot_pos, rng, toks.T  # [B, n_steps]
 
 
 @dataclass
@@ -164,7 +171,8 @@ class _Slot:
 
 
 class ContinuousBatcher:
-    """Admit-as-you-go generation over a fixed slot pool (greedy)."""
+    """Admit-as-you-go generation over a fixed slot pool. Greedy by
+    default; per-request ``temperature`` samples that slot only."""
 
     def __init__(
         self,
@@ -175,6 +183,7 @@ class ContinuousBatcher:
         max_len: int = 512,
         chunk_steps: int = 8,
         eos_id: Optional[int] = None,
+        rng: Optional[jax.Array] = None,
     ):
         self.params, self.cfg = params, cfg
         self.B, self.max_len = batch_slots, max_len
@@ -190,6 +199,8 @@ class ContinuousBatcher:
         self._kv_np = np.zeros((batch_slots, max_len), bool)
         self._off_np = np.zeros((batch_slots,), np.int32)
         self._pos_np = np.zeros((batch_slots,), np.int32)
+        self._temp_np = np.zeros((batch_slots,), np.float32)  # ≤0 = greedy
+        self.rng = rng if rng is not None else jax.random.PRNGKey(0)
         self.slots: Dict[int, _Slot] = {}
         self.free = list(range(batch_slots))
         self.results: Dict[int, List[int]] = {}
@@ -203,7 +214,9 @@ class ContinuousBatcher:
     def active(self) -> int:
         return len(self.slots)
 
-    def admit(self, prompt_ids: List[int], max_new_tokens: int = 64) -> int:
+    def admit(
+        self, prompt_ids: List[int], max_new_tokens: int = 64, temperature: float = 0.0
+    ) -> int:
         """Prefill into a free slot; returns a request id.
 
         Prompts are LEFT-padded to a power-of-two bucket so admission hits
@@ -228,6 +241,7 @@ class ContinuousBatcher:
         self._kv_np[slot] = (ar >= off) & (ar < bucket)
         self._off_np[slot] = off
         self._pos_np[slot] = bucket
+        self._temp_np[slot] = temperature
         padded = [0] * off + list(prompt_ids)
         # .copy(): on the CPU backend jnp.asarray can alias the numpy
         # buffer ZERO-COPY, and these mirrors keep mutating while the
@@ -257,9 +271,10 @@ class ContinuousBatcher:
         grow = active[:, None] & (ar >= self._off_np[:, None]) & (ar < limit)
         self._kv_np |= grow
 
-        self.cache, self.last, _, toks = _step_chunk_jit(
+        self.cache, self.last, _, self.rng, toks = _step_chunk_jit(
             self.params, self.cfg, self.cache, self.last, jnp.asarray(self._pos_np.copy()),
-            jnp.asarray(self._kv_np.copy()), jnp.asarray(self._off_np.copy()), self.chunk_steps,
+            jnp.asarray(self._kv_np.copy()), jnp.asarray(self._off_np.copy()),
+            jnp.asarray(self._temp_np.copy()), self.rng, self.chunk_steps,
         )
         self._pos_np += self.chunk_steps  # every slot advances in lockstep
         toks_h = np.asarray(toks)

@@ -387,3 +387,24 @@ def test_snapshot_tail_update_of_snapshotted_record(tmp_path):
     rec = g2.list_failures()[0]
     assert rec.version == 2 and sorted(rec.affected_apps) == ["app-A", "app-B"]
     g2.close()
+
+
+def test_reopen_after_log_outgrows_capacity(tmp_path):
+    """Reopening a dir whose log has MORE records than the configured
+    capacity must replay through init-time growth (regression: _build_index
+    read type ids before replay had minted any → KeyError on restart)."""
+    kb = GFKB(data_dir=tmp_path / "d", capacity=8, dim=256)
+    for i in range(30):
+        kb.upsert_failure(
+            failure_type=f"T{i % 3}",
+            signature_text=_sig(f"grown record {i} topic {i * 11}"),
+            app_id=f"a{i % 2}",
+            impact_severity=Severity.low,
+        )
+    kb.close()
+
+    kb2 = GFKB(data_dir=tmp_path / "d", capacity=8, dim=256)
+    assert kb2.count == 30
+    m = kb2.match(_sig("grown record 17 topic 187"), failure_type="T2", type_filter="pre")
+    assert m and m[0].score > 0.9 and m[0].failure_type == "T2"
+    kb2.close()

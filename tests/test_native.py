@@ -116,3 +116,41 @@ def test_gfkb_appends_visible_after_upsert(tmp_path):
     text = (tmp_path / "failures.jsonl").read_text()
     assert text.count("\n") == 1 and "F-0001" in text
     idx.close()
+
+
+def test_sparse_encode_native_python_parity():
+    """The C++ sparse encoder and the Python fallback (dense + nonzero)
+    must produce the same DENSIFIED rows — entry order inside a row may
+    differ, so compare through the scatter semantics, and exercise the
+    grow-and-retry path with a >64-feature text."""
+    import numpy as np
+
+    from kakveda_tpu import native
+    from kakveda_tpu.ops.featurizer import HashedNGramFeaturizer
+
+    if not native.available():
+        import pytest
+
+        pytest.skip("native library unavailable")
+
+    feat = HashedNGramFeaturizer(dim=512)
+    texts = [
+        "intent_tags:intent:citations_required,task:summarization | prompt_hint:summarize the report | tools:search,browse | env_keys:os,region",
+        "plain free-form text without any field structure at all",
+        "",
+        # >64 unique grams → native returns required-K and the wrapper retries
+        " ".join(f"word{i}" for i in range(90)),
+    ]
+    n_idx, n_val = feat._encode_sparse_native(native.load(), texts)
+    dense = feat.encode_batch(texts)
+
+    def densify(idx, val):
+        out = np.zeros((idx.shape[0], feat.dim), np.float32)
+        for r in range(idx.shape[0]):
+            for c, v in zip(idx[r], val[r]):
+                if c < feat.dim:
+                    out[r, c] += v
+        return out
+
+    np.testing.assert_allclose(densify(n_idx, n_val), dense, atol=1e-6)
+    assert n_idx.shape[1] >= 128  # grew past the 64 floor for the long text

@@ -71,3 +71,27 @@ def test_batcher_and_warn_interleave_on_one_device():
         scores, slots = knn.topk_result(knn.topk_async_sparse(emb, valid, idx, val))
         assert scores[0, 0] > 0.99 and slots[0, 0] == 0  # self-match intact
     assert [cb.results[r] for r in rids] == solo
+
+
+def test_per_request_temperature():
+    """A sampled slot varies with the rng while a greedy slot in the SAME
+    pool keeps exact parity with solo greedy decoding."""
+    params = init_params(jax.random.PRNGKey(2), CFG)
+    greedy_prompt, sampled_prompt = [5, 6, 7], [50, 51, 52]
+    solo = generate_tokens(params, CFG, greedy_prompt, max_new_tokens=10, max_len=64)
+
+    def run(seed):
+        cb = ContinuousBatcher(
+            params, CFG, batch_slots=2, max_len=64, chunk_steps=4,
+            rng=jax.random.PRNGKey(seed),
+        )
+        rg = cb.admit(greedy_prompt, max_new_tokens=10)
+        rs = cb.admit(sampled_prompt, max_new_tokens=10, temperature=1.5)
+        while cb.active:
+            cb.step()
+        return cb.results[rg], cb.results[rs]
+
+    g1, s1 = run(seed=1)
+    g2, s2 = run(seed=2)
+    assert g1 == solo and g2 == solo  # greedy slot unaffected by sampling
+    assert s1 != s2  # sampled slot actually samples (different keys differ)
