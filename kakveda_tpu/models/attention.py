@@ -54,7 +54,7 @@ _NEG_INF = -1e30
 # ---------------------------------------------------------------------------
 
 
-def _gqa_xla(q, k, v, pos0, kv_valid):
+def _gqa_xla(q, k, v, pos0, kv_valid, window: int = 0):
     b, s, h, d = q.shape
     _, kv, l, _ = k.shape
     r = h // kv
@@ -66,6 +66,9 @@ def _gqa_xla(q, k, v, pos0, kv_valid):
     q_pos = pos0 + jnp.arange(s)
     l_pos = jnp.arange(l)
     mask = q_pos[:, None] >= l_pos[None, :]  # [S, L]
+    if window:
+        # Sliding-window attention (Mistral): keep iff q_pos − l_pos < window.
+        mask &= (q_pos[:, None] - l_pos[None, :]) < window
     if kv_valid is not None:
         full = mask[None, :, :] & kv_valid[:, None, :]  # [B, S, L]
         scores = jnp.where(full[:, None, :, None, :], scores, _NEG_INF)
@@ -97,6 +100,7 @@ def _flash_kernel(
     l_blk: int,
     n_l: int,
     scale: float,
+    window: int,
 ):
     lb = pl.program_id(2)
     qb = pl.program_id(1)
@@ -121,6 +125,8 @@ def _flash_kernel(
     q_pos = pos0_ref[0, 0] + (qb * q_blk + rows) // r
     l_pos = lb * l_blk + cols
     keep = (q_pos >= l_pos) & (valid_ref[0, 0][None, :] > 0.5)
+    if window:
+        keep &= (q_pos - l_pos) < window
     s = jnp.where(keep, s, _NEG_INF)
 
     m_prev = m_scr[:, :1]  # [q_blk, 1] (all lanes equal; col 0 is truth)
@@ -146,7 +152,7 @@ def _flash_kernel(
         o_ref[0] = (acc_scr[:] / denom).astype(o_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("q_blk", "l_blk", "interpret"))
+@functools.partial(jax.jit, static_argnames=("q_blk", "l_blk", "window", "interpret"))
 def flash_gqa_cache(
     q: jax.Array,  # [B, S, H, D]
     k: jax.Array,  # [B, KV, L, D]
@@ -156,6 +162,7 @@ def flash_gqa_cache(
     *,
     q_blk: int = 512,
     l_blk: int = 512,
+    window: int = 0,
     interpret: bool = False,
 ) -> jax.Array:
     b, s, h, d = q.shape
@@ -193,6 +200,7 @@ def flash_gqa_cache(
             l_blk=l_blk,
             n_l=n_l,
             scale=d**-0.5,
+            window=window,
         ),
         grid=(b * kv, n_q, n_l),
         in_specs=[
@@ -273,10 +281,12 @@ def gqa_cache_attention(
     pos0: jax.Array,
     kv_valid: jax.Array | None = None,
     *,
+    window: int = 0,
     use_flash: bool | None = None,
 ) -> jax.Array:
     """Cached GQA attention — dispatches to the Pallas flash kernel on TPU
     (inference shapes that fit its tiling), XLA grouped einsum otherwise.
+    ``window`` > 0 applies sliding-window attention (Mistral) in both paths.
     ``KAKVEDA_FLASH=0`` forces the XLA path."""
     b, s, h, d = q.shape
     _, kv, l, _ = k.shape
@@ -295,5 +305,6 @@ def gqa_cache_attention(
             q, k, v, pos0, kv_valid,
             q_blk=_pick_block(sr, 512, 8),
             l_blk=_pick_block(l, 512, 128),
+            window=window,
         )
-    return _gqa_xla(q, k, v, pos0, kv_valid)
+    return _gqa_xla(q, k, v, pos0, kv_valid, window=window)

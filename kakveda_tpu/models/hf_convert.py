@@ -1,10 +1,14 @@
-"""HF Llama checkpoint → kakveda param pytree.
+"""HF checkpoint → kakveda param pytree (Llama / Mistral / Qwen2 families).
 
 The reference delegates all real-model inference to an external Ollama
-daemon (reference: services/dashboard/app.py:1182-1258). Here real weights
-load directly onto the TPU mesh: point ``KAKVEDA_HF_CKPT`` at any local
-HF-format Llama-family checkpoint directory (TinyLlama-1.1B,
-Llama-3-8B, …) and ``runtime=tpu`` serves it in-process.
+daemon (reference: services/dashboard/app.py:1182-1258) — which is also how
+it supports many model families. Here real weights load directly onto the
+TPU mesh: point ``KAKVEDA_HF_CKPT`` at any local HF-format checkpoint
+directory of a supported family (TinyLlama-1.1B, Llama-3-8B,
+Mistral-7B, Qwen2.5-…, …) and ``runtime=tpu`` serves it in-process.
+Family deltas handled by the one runtime: Mistral's sliding attention
+window + explicit head_dim, Qwen2's q/k/v biases (see
+:func:`hf_config_to_llama`).
 
 Conversion notes (all verified by the logit-parity tests in
 tests/test_hf_convert.py against ``transformers.LlamaForCausalLM``):
@@ -46,10 +50,21 @@ __all__ = ["hf_config_to_llama", "load_hf_checkpoint", "shard_params"]
 _VOCAB_MULTIPLE = 8
 
 
+_SUPPORTED_FAMILIES = ("llama", "mistral", "qwen2")
+
+
 def hf_config_to_llama(hf: Dict[str, Any], *, dtype=jnp.bfloat16) -> LlamaConfig:
-    """Map an HF ``config.json`` dict to :class:`LlamaConfig`."""
-    if hf.get("model_type") not in (None, "llama"):
-        raise ValueError(f"not a llama-family config: model_type={hf.get('model_type')!r}")
+    """Map an HF ``config.json`` dict to :class:`LlamaConfig`.
+
+    Three HF families share the Llama block structure and load onto the one
+    runtime: ``llama`` (the baseline), ``mistral`` (adds a sliding attention
+    window and sometimes an explicit head_dim), and ``qwen2`` (adds q/k/v
+    projection biases). Anything else is rejected loudly."""
+    family = hf.get("model_type") or "llama"
+    if family not in _SUPPORTED_FAMILIES:
+        raise ValueError(
+            f"unsupported model_type={family!r} (supported: {', '.join(_SUPPORTED_FAMILIES)})"
+        )
     rope = hf.get("rope_scaling") or {}
     kw: Dict[str, Any] = {}
     if rope:
@@ -62,6 +77,34 @@ def hf_config_to_llama(hf: Dict[str, Any], *, dtype=jnp.bfloat16) -> LlamaConfig
             rope_high_freq_factor=float(rope.get("high_freq_factor", 4.0)),
             rope_original_max_len=int(rope.get("original_max_position_embeddings", 8192)),
         )
+
+    # Sliding-window attention: Mistral applies it whenever the config sets
+    # one; Qwen2 additionally gates on use_sliding_window and only past
+    # max_window_layers — the mixed-layer form has no support here, so it
+    # fails loudly rather than serving wrong attention.
+    window = int(hf.get("sliding_window") or 0)
+    if family == "qwen2" and window:
+        if not hf.get("use_sliding_window", False):
+            window = 0
+        else:
+            # HF semantics: the first max_window_layers layers use FULL
+            # attention, the rest slide. Only the uniform cases map here.
+            # The missing-key default matches Qwen2Config's (28), so a
+            # config without the key resolves the same way HF resolves it.
+            mwl = int(hf.get("max_window_layers", 28))
+            if mwl >= int(hf["num_hidden_layers"]):
+                window = 0  # every layer full attention
+            elif mwl != 0:
+                raise ValueError(
+                    "qwen2 mixed full/sliding layers (0 < max_window_layers < "
+                    "num_hidden_layers) is not supported"
+                )
+
+    n_heads = int(hf["num_attention_heads"])
+    head_dim = int(hf.get("head_dim") or 0)
+    if head_dim and head_dim * n_heads == int(hf["hidden_size"]):
+        head_dim = 0  # derived value; keep the config canonical
+
     vocab = int(hf["vocab_size"])
     padded = -(-vocab // _VOCAB_MULTIPLE) * _VOCAB_MULTIPLE
     return LlamaConfig(
@@ -69,13 +112,16 @@ def hf_config_to_llama(hf: Dict[str, Any], *, dtype=jnp.bfloat16) -> LlamaConfig
         effective_vocab=vocab if padded != vocab else None,
         d_model=int(hf["hidden_size"]),
         n_layers=int(hf["num_hidden_layers"]),
-        n_heads=int(hf["num_attention_heads"]),
-        n_kv_heads=int(hf.get("num_key_value_heads", hf["num_attention_heads"])),
+        n_heads=n_heads,
+        n_kv_heads=int(hf.get("num_key_value_heads", n_heads)),
         d_ff=int(hf["intermediate_size"]),
         max_seq_len=int(hf.get("max_position_embeddings", 2048)),
         rope_theta=float(hf.get("rope_theta", 10000.0)),
         norm_eps=float(hf.get("rms_norm_eps", 1e-5)),
         dtype=dtype,
+        attn_bias=bool(hf.get("attention_bias", family == "qwen2")),
+        sliding_window=window,
+        head_dim_opt=head_dim,
         **kw,
     )
 
@@ -137,22 +183,12 @@ def _torch():
 
 
 def _empty_tree(cfg: LlamaConfig) -> Params:
+    keys = ["attn_norm", "wq", "wk", "wv", "wo", "mlp_norm", "w_gate", "w_up", "w_down"]
+    if cfg.attn_bias:
+        keys += ["bq", "bk", "bv"]
     return {
         "embed": None,
-        "layers": [
-            {
-                "attn_norm": None,
-                "wq": None,
-                "wk": None,
-                "wv": None,
-                "wo": None,
-                "mlp_norm": None,
-                "w_gate": None,
-                "w_up": None,
-                "w_down": None,
-            }
-            for _ in range(cfg.n_layers)
-        ],
+        "layers": [{k: None for k in keys} for _ in range(cfg.n_layers)],
         "final_norm": None,
         "lm_head": None,
     }
@@ -212,6 +248,12 @@ def load_hf_checkpoint(
                     put(layer, "wk", arr, transpose=True)
                 case "self_attn.v_proj.weight":
                     put(layer, "wv", arr, transpose=True)
+                case "self_attn.q_proj.bias" | "self_attn.k_proj.bias" | "self_attn.v_proj.bias":
+                    if not cfg.attn_bias:
+                        raise ValueError(
+                            f"checkpoint carries {name} but the config resolved attn_bias=False"
+                        )
+                    put(layer, "b" + rest.split(".")[1][0], arr, transpose=False)
                 case "self_attn.o_proj.weight":
                     put(layer, "wo", arr, transpose=True)
                 case "mlp.gate_proj.weight":

@@ -62,10 +62,18 @@ class LlamaConfig:
     # `vocab_size` is the padded table size and `effective_vocab` the real
     # tokenizer vocab; sampling masks logits beyond it. None = no padding.
     effective_vocab: Optional[int] = None
+    # Model-family knobs (Qwen2 / Mistral share the Llama block structure):
+    # q/k/v projection biases (Qwen2), a sliding attention window in tokens
+    # (Mistral; 0 = full causal), and an explicit head_dim for checkpoints
+    # where it isn't d_model/n_heads (Mistral-NeMo-style). Flat scalars so
+    # the config stays hashable (it is a static jit argument).
+    attn_bias: bool = False
+    sliding_window: int = 0
+    head_dim_opt: int = 0  # 0 = derive from d_model // n_heads
 
     @property
     def head_dim(self) -> int:
-        return self.d_model // self.n_heads
+        return self.head_dim_opt or self.d_model // self.n_heads
 
     @classmethod
     def tiny(cls, **kw) -> "LlamaConfig":
@@ -101,19 +109,22 @@ def init_params(rng: jax.Array, cfg: LlamaConfig) -> Params:
     layers = []
     for i in range(cfg.n_layers):
         k = jax.random.split(keys[i], 7)
-        layers.append(
-            {
-                "attn_norm": jnp.ones((cfg.d_model,), jnp.float32),
-                "wq": dense(k[0], cfg.d_model, (cfg.d_model, cfg.n_heads * hd)),
-                "wk": dense(k[1], cfg.d_model, (cfg.d_model, cfg.n_kv_heads * hd)),
-                "wv": dense(k[2], cfg.d_model, (cfg.d_model, cfg.n_kv_heads * hd)),
-                "wo": dense(k[3], cfg.n_heads * hd, (cfg.n_heads * hd, cfg.d_model)),
-                "mlp_norm": jnp.ones((cfg.d_model,), jnp.float32),
-                "w_gate": dense(k[4], cfg.d_model, (cfg.d_model, cfg.d_ff)),
-                "w_up": dense(k[5], cfg.d_model, (cfg.d_model, cfg.d_ff)),
-                "w_down": dense(k[6], cfg.d_ff, (cfg.d_ff, cfg.d_model)),
-            }
-        )
+        layer = {
+            "attn_norm": jnp.ones((cfg.d_model,), jnp.float32),
+            "wq": dense(k[0], cfg.d_model, (cfg.d_model, cfg.n_heads * hd)),
+            "wk": dense(k[1], cfg.d_model, (cfg.d_model, cfg.n_kv_heads * hd)),
+            "wv": dense(k[2], cfg.d_model, (cfg.d_model, cfg.n_kv_heads * hd)),
+            "wo": dense(k[3], cfg.n_heads * hd, (cfg.n_heads * hd, cfg.d_model)),
+            "mlp_norm": jnp.ones((cfg.d_model,), jnp.float32),
+            "w_gate": dense(k[4], cfg.d_model, (cfg.d_model, cfg.d_ff)),
+            "w_up": dense(k[5], cfg.d_model, (cfg.d_model, cfg.d_ff)),
+            "w_down": dense(k[6], cfg.d_ff, (cfg.d_ff, cfg.d_model)),
+        }
+        if cfg.attn_bias:
+            layer["bq"] = jnp.zeros((cfg.n_heads * hd,), jnp.float32)
+            layer["bk"] = jnp.zeros((cfg.n_kv_heads * hd,), jnp.float32)
+            layer["bv"] = jnp.zeros((cfg.n_kv_heads * hd,), jnp.float32)
+        layers.append(layer)
     return {
         "embed": dense(keys[-2], cfg.d_model, (cfg.vocab_size, cfg.d_model)),
         "layers": layers,
@@ -135,6 +146,9 @@ def param_specs(cfg: LlamaConfig) -> Params:
         "w_up": P(None, "tp"),
         "w_down": P("tp", None),
     }
+    if cfg.attn_bias:
+        # Column-parallel biases follow their projection's out axis.
+        layer.update({"bq": P("tp"), "bk": P("tp"), "bv": P("tp")})
     return {
         "embed": P("tp", None),  # vocab-sharded table
         "layers": [dict(layer) for _ in range(cfg.n_layers)],
@@ -176,6 +190,27 @@ def wmat(w, dt) -> jax.Array:
     if isinstance(w, dict):
         return w["q"].astype(dt) * w["s"].astype(dt)[None, :]
     return w.astype(dt)
+
+def qkv_proj(
+    h: jax.Array, layer: Params, cfg: LlamaConfig, dt
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """q/k/v projections with optional attention biases (Qwen2-style).
+    h: [B, S, d_model] -> q [B,S,H,hd], k/v [B,S,KV,hd]."""
+    b, s, _ = h.shape
+    hd = cfg.head_dim
+    q = h @ wmat(layer["wq"], dt)
+    k = h @ wmat(layer["wk"], dt)
+    v = h @ wmat(layer["wv"], dt)
+    if "bq" in layer:
+        q = q + layer["bq"].astype(dt)
+        k = k + layer["bk"].astype(dt)
+        v = v + layer["bv"].astype(dt)
+    return (
+        q.reshape(b, s, cfg.n_heads, hd),
+        k.reshape(b, s, cfg.n_kv_heads, hd),
+        v.reshape(b, s, cfg.n_kv_heads, hd),
+    )
+
 
 def rms_norm(x: jax.Array, w: jax.Array, eps: float) -> jax.Array:
     x32 = x.astype(jnp.float32)
@@ -227,17 +262,23 @@ def _repeat_kv(x: jax.Array, n_rep: int) -> jax.Array:
     return jnp.broadcast_to(x[:, :, :, None, :], (b, s, kv, n_rep, d)).reshape(b, s, kv * n_rep, d)
 
 
-def causal_attention(q: jax.Array, k: jax.Array, v: jax.Array, q_off: jax.Array | int = 0) -> jax.Array:
+def causal_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array, q_off: jax.Array | int = 0, window: int = 0
+) -> jax.Array:
     """Plain causal attention — the readable O(S²)-memory reference oracle
     that the fused paths are parity-tested against (tests/test_llama.py).
     q: [B,Sq,H,D], k/v: [B,Sk,H,D] (already GQA-repeated). ``q_off`` is the
-    global position of q[0] relative to k[0] (for cached decode). Returns
+    global position of q[0] relative to k[0] (for cached decode); ``window``
+    > 0 restricts each query to the last ``window`` positions (sliding-window
+    attention, Mistral semantics: keep iff q_pos − k_pos < window). Returns
     [B,Sq,H,D]."""
     scale = q.shape[-1] ** -0.5
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
     q_pos = jnp.arange(q.shape[1]) + q_off
     k_pos = jnp.arange(k.shape[1])
     mask = q_pos[:, None] >= k_pos[None, :]
+    if window:
+        mask &= (q_pos[:, None] - k_pos[None, :]) < window
     scores = jnp.where(mask[None, None], scores, _NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
@@ -250,6 +291,7 @@ def ring_attention_local(
     axis_name: str,
     n_chunks: int,
     key_block: int = 2048,
+    window: int = 0,
 ) -> jax.Array:
     """Ring attention body — runs *inside* shard_map, sequence sharded over
     ``axis_name``. Each step attends the local queries against the currently
@@ -293,7 +335,10 @@ def ring_attention_local(
             v_sub = jax.lax.slice_in_dim(v_cur, j, j + jb, axis=1)
             k_pos = src * s_l + j + jnp.arange(jb)
             scores = jnp.einsum("bqgrd,bkgd->bgrqk", q5, k_sub).astype(jnp.float32) * scale
-            mask = (q_pos[:, None] >= k_pos[None, :])[None, None, None]
+            keep2d = q_pos[:, None] >= k_pos[None, :]
+            if window:
+                keep2d &= (q_pos[:, None] - k_pos[None, :]) < window
+            mask = keep2d[None, None, None]
             scores = jnp.where(mask, scores, _NEG_INF)
 
             blk_max = jnp.max(scores, axis=-1)
@@ -335,9 +380,7 @@ def _attention_block(
     hd = cfg.head_dim
     dt = x.dtype
 
-    q = (x @ wmat(layer["wq"], dt)).reshape(b, s, cfg.n_heads, hd)
-    k = (x @ wmat(layer["wk"], dt)).reshape(b, s, cfg.n_kv_heads, hd)
-    v = (x @ wmat(layer["wv"], dt)).reshape(b, s, cfg.n_kv_heads, hd)
+    q, k, v = qkv_proj(x, layer, cfg, dt)
 
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
@@ -353,7 +396,12 @@ def _attention_block(
             v = _repeat_kv(v, cfg.n_heads // cfg.n_kv_heads)
         spec = P("dp", cp_axis, tp, None)
         attn = jax.shard_map(
-            partial(ring_attention_local, axis_name=cp_axis, n_chunks=n_cp),
+            partial(
+                ring_attention_local,
+                axis_name=cp_axis,
+                n_chunks=n_cp,
+                window=cfg.sliding_window,
+            ),
             mesh=mesh,
             in_specs=(spec, spec, spec),
             out_specs=spec,
@@ -364,7 +412,10 @@ def _attention_block(
         # GQA repeat, differentiable XLA path (training runs through here).
         from kakveda_tpu.models.attention import _gqa_xla
 
-        attn = _gqa_xla(q, k.transpose(0, 2, 1, 3), v.transpose(0, 2, 1, 3), 0, None)
+        attn = _gqa_xla(
+            q, k.transpose(0, 2, 1, 3), v.transpose(0, 2, 1, 3), 0, None,
+            window=cfg.sliding_window,
+        )
 
     return attn.reshape(b, s, cfg.n_heads * hd) @ wmat(layer["wo"], dt)
 
@@ -471,9 +522,7 @@ def decode_step(
     for li, layer in enumerate(params["layers"]):
         h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
         dt = h.dtype
-        q = (h @ wmat(layer["wq"], dt)).reshape(b, s, cfg.n_heads, hd)
-        k = (h @ wmat(layer["wk"], dt)).reshape(b, s, cfg.n_kv_heads, hd)
-        v = (h @ wmat(layer["wv"], dt)).reshape(b, s, cfg.n_kv_heads, hd)
+        q, k, v = qkv_proj(h, layer, cfg, dt)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
 
@@ -490,7 +539,7 @@ def decode_step(
         # Fused cached attention: Pallas flash on TPU, grouped XLA einsum
         # elsewhere — either way K/V are read once, not n_rep times, and
         # the causal mask (q_pos >= slot) also excludes unwritten slots.
-        attn = gqa_cache_attention(q, k_all, v_all, pos0, kv_valid)
+        attn = gqa_cache_attention(q, k_all, v_all, pos0, kv_valid, window=cfg.sliding_window)
         x = x + attn.reshape(b, s, cfg.n_heads * hd) @ wmat(layer["wo"], dt)
 
         h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)

@@ -103,7 +103,14 @@ def _step_chunk_jit(params, cfg: LlamaConfig, cache, last, slot_pos, kv_valid, p
     shared across slots — rows are independent draws of the same key).
     """
     from kakveda_tpu.models.attention import gqa_cache_attention
-    from kakveda_tpu.models.llama import _mlp_block, _rope_freqs, apply_rope, rms_norm, wmat
+    from kakveda_tpu.models.llama import (
+        _mlp_block,
+        _rope_freqs,
+        apply_rope,
+        qkv_proj,
+        rms_norm,
+        wmat,
+    )
 
     b = last.shape[0]
     hd = cfg.head_dim
@@ -122,15 +129,17 @@ def _step_chunk_jit(params, cfg: LlamaConfig, cache, last, slot_pos, kv_valid, p
         x = params["embed"].astype(cfg.dtype)[tokens]
         new_k, new_v = [], []
         # Validity for reads this step: slots < own write index, plus self.
+        # A sliding window (Mistral) folds in here — the query's slot index
+        # IS slot_pos[b], so the band is (slot_pos − window, slot_pos].
         col = jnp.arange(max_len)[None, :]
         step_valid = kv_valid & (col <= slot_pos[:, None])
+        if cfg.sliding_window:
+            step_valid &= col > (slot_pos[:, None] - cfg.sliding_window)
         for li in range(cfg.n_layers):
             layer = params["layers"][li]
             h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
             dt = h.dtype
-            q = (h @ wmat(layer["wq"], dt)).reshape(b, 1, cfg.n_heads, hd)
-            k = (h @ wmat(layer["wk"], dt)).reshape(b, 1, cfg.n_kv_heads, hd)
-            v = (h @ wmat(layer["wv"], dt)).reshape(b, 1, cfg.n_kv_heads, hd)
+            q, k, v = qkv_proj(h, layer, cfg, dt)
             q = apply_rope(q, cos, sin)
             k = apply_rope(k, cos, sin)
             # Per-slot scatter: k[b] -> cache_k[li][b, :, slot_pos[b]] —

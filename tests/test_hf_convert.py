@@ -152,9 +152,110 @@ def test_runtime_from_hf_end_to_end(tmp_path):
     assert len(batch) == 2
 
 
-def test_rejects_non_llama_and_unknown_scaling(tmp_path):
+def _make_qwen2_checkpoint(path, *, vocab=256, seed=0):
+    hf_cfg = transformers.Qwen2Config(
+        vocab_size=vocab,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=128,
+        rms_norm_eps=1e-5,
+        rope_theta=10000.0,
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(seed)
+    model = transformers.Qwen2ForCausalLM(hf_cfg).eval()
+    # transformers zero-inits Linear biases; randomize them so parity
+    # genuinely exercises the bias path.
+    with torch.no_grad():
+        for lyr in model.model.layers:
+            for proj in (lyr.self_attn.q_proj, lyr.self_attn.k_proj, lyr.self_attn.v_proj):
+                proj.bias.normal_(0.0, 0.5)
+    model.save_pretrained(str(path), safe_serialization=True)
+    return model
+
+
+def _make_mistral_checkpoint(path, *, vocab=256, sliding_window=None, seed=0):
+    hf_cfg = transformers.MistralConfig(
+        vocab_size=vocab,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=128,
+        rms_norm_eps=1e-5,
+        rope_theta=10000.0,
+        sliding_window=sliding_window,
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(seed)
+    model = transformers.MistralForCausalLM(hf_cfg).eval()
+    model.save_pretrained(str(path), safe_serialization=True)
+    return model
+
+
+def test_logit_parity_qwen2_attention_bias(tmp_path):
+    # Qwen2 hardcodes q/k/v projection biases (no config flag) — random-init
+    # HF biases are nonzero, so parity here proves the bias path end to end.
+    model = _make_qwen2_checkpoint(tmp_path, seed=6)
+    params, cfg = _assert_parity(model, tmp_path, vocab=256)
+    assert cfg.attn_bias
+    assert float(np.abs(np.asarray(params["layers"][0]["bq"])).sum()) > 0
+
+
+def test_logit_parity_mistral_sliding_window(tmp_path):
+    # window=8 over a 17-token sequence: positions past the window genuinely
+    # change the mask, so parity proves the sliding-window semantics match
+    # HF's (keep iff q_pos − k_pos < window).
+    model = _make_mistral_checkpoint(tmp_path, sliding_window=8, seed=7)
+    params, cfg = _assert_parity(model, tmp_path, vocab=256)
+    assert cfg.sliding_window == 8
+
+    # And the windowed mask must differ from full causal — guard against a
+    # silently ignored window (parity would still pass if HF ignored it too).
+    import dataclasses
+
+    full = dataclasses.replace(cfg, sliding_window=0)
+    ids = np.random.default_rng(3).integers(0, 256, size=(1, 17), dtype=np.int64)
+    ours_win = np.asarray(forward(params, cfg, jnp.asarray(ids)))
+    ours_full = np.asarray(forward(params, full, jnp.asarray(ids)))
+    assert np.abs(ours_win - ours_full).max() > 1e-3
+
+
+def test_mistral_decode_cache_matches_full_forward(tmp_path):
+    # The cached decode path applies the window in slot space (offsets
+    # cancel); greedy parity with the parity-tested full forward proves it.
+    _make_mistral_checkpoint(tmp_path, sliding_window=8, seed=8)
+    params, cfg = load_hf_checkpoint(str(tmp_path), param_dtype=jnp.float32)
+    prompt = list(range(5, 25))
+    greedy_cached = generate_tokens(params, cfg, prompt, max_new_tokens=8)
+
+    toks = list(prompt)
+    for _ in range(8):
+        logits = forward(params, cfg, jnp.asarray([toks]))
+        toks.append(int(jnp.argmax(logits[0, -1])))
+    assert greedy_cached == toks[len(prompt) :]
+
+
+def test_qwen2_decode_cache_matches_full_forward(tmp_path):
+    _make_qwen2_checkpoint(tmp_path, seed=9)
+    params, cfg = load_hf_checkpoint(str(tmp_path), param_dtype=jnp.float32)
+    prompt = list(range(3, 17))
+    greedy_cached = generate_tokens(params, cfg, prompt, max_new_tokens=8)
+
+    toks = list(prompt)
+    for _ in range(8):
+        logits = forward(params, cfg, jnp.asarray([toks]))
+        toks.append(int(jnp.argmax(logits[0, -1])))
+    assert greedy_cached == toks[len(prompt) :]
+
+
+def test_rejects_unknown_family_and_unknown_scaling(tmp_path):
     with pytest.raises(ValueError, match="model_type"):
-        hf_config_to_llama({"model_type": "mistral", "vocab_size": 8})
+        hf_config_to_llama({"model_type": "gpt2", "vocab_size": 8})
     with pytest.raises(ValueError, match="rope_scaling"):
         hf_config_to_llama(
             {
