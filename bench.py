@@ -591,20 +591,40 @@ def _measure_mine(n: int, dim: int, n_templates: int) -> dict:
             f"variant {t} {tails[t % len(tails)]} item {rng.integers(0, 9)}"
         )
         texts.append(signature_text(text, [], {"os": "linux"}))
-    t0 = time.perf_counter()
-    vecs = np.empty((n, dim), np.float32)
-    enc_chunk = 1 << 14
-    for s in range(0, n, enc_chunk):
-        vecs[s : s + enc_chunk] = feat.encode_batch(texts[s : s + enc_chunk])
-    t_embed = time.perf_counter() - t0
-    print(f"bench[mine]: embedded {n:,} texts in {t_embed:.1f}s", file=sys.stderr, flush=True)
+    # Embed + ship sparse (idx, val) pairs and densify ON DEVICE — the
+    # dense [N, dim] form is ~98% zeros and shipping it over the tunneled
+    # TPU's ~20 MB/s link took 4+ minutes at 1M rows (long enough to trip
+    # backend timeouts); the sparse pairs are ~60× smaller. Untimed vs
+    # mining: production embeddings already live in HBM.
+    from functools import partial as _partial
 
-    # Ship once (untimed vs mining: production embeddings already live in
-    # HBM; mining gathers them device-side).
+    @_partial(jax.jit, donate_argnums=(0,))
+    def _scatter_chunk(buf, idx, val, row0):
+        b, k = idx.shape
+        rows = jnp.broadcast_to(jnp.arange(b)[:, None], (b, k))
+        chunk = jnp.zeros((b, dim + 1), jnp.float32).at[rows, idx].add(val)[:, :dim]
+        return jax.lax.dynamic_update_slice(buf, chunk, (row0, 0))
+
     t0 = time.perf_counter()
-    v_dev = jax.device_put(jnp.asarray(vecs))
+    enc_chunk = 1 << 14
+    n_pad = -(-n // enc_chunk) * enc_chunk  # buffer padded so the tail
+    v_dev = jnp.zeros((n_pad, dim), jnp.float32)  # chunk never clamps
+    t_embed = 0.0
+    for s in range(0, n, enc_chunk):
+        te = time.perf_counter()
+        idx, val = feat.encode_batch_sparse(texts[s : s + enc_chunk])
+        t_embed += time.perf_counter() - te
+        if idx.shape[0] < enc_chunk:  # pad tail to the compiled shape
+            pad = enc_chunk - idx.shape[0]
+            idx = np.concatenate([idx, np.full((pad, idx.shape[1]), dim, np.int32)])
+            val = np.concatenate([val, np.zeros((pad, val.shape[1]), np.float32)])
+        v_dev = _scatter_chunk(v_dev, idx, val, jnp.asarray(s, jnp.int32))
+    if n_pad != n:
+        v_dev = v_dev[:n]
     jax.block_until_ready(v_dev)
-    print(f"bench[mine]: device upload took {time.perf_counter() - t0:.1f}s", file=sys.stderr, flush=True)
+    t_ship = time.perf_counter() - t0 - t_embed
+    print(f"bench[mine]: embedded {n:,} texts in {t_embed:.1f}s", file=sys.stderr, flush=True)
+    print(f"bench[mine]: sparse device upload took {t_ship:.1f}s", file=sys.stderr, flush=True)
 
     t0 = time.perf_counter()
     labels = cluster_embeddings(v_dev, threshold=0.6)
@@ -868,6 +888,16 @@ def main() -> int:
     import threading
 
     import jax
+
+    # Honor JAX_PLATFORMS=cpu explicitly: this image's sitecustomize pins
+    # jax to the remote accelerator via jax.config, which the env var alone
+    # does not override — without this a "CPU" bench run would still claim
+    # (or block on) the device lease.
+    if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
 
     # Backend-init watchdog: a wedged accelerator lease (e.g. a killed
     # process still holding the remote chip) blocks jax.default_backend()

@@ -50,16 +50,17 @@ __all__ = ["hf_config_to_llama", "load_hf_checkpoint", "shard_params"]
 _VOCAB_MULTIPLE = 8
 
 
-_SUPPORTED_FAMILIES = ("llama", "mistral", "qwen2")
+_SUPPORTED_FAMILIES = ("llama", "mistral", "qwen2", "mixtral")
 
 
 def hf_config_to_llama(hf: Dict[str, Any], *, dtype=jnp.bfloat16) -> LlamaConfig:
     """Map an HF ``config.json`` dict to :class:`LlamaConfig`.
 
-    Three HF families share the Llama block structure and load onto the one
+    Four HF families share the Llama block structure and load onto the one
     runtime: ``llama`` (the baseline), ``mistral`` (adds a sliding attention
-    window and sometimes an explicit head_dim), and ``qwen2`` (adds q/k/v
-    projection biases). Anything else is rejected loudly."""
+    window and sometimes an explicit head_dim), ``qwen2`` (adds q/k/v
+    projection biases), and ``mixtral`` (replaces the dense MLP with a
+    sparse MoE block — models/moe.py). Anything else is rejected loudly."""
     family = hf.get("model_type") or "llama"
     if family not in _SUPPORTED_FAMILIES:
         raise ValueError(
@@ -105,9 +106,17 @@ def hf_config_to_llama(hf: Dict[str, Any], *, dtype=jnp.bfloat16) -> LlamaConfig
     if head_dim and head_dim * n_heads == int(hf["hidden_size"]):
         head_dim = 0  # derived value; keep the config canonical
 
+    moe_kw: Dict[str, Any] = {}
+    if family == "mixtral":
+        moe_kw = dict(
+            n_experts=int(hf["num_local_experts"]),
+            n_experts_per_tok=int(hf.get("num_experts_per_tok", 2)),
+        )
+
     vocab = int(hf["vocab_size"])
     padded = -(-vocab // _VOCAB_MULTIPLE) * _VOCAB_MULTIPLE
     return LlamaConfig(
+        **moe_kw,
         vocab_size=padded,
         effective_vocab=vocab if padded != vocab else None,
         d_model=int(hf["hidden_size"]),
@@ -183,7 +192,11 @@ def _torch():
 
 
 def _empty_tree(cfg: LlamaConfig) -> Params:
-    keys = ["attn_norm", "wq", "wk", "wv", "wo", "mlp_norm", "w_gate", "w_up", "w_down"]
+    keys = ["attn_norm", "wq", "wk", "wv", "wo", "mlp_norm"]
+    if cfg.n_experts:
+        keys += ["router", "we_gate", "we_up", "we_down"]
+    else:
+        keys += ["w_gate", "w_up", "w_down"]
     if cfg.attn_bias:
         keys += ["bq", "bk", "bv"]
     return {
@@ -220,10 +233,20 @@ def load_hf_checkpoint(
 
     params = _empty_tree(cfg)
     seen = set()
+    # Mixtral expert tensors arrive one (layer, expert, projection) at a
+    # time; stage them (already cast to param_dtype) and stack per layer
+    # at the end into the [E, ...] arrays the MoE block wants.
+    staged: Dict[Tuple[int, str], list] = {}
 
     def put(slot: Dict[str, Any] | Params, key: str, arr: np.ndarray, *, transpose: bool) -> None:
         a = arr.T if transpose else arr
         slot[key] = jnp.asarray(a).astype(param_dtype)
+
+    def stage_expert(li: int, key: str, ei: int, arr: np.ndarray, *, transpose: bool) -> None:
+        lst = staged.setdefault((li, key), [None] * cfg.n_experts)
+        if not 0 <= ei < cfg.n_experts:
+            raise ValueError(f"expert index {ei} out of range (n_experts={cfg.n_experts})")
+        lst[ei] = jnp.asarray(arr.T if transpose else arr).astype(param_dtype)
 
     for name, arr in _tensor_reader(path)():
         seen.add(name)
@@ -264,12 +287,28 @@ def load_hf_checkpoint(
                     put(layer, "w_down", arr, transpose=True)
                 case "self_attn.rotary_emb.inv_freq":
                     pass  # derived, not a parameter
+                case "block_sparse_moe.gate.weight":
+                    put(layer, "router", arr, transpose=True)
+                case _ if rest.startswith("block_sparse_moe.experts."):
+                    # experts.{i}.w1|w2|w3.weight — w1=gate, w2=down, w3=up
+                    parts = rest.split(".")
+                    ei, proj = int(parts[2]), parts[3]
+                    key = {"w1": "we_gate", "w2": "we_down", "w3": "we_up"}.get(proj)
+                    if key is None or parts[4:] != ["weight"]:
+                        raise ValueError(f"unrecognized expert tensor: {name}")
+                    stage_expert(int(idx), key, ei, arr, transpose=True)
                 case _:
                     raise ValueError(f"unrecognized layer tensor: {name}")
         elif name.endswith("rotary_emb.inv_freq"):
             pass
         else:
             raise ValueError(f"unrecognized tensor: {name}")
+
+    for (li, key), lst in staged.items():
+        holes = [i for i, a in enumerate(lst) if a is None]
+        if holes:
+            raise ValueError(f"layer {li} {key}: missing experts {holes[:8]}")
+        params["layers"][li][key] = jnp.stack(lst)
 
     if params["lm_head"] is None:
         if not hf_cfg.get("tie_word_embeddings", False):
@@ -292,10 +331,10 @@ def shard_params(params: Params, cfg: LlamaConfig, mesh) -> Params:
     (llama.param_specs_like — also places int8 weight-only trees)."""
     from jax.sharding import NamedSharding
 
-    from kakveda_tpu.models.llama import param_specs_like
+    from kakveda_tpu.models.llama import param_specs_like, specs_for_mesh
     from kakveda_tpu.parallel.distributed import put_global
 
-    specs = param_specs_like(params, cfg)
+    specs = specs_for_mesh(param_specs_like(params, cfg), mesh)
     return jax.tree.map(
         lambda x, s: put_global(x, NamedSharding(mesh, s)),
         params,

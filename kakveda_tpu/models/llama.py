@@ -70,6 +70,13 @@ class LlamaConfig:
     attn_bias: bool = False
     sliding_window: int = 0
     head_dim_opt: int = 0  # 0 = derive from d_model // n_heads
+    # Sparse Mixture-of-Experts MLP (Mixtral family; models/moe.py).
+    # n_experts == 0 means dense. expert_capacity_factor <= 0 means no-drop
+    # dispatch (exact; decode + parity tests); positive caps each expert at
+    # ceil(T·k/E·factor) tokens per dispatch (training discipline).
+    n_experts: int = 0
+    n_experts_per_tok: int = 2
+    expert_capacity_factor: float = 0.0
 
     @property
     def head_dim(self) -> int:
@@ -116,10 +123,17 @@ def init_params(rng: jax.Array, cfg: LlamaConfig) -> Params:
             "wv": dense(k[2], cfg.d_model, (cfg.d_model, cfg.n_kv_heads * hd)),
             "wo": dense(k[3], cfg.n_heads * hd, (cfg.n_heads * hd, cfg.d_model)),
             "mlp_norm": jnp.ones((cfg.d_model,), jnp.float32),
-            "w_gate": dense(k[4], cfg.d_model, (cfg.d_model, cfg.d_ff)),
-            "w_up": dense(k[5], cfg.d_model, (cfg.d_model, cfg.d_ff)),
-            "w_down": dense(k[6], cfg.d_ff, (cfg.d_ff, cfg.d_model)),
         }
+        if cfg.n_experts:
+            ke = jax.random.split(k[4], 3)
+            layer["router"] = dense(k[5], cfg.d_model, (cfg.d_model, cfg.n_experts))
+            layer["we_gate"] = dense(ke[0], cfg.d_model, (cfg.n_experts, cfg.d_model, cfg.d_ff))
+            layer["we_up"] = dense(ke[1], cfg.d_model, (cfg.n_experts, cfg.d_model, cfg.d_ff))
+            layer["we_down"] = dense(ke[2], cfg.d_ff, (cfg.n_experts, cfg.d_ff, cfg.d_model))
+        else:
+            layer["w_gate"] = dense(k[4], cfg.d_model, (cfg.d_model, cfg.d_ff))
+            layer["w_up"] = dense(k[5], cfg.d_model, (cfg.d_model, cfg.d_ff))
+            layer["w_down"] = dense(k[6], cfg.d_ff, (cfg.d_ff, cfg.d_model))
         if cfg.attn_bias:
             layer["bq"] = jnp.zeros((cfg.n_heads * hd,), jnp.float32)
             layer["bk"] = jnp.zeros((cfg.n_kv_heads * hd,), jnp.float32)
@@ -142,10 +156,21 @@ def param_specs(cfg: LlamaConfig) -> Params:
         "wv": P(None, "tp"),
         "wo": P("tp", None),
         "mlp_norm": P(),
-        "w_gate": P(None, "tp"),
-        "w_up": P(None, "tp"),
-        "w_down": P("tp", None),
     }
+    if cfg.n_experts:
+        # Expert parallelism over ``ep`` on the stacked-expert axis,
+        # composing with TP over the ffn width; the router is tiny and
+        # replicated.
+        layer.update(
+            {
+                "router": P(),
+                "we_gate": P("ep", None, "tp"),
+                "we_up": P("ep", None, "tp"),
+                "we_down": P("ep", "tp", None),
+            }
+        )
+    else:
+        layer.update({"w_gate": P(None, "tp"), "w_up": P(None, "tp"), "w_down": P("tp", None)})
     if cfg.attn_bias:
         # Column-parallel biases follow their projection's out axis.
         layer.update({"bq": P("tp"), "bk": P("tp"), "bv": P("tp")})
@@ -157,6 +182,18 @@ def param_specs(cfg: LlamaConfig) -> Params:
     }
 
 
+def specs_for_mesh(specs, mesh: Mesh):
+    """Drop spec axes the mesh doesn't have (→ replicated on that dim):
+    a MoE spec's ``ep`` axis on a dp×tp serving mesh, or ``tp`` on a pure-dp
+    mesh, degrades to replication instead of erroring."""
+    names = set(mesh.axis_names)
+
+    def fix(s):
+        return P(*(a if a in names else None for a in s))
+
+    return jax.tree.map(fix, specs, is_leaf=lambda x: isinstance(x, P))
+
+
 def _is_quant_leaf(x) -> bool:
     return isinstance(x, dict) and set(x.keys()) == {"q", "s"}
 
@@ -164,13 +201,15 @@ def _is_quant_leaf(x) -> bool:
 def param_specs_like(params: Params, cfg: LlamaConfig) -> Params:
     """Spec tree matching ``params``' structure — handles int8 weight-only
     leaves (models/quant.py): the int8 matrix shards like the original
-    weight and the per-output-channel scale follows the OUT axis's placement
-    (sharded for column-parallel projections, replicated for row-parallel)."""
+    weight and the per-output-channel scale drops the contraction (in) axis
+    — sharded for column-parallel projections, replicated for row-parallel,
+    and keeping the leading ``ep`` axis for stacked MoE experts."""
     base = param_specs(cfg)
 
     def expand(w, spec):
         if _is_quant_leaf(w):
-            return {"q": spec, "s": P(spec[1] if len(spec) > 1 else None)}
+            s_spec = P(*spec[:-2], spec[-1]) if len(spec) >= 2 else P(None)
+            return {"q": spec, "s": s_spec}
         return spec
 
     return jax.tree.map(expand, params, base, is_leaf=_is_quant_leaf)
@@ -186,9 +225,10 @@ def wmat(w, dt) -> jax.Array:
     """Materialize a dense weight at compute dtype. Accepts a raw array or
     an int8 weight-only pair ``{"q", "s"}`` (models/quant.py) — the dequant
     multiply fuses into the consuming matmul, so quantized weights stream
-    from HBM at int8 width."""
+    from HBM at int8 width. Handles 2-D dense and stacked [E, in, out]
+    MoE expert weights alike (scale broadcasts over the in axis)."""
     if isinstance(w, dict):
-        return w["q"].astype(dt) * w["s"].astype(dt)[None, :]
+        return w["q"].astype(dt) * w["s"].astype(dt)[..., None, :]
     return w.astype(dt)
 
 def qkv_proj(
@@ -427,6 +467,16 @@ def _mlp_block(x: jax.Array, layer: Params) -> jax.Array:
     return (gate * up) @ wmat(layer["w_down"], dt)
 
 
+def mlp_block(x: jax.Array, layer: Params, cfg: LlamaConfig) -> jax.Array:
+    """Dense SwiGLU or sparse-MoE MLP, keyed on the layer's params
+    (MoE layers carry a ``router``; models/moe.py)."""
+    if "router" in layer:
+        from kakveda_tpu.models.moe import moe_mlp
+
+        return moe_mlp(x, layer, cfg)
+    return _mlp_block(x, layer)
+
+
 def forward(
     params: Params,
     cfg: LlamaConfig,
@@ -454,7 +504,7 @@ def forward(
         h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
         x = x + _attention_block(h, layer, cfg, cos, sin, mesh, cp_axis)
         h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
-        x = x + _mlp_block(h, layer)
+        x = x + mlp_block(h, layer, cfg)
 
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     return (x @ wmat(params["lm_head"], cfg.dtype)).astype(jnp.float32)
@@ -543,7 +593,7 @@ def decode_step(
         x = x + attn.reshape(b, s, cfg.n_heads * hd) @ wmat(layer["wo"], dt)
 
         h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
-        x = x + _mlp_block(h, layer)
+        x = x + mlp_block(h, layer, cfg)
 
     if last_only:
         x = x[:, -1:, :]

@@ -1,0 +1,127 @@
+"""Sparse Mixture-of-Experts MLP for the Llama runtime (Mixtral family).
+
+The reference's model tier is an HTTP client to an Ollama daemon
+(reference: services/dashboard/app.py:1182-1258), which is how it "supports"
+MoE checkpoints like Mixtral. Here the MoE block is a first-class layer on
+the same runtime/mesh as everything else, designed TPU-first:
+
+  * **Routing** matches HF Mixtral semantics exactly: f32 softmax over all
+    expert logits, top-k, renormalize the kept weights
+    (``transformers`` MixtralSparseMoeBlock) — parity-tested in
+    tests/test_hf_convert.py.
+  * **Dispatch** is sort-based with a static per-expert capacity: the
+    [T·k] (token, choice) assignments are argsorted by expert, each lands
+    in slot ``expert·cap + position_in_expert``, and tokens beyond an
+    expert's capacity are dropped (GShard discipline, position-priority).
+    Everything is static-shaped — no ragged tensors, no data-dependent
+    control flow — so the whole block jits and differentiates.
+  * **Compute** is one batched einsum per projection over the stacked
+    expert weights ``[E, d_model, d_ff]`` — E MXU matmuls batched on the
+    leading axis, not a Python loop over experts.
+  * **Expert parallelism**: the stacked-E leading axis is the ``ep`` mesh
+    axis (llama.param_specs), composing with tensor parallelism over the
+    ffn width (``we_gate [E, D, F]`` shards P("ep", None, "tp")). XLA
+    partitions the batched einsums over both axes and inserts the
+    dispatch/combine collectives from the shardings.
+
+Capacity: ``cfg.expert_capacity_factor <= 0`` means no-drop (capacity = T,
+exact — what parity tests and decode steps use; decode T is the batch
+size, so the buffer stays small). A positive factor caps each expert at
+``ceil(T·k/E · factor)`` tokens, the standard training configuration.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from kakveda_tpu.models.llama import LlamaConfig, Params, wmat
+
+
+def router_topk(logits: jax.Array, k: int) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """HF-Mixtral routing: softmax over ALL experts in f32, take top-k,
+    renormalize the kept mass. Returns (weights [T,k], expert_idx [T,k],
+    full_probs [T,E] — the latter feeds the load-balancing loss)."""
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    w, idx = jax.lax.top_k(probs, k)
+    w = w / jnp.maximum(jnp.sum(w, axis=-1, keepdims=True), 1e-20)
+    return w, idx, probs
+
+
+def expert_capacity(n_tokens: int, cfg: LlamaConfig) -> int:
+    """Static per-expert token capacity for a T-token dispatch."""
+    f = cfg.expert_capacity_factor
+    if f <= 0.0:
+        return n_tokens
+    k, e = cfg.n_experts_per_tok, cfg.n_experts
+    return min(n_tokens, max(1, math.ceil(n_tokens * k / e * f)))
+
+
+def moe_mlp(x: jax.Array, layer: Params, cfg: LlamaConfig) -> jax.Array:
+    """Sparse-MoE SwiGLU MLP: x [B, S, D] -> [B, S, D].
+
+    Layer params: ``router`` [D, E], stacked ``we_gate``/``we_up``
+    [E, D, F], ``we_down`` [E, F, D] (llama.init_params / Mixtral
+    conversion in models/hf_convert.py).
+    """
+    b, s, d = x.shape
+    dt = x.dtype
+    e, k = cfg.n_experts, cfg.n_experts_per_tok
+    t = b * s
+    xf = x.reshape(t, d)
+
+    logits = xf.astype(jnp.float32) @ layer["router"].astype(jnp.float32)
+    w, idx, _ = router_topk(logits, k)  # [T, k]
+
+    cap = expert_capacity(t, cfg)
+
+    # Flatten (token, choice) assignments and sort by expert. Stable sort
+    # keeps token order within an expert => position-priority capacity drop.
+    e_flat = idx.reshape(t * k)
+    w_flat = w.reshape(t * k)
+    tok_flat = jnp.arange(t * k, dtype=jnp.int32) // k
+    order = jnp.argsort(e_flat, stable=True)
+    e_sorted = e_flat[order]
+    tok_sorted = tok_flat[order]
+
+    # Position within the expert's group: running index minus the group's
+    # start offset (exclusive cumsum of per-expert counts).
+    counts = jnp.zeros((e,), jnp.int32).at[e_sorted].add(1)
+    starts = jnp.concatenate([jnp.zeros((1,), jnp.int32), jnp.cumsum(counts)[:-1]])
+    pos = jnp.arange(t * k, dtype=jnp.int32) - starts[e_sorted]
+
+    # Slot in the [E·cap] dispatch buffer; over-capacity rows drop.
+    slot = e_sorted * cap + pos
+    keep = pos < cap
+    slot = jnp.where(keep, slot, e * cap)  # out-of-range => .at[].set drop
+
+    buf = jnp.zeros((e * cap, d), dt).at[slot, :].set(xf[tok_sorted], mode="drop")
+    xe = buf.reshape(e, cap, d)
+
+    # Batched expert SwiGLU on the MXU; E axis shards over ``ep``,
+    # F over ``tp``.
+    gate = jax.nn.silu(jnp.einsum("ecd,edf->ecf", xe, wmat(layer["we_gate"], dt)))
+    up = jnp.einsum("ecd,edf->ecf", xe, wmat(layer["we_up"], dt))
+    ye = jnp.einsum("ecf,efd->ecd", gate * up, wmat(layer["we_down"], dt))
+
+    # Combine: read each assignment's expert output back from its slot and
+    # scatter-add the routing-weighted result into the token rows.
+    y_rows = ye.reshape(e * cap, d)[jnp.minimum(slot, e * cap - 1)]
+    contrib = y_rows * (w_flat[order] * keep.astype(jnp.float32))[:, None].astype(dt)
+    out = jnp.zeros((t, d), dt).at[tok_sorted, :].add(contrib)
+    return out.reshape(b, s, d)
+
+
+def load_balancing_loss(router_probs: jax.Array, expert_idx: jax.Array, n_experts: int) -> jax.Array:
+    """Switch/Mixtral auxiliary load-balancing loss: E · Σ_e f_e · P_e,
+    where f_e is the fraction of (token, choice) assignments routed to
+    expert e and P_e the mean router probability of e. Minimized (=1) by
+    uniform routing; add ``coef · loss`` to the LM loss when fine-tuning a
+    MoE config (HF ``router_aux_loss_coef``)."""
+    probs = router_probs.reshape(-1, n_experts)
+    idx = expert_idx.reshape(-1)
+    f = jnp.zeros((n_experts,), jnp.float32).at[idx].add(1.0) / jnp.maximum(idx.size, 1)
+    p = jnp.mean(probs, axis=0)
+    return n_experts * jnp.sum(f * p)

@@ -27,21 +27,26 @@ from typing import Any, Dict
 import jax
 import jax.numpy as jnp
 
-_DENSE_KEYS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+_DENSE_KEYS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down", "we_gate", "we_up", "we_down")
 
 
 def quantize_tensor_int8(w: jax.Array) -> Dict[str, jax.Array]:
     """Symmetric per-output-channel (last axis) int8: q = round(w / s),
-    s = absmax / 127 per column."""
+    s = absmax / 127 per output column. Works for 2-D dense weights
+    ([in, out] → s [out]) and stacked MoE expert weights
+    ([E, in, out] → s [E, out]) alike — the reduction is over the
+    contraction (in) axis."""
     w32 = w.astype(jnp.float32)
-    s = jnp.max(jnp.abs(w32), axis=0) / 127.0
+    s = jnp.max(jnp.abs(w32), axis=-2) / 127.0
     s = jnp.where(s == 0.0, 1.0, s)
-    q = jnp.clip(jnp.round(w32 / s[None, :]), -127, 127).astype(jnp.int8)
+    q = jnp.clip(jnp.round(w32 / s[..., None, :]), -127, 127).astype(jnp.int8)
     return {"q": q, "s": s}
 
 
 def quantize_params_int8(params: Dict[str, Any]) -> Dict[str, Any]:
-    """Quantize every dense projection + lm_head; keep norms/embedding."""
+    """Quantize every dense projection (incl. stacked MoE experts — on
+    Mixtral the expert FFNs are ~95% of weight bytes) + lm_head; keep
+    norms, biases, the MoE router and the embedding."""
     out: Dict[str, Any] = {
         "embed": params["embed"],
         "final_norm": params["final_norm"],
@@ -57,13 +62,15 @@ def quantize_params_int8(params: Dict[str, Any]) -> Dict[str, Any]:
 
 
 def quantization_error(params: Dict[str, Any], qparams: Dict[str, Any]) -> float:
-    """Max relative per-column reconstruction error across dense weights
+    """Max relative per-tensor reconstruction error across dense weights
     (test/diagnostic helper)."""
     worst = 0.0
     for orig, quant in zip(params["layers"], qparams["layers"]):
         for k in _DENSE_KEYS:
+            if k not in orig:
+                continue
             w = orig[k].astype(jnp.float32)
-            wq = quant[k]["q"].astype(jnp.float32) * quant[k]["s"][None, :]
+            wq = quant[k]["q"].astype(jnp.float32) * quant[k]["s"][..., None, :]
             num = jnp.max(jnp.abs(w - wq))
             den = jnp.max(jnp.abs(w))
             worst = max(worst, float(num / den))

@@ -104,9 +104,9 @@ def _step_chunk_jit(params, cfg: LlamaConfig, cache, last, slot_pos, kv_valid, p
     """
     from kakveda_tpu.models.attention import gqa_cache_attention
     from kakveda_tpu.models.llama import (
-        _mlp_block,
         _rope_freqs,
         apply_rope,
+        mlp_block,
         qkv_proj,
         rms_norm,
         wmat,
@@ -157,7 +157,7 @@ def _step_chunk_jit(params, cfg: LlamaConfig, cache, last, slot_pos, kv_valid, p
             attn = gqa_cache_attention(q, k_all, v_all, jnp.asarray(max_len), step_valid)
             x = x + attn.reshape(b, 1, cfg.n_heads * hd) @ wmat(layer["wo"], dt)
             h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
-            x = x + _mlp_block(h, layer)
+            x = x + mlp_block(h, layer, cfg)
         x = rms_norm(x, params["final_norm"], cfg.norm_eps)
         logits = (x @ wmat(params["lm_head"], cfg.dtype)).astype(jnp.float32)[:, -1, :]
         if cfg.effective_vocab is not None:

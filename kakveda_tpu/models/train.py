@@ -25,7 +25,14 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from kakveda_tpu.models.llama import LlamaConfig, Params, forward, init_params, param_specs
+from kakveda_tpu.models.llama import (
+    LlamaConfig,
+    Params,
+    forward,
+    init_params,
+    param_specs,
+    specs_for_mesh,
+)
 
 
 def lm_loss(
@@ -63,7 +70,7 @@ def make_train_step(cfg: LlamaConfig, opt: Optional[optax.GradientTransformation
 def shard_params(params: Params, cfg: LlamaConfig, mesh: Mesh) -> Params:
     from kakveda_tpu.parallel.distributed import put_global
 
-    specs = param_specs(cfg)
+    specs = specs_for_mesh(param_specs(cfg), mesh)
     return jax.tree.map(
         lambda x, s: put_global(x, NamedSharding(mesh, s)),
         params,
@@ -85,7 +92,7 @@ def make_sharded_train_step(
     weights never exist unsharded on one device).
     """
     opt = opt or make_optimizer()
-    specs = param_specs(cfg)
+    specs = specs_for_mesh(param_specs(cfg), mesh)
     param_shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
                                    is_leaf=lambda x: isinstance(x, P))
     batch_sharding = NamedSharding(mesh, P("dp", cp_axis if cp_axis in mesh.axis_names else None))

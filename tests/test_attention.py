@@ -109,19 +109,12 @@ def test_flash_kernel_multiblock_streaming():
 
 
 def _windowed_oracle(q, k, v, pos0, window):
-    """Sliding-window oracle: full softmax with keep iff 0 ≤ q_pos − l_pos < window."""
-    b, s, h, d = q.shape
-    kv = k.shape[1]
+    """Banded oracle = llama.causal_attention(window=) over the repeated,
+    seq-major cache (one oracle for the semantics, shared with llama.py)."""
+    h, kv = q.shape[2], k.shape[1]
     kr = _repeat_kv(k.transpose(0, 2, 1, 3), h // kv)
     vr = _repeat_kv(v.transpose(0, 2, 1, 3), h // kv)
-    l = kr.shape[1]
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, kr).astype(jnp.float32) * d**-0.5
-    q_pos = pos0 + jnp.arange(s)
-    l_pos = jnp.arange(l)
-    mask = (q_pos[:, None] >= l_pos[None, :]) & ((q_pos[:, None] - l_pos[None, :]) < window)
-    scores = jnp.where(mask[None, None], scores, -1e30)
-    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
-    return jnp.einsum("bhqk,bkhd->bqhd", probs, vr)
+    return causal_attention(q, kr, vr, q_off=pos0, window=window)
 
 
 @pytest.mark.parametrize("pos0,window", [(0, 4), (20, 8), (31, 5)])

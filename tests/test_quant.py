@@ -50,6 +50,30 @@ def test_quantized_logits_close_and_generation_runs():
     assert rt.generate("hello world", max_tokens=8).text == r.text
 
 
+def test_int8_quantizes_moe_expert_stacks():
+    """Mixtral-style trees: stacked [E, in, out] expert weights quantize
+    per-(expert, out-channel) — on MoE models they are ~95% of weight
+    bytes, so skipping them would make quant=int8 a no-op."""
+    cfg = LlamaConfig(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=48, max_seq_len=64, dtype=jnp.float32,
+        n_experts=4, n_experts_per_tok=2,
+    )
+    params = init_params(jax.random.PRNGKey(1), cfg)
+    qparams = quantize_params_int8(params)
+    qe = qparams["layers"][0]["we_gate"]
+    assert qe["q"].dtype == jnp.int8 and qe["q"].shape == (4, 32, 48)
+    assert qe["s"].shape == (4, 48)
+    assert qparams["layers"][0]["router"].dtype != jnp.int8  # router kept f32
+    assert quantization_error(params, qparams) < 0.01
+
+    toks = jnp.asarray(np.random.default_rng(1).integers(3, 60, size=(2, 12)), jnp.int32)
+    ref = np.asarray(forward(params, cfg, toks)).reshape(-1, cfg.vocab_size)
+    got = np.asarray(forward(qparams, cfg, toks)).reshape(-1, cfg.vocab_size)
+    cos = (ref * got).sum(-1) / (np.linalg.norm(ref, axis=-1) * np.linalg.norm(got, axis=-1))
+    assert cos.min() > 0.995, cos.min()
+
+
 def test_int8_tp_sharded_generation_matches_unsharded():
     """int8 + Megatron TP: the quantized tree shards (q like the weight,
     scale along the out axis) and greedy tokens match unsharded int8."""
