@@ -309,7 +309,7 @@ def _measure_decode(preset: str, bsz: int, steps: int) -> dict:
 
     rng = np.random.default_rng(0)
 
-    def timed(b: int, p: int, n_steps: int, reps: int = 3) -> float:
+    def timed(prm, b: int, p: int, n_steps: int, reps: int = 3) -> float:
         """Best-of-reps wall time of one fused generation (prefill p tokens
         + n_steps decode) at batch b. np.asarray syncs through the wire, so
         every timing carries the same fixed RTT — all derived numbers below
@@ -323,7 +323,7 @@ def _measure_decode(preset: str, bsz: int, steps: int) -> dict:
         def gen():
             cache = init_cache(cfg, batch=b, max_len=512)
             out = _generate_fused_jit(
-                params, cfg, toks, cache, valid, offs, key, temp, n_steps, True
+                prm, cfg, toks, cache, valid, offs, key, temp, n_steps, True
             )
             return np.asarray(out)
 
@@ -337,12 +337,12 @@ def _measure_decode(preset: str, bsz: int, steps: int) -> dict:
 
     s_lo = max(1, steps // 4)
 
-    def decode_rate(b: int) -> float:
-        dt = timed(b, plen, steps) - timed(b, plen, s_lo)
+    def decode_rate(prm, b: int) -> float:
+        dt = timed(prm, b, plen, steps) - timed(prm, b, plen, s_lo)
         return b * (steps - s_lo) / max(dt, 1e-9)
 
-    decode_tps = decode_rate(bsz)
-    solo_tps = decode_rate(1)
+    decode_tps = decode_rate(params, bsz)
+    solo_tps = decode_rate(params, 1)
     # Batch-scaling curve: defaults to 4×/8× the configured batch so an
     # operator who shrank KAKVEDA_BENCH_DECODE_BATCH for a small device
     # never gets surprise-large allocations; KAKVEDA_BENCH_DECODE_CURVE
@@ -351,12 +351,27 @@ def _measure_decode(preset: str, bsz: int, steps: int) -> dict:
     curve_env = os.environ.get("KAKVEDA_BENCH_DECODE_CURVE", f"{bsz * 4},{bsz * 8}")
     for b in (int(x) for x in curve_env.split(",") if x):
         if b != bsz:
-            curve[b] = decode_rate(b)
+            curve[b] = decode_rate(params, b)
     curve[bsz] = decode_tps
+
+    # int8 weight-only decode at the same batch: decode streams every dense
+    # weight from HBM per step, so halving the weight bytes is the headline
+    # serving lever (models/quant.py). Skipped when the main run is already
+    # int8 (KAKVEDA_BENCH_QUANT) or KAKVEDA_BENCH_INT8=0.
+    int8_tps = None
+    if (
+        os.environ.get("KAKVEDA_BENCH_QUANT") != "int8"
+        and os.environ.get("KAKVEDA_BENCH_INT8", "1") != "0"
+    ):
+        from kakveda_tpu.models.quant import quantize_params_int8
+
+        qparams = quantize_params_int8(params)
+        int8_tps = decode_rate(qparams, bsz)
+        del qparams
 
     # Prefill slope between two prompt lengths at one decode step.
     p_hi = 384
-    dt_p = timed(bsz, p_hi, 1) - timed(bsz, plen, 1)
+    dt_p = timed(params, bsz, p_hi, 1) - timed(params, bsz, plen, 1)
     prefill_tps = bsz * (p_hi - plen) / max(dt_p, 1e-9)
 
     mfu = decode_tps * flops_per_tok / peak_flops
@@ -365,6 +380,7 @@ def _measure_decode(preset: str, bsz: int, steps: int) -> dict:
         "decode_tps": decode_tps,
         "prefill_tps": prefill_tps,
         "solo_tps": solo_tps,
+        "int8_tps": int8_tps,
         "mfu": mfu,
         "prefill_mfu": prefill_mfu,
         "curve": curve,
@@ -729,15 +745,16 @@ def _bench_decode(backend: str) -> dict:
     print(f"bench[decode]: backend={backend} preset={preset} batch={bsz} steps={steps}", file=sys.stderr)
     r = _measure_decode(preset, bsz, steps)
     curve_s = " ".join(f"b{b}={v:,.0f}" for b, v in sorted(r["curve"].items()))
+    int8_s = f", int8 {r['int8_tps']:,.0f} tok/s" if r["int8_tps"] else ""
     print(
         f"bench[decode]: {r['n_params']/1e9:.2f}B params on {r['device_kind']} "
         f"(peak {r['peak_tflops']:.0f} bf16 TFLOP/s assumed) — decode {r['decode_tps']:,.0f} tok/s "
         f"@batch {r['batch']} (MFU {r['mfu']*100:.1f}%), prefill {r['prefill_tps']:,.0f} tok/s "
         f"(MFU {r['prefill_mfu']*100:.1f}%), unbatched {r['solo_tps']:,.0f} tok/s, "
-        f"curve {curve_s}",
+        f"curve {curve_s}{int8_s}",
         file=sys.stderr,
     )
-    return {
+    out = {
         "metric": f"decode_tokens_per_sec_{preset}_b{bsz}",
         "value": round(r["decode_tps"], 1),
         "unit": "tokens/sec",
@@ -747,6 +764,9 @@ def _bench_decode(backend: str) -> dict:
         "prefill_mfu": round(r["prefill_mfu"], 4),
         "decode_tps_curve": {str(b): round(v, 1) for b, v in sorted(r["curve"].items())},
     }
+    if r["int8_tps"]:
+        out["int8_decode_tps"] = round(r["int8_tps"], 1)
+    return out
 
 
 def _bench_mixed(backend: str) -> dict:

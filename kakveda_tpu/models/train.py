@@ -35,6 +35,17 @@ from kakveda_tpu.models.llama import (
 )
 
 
+def lm_loss_from_logits(logits: jax.Array, tokens: jax.Array) -> jax.Array:
+    """Causal-LM loss given logits [B, S, V]: next-token targets are the
+    tokens shifted left, the wrapped last position masked out. The ONE
+    definition of the training objective — shared by the dense step here
+    and the pipeline-parallel step (models/pipeline.py)."""
+    targets = jnp.roll(tokens, -1, axis=1)
+    mask = jnp.ones_like(tokens, jnp.float32).at[:, -1].set(0.0)
+    losses = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
+    return jnp.sum(losses * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
 def lm_loss(
     params: Params,
     cfg: LlamaConfig,
@@ -43,10 +54,7 @@ def lm_loss(
     cp_axis: Optional[str] = None,
 ) -> jax.Array:
     logits = forward(params, cfg, tokens, mesh=mesh, cp_axis=cp_axis)
-    targets = jnp.roll(tokens, -1, axis=1)
-    mask = jnp.ones_like(tokens, jnp.float32).at[:, -1].set(0.0)  # drop wrapped last position
-    losses = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
-    return jnp.sum(losses * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    return lm_loss_from_logits(logits, tokens)
 
 
 def make_optimizer(lr: float = 3e-4, weight_decay: float = 0.01) -> optax.GradientTransformation:
