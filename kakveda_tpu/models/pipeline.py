@@ -74,8 +74,10 @@ def split_stages(params: Params, cfg: LlamaConfig, n_stages: int) -> Params:
 
 
 def pp_param_specs(cfg: LlamaConfig) -> Params:
-    """Spec tree for the stage-stacked layout: stage arrays P("pp", …),
-    embed/norm/head replicated (they run outside the pipelined region)."""
+    """Spec tree for the stage-stacked FLOAT layout (the training path):
+    stage arrays P("pp", …), embed/norm/head replicated (they run outside
+    the pipelined region). For serving trees that may carry int8 pairs,
+    ``place_stacked`` derives specs from the actual structure instead."""
     layer = param_specs(cfg)["layers"][0]
     stacked = jax.tree.map(lambda s: P("pp"), layer, is_leaf=lambda x: isinstance(x, P))
     return {
@@ -178,8 +180,16 @@ def pp_forward(
 
 
 def place_stacked(stacked: Params, cfg: LlamaConfig, mesh: Mesh) -> Params:
-    """Place a stage-stacked tree on the mesh (stages over ``pp``)."""
-    specs = pp_param_specs(cfg)
+    """Place a stage-stacked tree on the mesh (stages over ``pp``). Specs
+    derive from the actual tree structure, so int8 weight-only pairs
+    ``{"q","s"}`` (models/quant.py) place too — both members carry the
+    stage axis."""
+    specs = {
+        "embed": jax.tree.map(lambda a: P(), stacked["embed"]),
+        "stages": jax.tree.map(lambda a: P("pp"), stacked["stages"]),
+        "final_norm": P(),
+        "lm_head": jax.tree.map(lambda a: P(), stacked["lm_head"]),
+    }
     return jax.tree.map(
         lambda x, sp: jax.device_put(x, NamedSharding(mesh, sp)), stacked, specs
     )

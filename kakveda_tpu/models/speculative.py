@@ -1,0 +1,187 @@
+"""Draft-free speculative decoding (prompt lookup), fused on device.
+
+The reference serves tokens one Ollama HTTP call at a time
+(reference: services/dashboard/app.py:1182-1258); this module is a serving
+lever it has no equivalent for. Greedy decode emits one token per weight
+stream — and at 1B+ scale decode is HBM-bandwidth-bound: every step reads
+every dense weight. Speculative decoding amortizes that stream: guess the
+next ``k`` tokens, verify all of them in ONE cached forward (k+1 query
+positions), keep the longest correct prefix. Each round emits between 1
+and k+1 tokens for one weight stream; by greedy-parity construction the
+output is IDENTICAL to plain greedy decode, rounds only change how many
+tokens each weight stream yields.
+
+No draft model: drafts come from **prompt lookup** (n-gram continuation —
+the same family as vLLM's prompt-lookup decoding and "lookahead" schemes).
+The failure-intelligence workload is exactly where this shines: LLM-judge
+prompts over near-duplicate traces, citation-style completions, and
+boilerplate-heavy scenario text repeat their own n-grams constantly.
+
+TPU-first design decisions:
+
+  * **The entire loop is one compiled program** — a ``lax.while_loop``
+    whose body does draft lookup, the (k+1)-position verify forward, and
+    the accept/advance bookkeeping on device. On a remote-attached chip a
+    host-side speculation loop would pay the ~70-90 ms dispatch RTT per
+    round, erasing the win; here the host pays ONE dispatch per
+    generation, same as ``generate_tokens_fused``.
+  * **Lookup is a vectorized bigram match** over the token buffer (no
+    hashes, no host dict): the most recent slot j with
+    ``buf[j-1] == prev and buf[j] == cur`` proposes ``buf[j+1 : j+1+k]``.
+  * **Static shapes throughout**: the verify chunk is always [1, k+1];
+    acceptance only moves the ``valid_len`` carry. Rejected draft K/V
+    slots are never masked — the next round's chunk overwrites them
+    before any query can attend that far (q_pos ≥ slot masking).
+
+Scope: single-sequence greedy (the playground / judge path). Batched
+serving keeps using ``generate_tokens_fused`` / ``ContinuousBatcher``
+(per-row accept rates diverge, which would stall the batch to its worst
+row). Parity + speedup characteristics: tests/test_speculative.py and
+``KAKVEDA_BENCH_METRIC=spec``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kakveda_tpu.models.llama import LlamaConfig, Params, decode_step, init_cache
+
+
+@partial(jax.jit, static_argnames=("cfg", "k", "max_new"))
+def _spec_decode_jit(
+    params: Params,
+    cfg: LlamaConfig,
+    buf: jax.Array,  # [1, ml] i32 — prompt in [0, plen), zeros beyond
+    cache: Params,
+    last: jax.Array,  # [1, V] logits at position plen-1 (post-prefill)
+    plen: jax.Array,  # scalar i32
+    k: int,
+    max_new: int,
+):
+    """Speculative greedy decode: returns (buf, n_decided) where
+    ``buf[0, plen : n_decided]`` are the generated tokens (≥ max_new of
+    them decided; caller truncates)."""
+    ml = buf.shape[1]
+    eff = cfg.effective_vocab
+
+    def mask_vocab(lg):
+        return lg.at[:, eff:].set(-jnp.inf) if eff is not None else lg
+
+    def cond(carry):
+        _, _, _, vl, _ = carry
+        return vl < plen + max_new
+
+    def body(carry):
+        buf, cache, last, vl, rounds = carry
+        t0 = jnp.argmax(mask_vocab(last), axis=-1)[0]  # token for slot vl
+        buf = jax.lax.dynamic_update_index_in_dim(buf, t0[None], vl, axis=1)
+
+        # Bigram prompt lookup over decided slots [1, vl]: most recent j
+        # with buf[j-1] == buf[vl-1] and buf[j] == t0 proposes the k slots
+        # that followed it. j == 0 (no match) proposes garbage — harmless,
+        # verification rejects it.
+        prev = buf[0, jnp.clip(vl - 1, 0, ml - 1)]
+        sl = jnp.arange(ml)
+        hit = (
+            (jnp.roll(buf[0], 1) == prev)
+            & (buf[0] == t0)
+            & (sl >= 1)
+            & (sl <= vl - 1)  # strictly before the slot being drafted
+        )
+        j = jnp.max(jnp.where(hit, sl, 0))
+        draft = jax.lax.dynamic_slice(buf, (0, jnp.clip(j + 1, 0, ml - k - 1)), (1, k))
+
+        # Verify chunk [t0, d1..dk] in one cached forward at pos = vl.
+        chunk = jnp.concatenate([t0[None][None], draft], axis=1)  # [1, k+1]
+        cache = dict(cache, pos=vl)
+        logits, cache = decode_step(params, cfg, chunk, cache)
+        preds = jnp.argmax(mask_vocab(logits.reshape(k + 1, -1)), axis=-1)  # [k+1]
+
+        # Longest accepted draft prefix: d_{i+1} must equal the model's
+        # greedy continuation p_i given everything before it.
+        match = draft[0] == preds[:k]
+        a = jnp.sum(jnp.cumprod(match.astype(jnp.int32)))
+
+        # Write the accepted drafts d1..da into slots vl+1..vl+a (the draft
+        # may have come from anywhere in the buffer; the decided region
+        # must hold it explicitly). The write window never clips: the loop
+        # stops at vl = plen+max_new-1 and ml ≥ plen+max_new+k+2.
+        keep = (sl > vl) & (sl <= vl + a)
+        upd = jnp.zeros((ml,), buf.dtype)
+        upd = jax.lax.dynamic_update_slice(upd, draft[0], (vl + 1,))
+        buf = jnp.where(keep[None, :], upd[None, :], buf)
+
+        # Next round's logits: the model's output after the accepted
+        # prefix — its argmax is the bonus/correction token.
+        last = jax.lax.dynamic_index_in_dim(logits.reshape(k + 1, -1), a, 0, keepdims=False)[None]
+        return (buf, cache, last, vl + a + 1, rounds + 1)
+
+    buf, _, _, vl, rounds = jax.lax.while_loop(
+        cond, body, (buf, cache, last, plen, jnp.asarray(0))
+    )
+    return buf, vl, rounds
+
+
+def generate_tokens_speculative(
+    params: Params,
+    cfg: LlamaConfig,
+    prompt_ids: list[int],
+    *,
+    max_new_tokens: int = 64,
+    k: int = 4,
+    eos_id: Optional[int] = None,
+    max_len: Optional[int] = None,
+    return_stats: bool = False,
+):
+    """Greedy decode with on-device prompt-lookup speculation; output is
+    token-identical to ``generate_tokens(temperature=0)`` (when the cache
+    window truncates the generation, the speculative window reserves k+1
+    extra verify slots, so it may emit up to k+1 fewer trailing tokens —
+    the emitted prefix is always identical). ``k`` is the draft length per
+    round (each round = one weight stream, emits 1..k+1 tokens). With
+    ``return_stats`` returns ``(tokens, {"rounds", "tokens_per_round"})``
+    — rounds is the number of weight streams the generation cost."""
+    from kakveda_tpu.models.generate import _bucket_len, _prefill_jit
+
+    plen = len(prompt_ids)
+    need = plen + max_new_tokens + k + 2
+    ml = max_len or _bucket_len(need, cfg.max_seq_len)
+    # The verify chunk writes k+1 cache slots per round, so the window must
+    # leave k+2 slots of headroom; clamp the generation budget to it (the
+    # plain path truncates at its window the same way) and refuse prompts
+    # that leave no room at all rather than silently clamping scatter
+    # indices into garbage output.
+    max_new = min(max_new_tokens, ml - plen - k - 2)
+    if max_new <= 0:
+        raise ValueError(
+            f"prompt ({plen} tokens) leaves no speculative decode room in the "
+            f"cache window (max_len={ml}, k={k}); truncate the prompt or raise max_len"
+        )
+    cache = init_cache(cfg, batch=1, max_len=ml)
+    buf = np.zeros((1, ml), np.int32)
+    buf[0, :plen] = prompt_ids
+
+    last, cache = _prefill_jit(
+        params,
+        cfg,
+        jnp.asarray([prompt_ids], jnp.int32),
+        cache,
+        jnp.ones((1, ml), bool),
+        jnp.zeros((1,), jnp.int32),
+    )
+    out_buf, vl, rounds = _spec_decode_jit(
+        params, cfg, jnp.asarray(buf), cache, last, jnp.asarray(plen), k, max_new
+    )
+    n = min(int(vl) - plen, max_new)
+    toks = np.asarray(out_buf)[0, plen : plen + n].tolist()
+    if eos_id is not None and eos_id in toks:
+        toks = toks[: toks.index(eos_id)]
+    if return_stats:
+        r = int(rounds)
+        return toks, {"rounds": r, "tokens_per_round": (int(vl) - plen) / max(r, 1)}
+    return toks

@@ -1,0 +1,152 @@
+"""Speculative decoding (models/speculative.py): exact greedy parity with
+the plain decode loop — on repetitive prompts (high acceptance), random
+prompts (low acceptance), converted HF checkpoints, int8 trees, and MoE
+configs — plus round-count evidence that acceptance actually amortizes."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from kakveda_tpu.models.generate import generate_tokens
+from kakveda_tpu.models.llama import LlamaConfig, init_params
+from kakveda_tpu.models.speculative import generate_tokens_speculative
+
+CFG = LlamaConfig(
+    vocab_size=64, d_model=32, n_layers=2, n_heads=4, n_kv_heads=2,
+    d_ff=48, max_seq_len=256, dtype=jnp.float32,
+)
+
+
+@pytest.mark.parametrize("k", [1, 3, 4])
+@pytest.mark.parametrize(
+    "prompt",
+    [
+        list(range(5, 25)),                       # arbitrary
+        [7, 8, 9, 10] * 6,                        # periodic — lookup should hit
+        [3, 3, 3, 3, 3, 3, 3, 3],                 # degenerate repetition
+        [11, 12],                                 # shorter than a draft window
+    ],
+)
+def test_speculative_matches_plain_greedy(prompt, k):
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    want = generate_tokens(params, CFG, prompt, max_new_tokens=24)
+    got = generate_tokens_speculative(params, CFG, prompt, max_new_tokens=24, k=k)
+    assert got == want, (got, want)
+
+
+def test_speculative_matches_on_hf_checkpoint(tmp_path):
+    transformers = pytest.importorskip("transformers")
+    torch = pytest.importorskip("torch")
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=250,  # not a multiple of 8 → exercises effective_vocab mask
+        hidden_size=64, intermediate_size=128, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, max_position_embeddings=128,
+    )
+    torch.manual_seed(0)
+    transformers.LlamaForCausalLM(hf_cfg).eval().save_pretrained(
+        str(tmp_path), safe_serialization=True
+    )
+    from kakveda_tpu.models.hf_convert import load_hf_checkpoint
+
+    params, cfg = load_hf_checkpoint(str(tmp_path), param_dtype=jnp.float32)
+    prompt = list(range(5, 20))
+    want = generate_tokens(params, cfg, prompt, max_new_tokens=16)
+    got = generate_tokens_speculative(params, cfg, prompt, max_new_tokens=16, k=4)
+    assert got == want
+
+
+def test_speculative_int8_and_moe():
+    from kakveda_tpu.models.quant import quantize_params_int8
+
+    params = init_params(jax.random.PRNGKey(1), CFG)
+    qparams = quantize_params_int8(params)
+    prompt = [5, 6, 7, 8, 5, 6, 7, 8, 5, 6]
+    assert generate_tokens_speculative(qparams, CFG, prompt, max_new_tokens=12) == \
+        generate_tokens(qparams, CFG, prompt, max_new_tokens=12)
+
+    moe_cfg = LlamaConfig(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=48, max_seq_len=256, dtype=jnp.float32,
+        n_experts=4, n_experts_per_tok=2,
+    )
+    mparams = init_params(jax.random.PRNGKey(2), moe_cfg)
+    assert generate_tokens_speculative(mparams, moe_cfg, prompt, max_new_tokens=12) == \
+        generate_tokens(mparams, moe_cfg, prompt, max_new_tokens=12)
+
+
+def test_speculative_respects_context_window():
+    """A prompt near cfg.max_seq_len must truncate the generation at the
+    window (same prefix as plain greedy), never decode past it; a prompt
+    with no room at all raises."""
+    import dataclasses
+
+    cfg = dataclasses.replace(CFG, max_seq_len=64)
+    params = init_params(jax.random.PRNGKey(4), CFG)
+    prompt = list(range(5, 45))  # 40 tokens in a 64 window
+    plain = generate_tokens(params, cfg, prompt, max_new_tokens=100)
+    spec = generate_tokens_speculative(params, cfg, prompt, max_new_tokens=100, k=4)
+    assert len(spec) <= len(plain) <= 64 - len(prompt)
+    assert spec == plain[: len(spec)]
+
+    with pytest.raises(ValueError, match="room"):
+        generate_tokens_speculative(params, cfg, list(range(3, 62)), max_new_tokens=8, k=4)
+
+
+def test_pp_place_stacked_int8():
+    """Stage-stacked int8 trees place on the pp mesh (specs derive from the
+    actual structure, not the float layout)."""
+    from jax.sharding import PartitionSpec as P
+
+    from kakveda_tpu.models.pipeline import place_stacked, split_stages
+    from kakveda_tpu.models.quant import quantize_params_int8
+    from kakveda_tpu.parallel.mesh import create_mesh
+
+    params = quantize_params_int8(init_params(jax.random.PRNGKey(5), CFG))
+    mesh = create_mesh("pp:2")
+    stacked = place_stacked(split_stages(params, CFG, 2), CFG, mesh)
+    assert stacked["stages"]["wq"]["q"].sharding.spec == P("pp")
+    assert stacked["stages"]["wq"]["s"].sharding.spec == P("pp")
+
+
+def test_speculative_eos_truncation():
+    params = init_params(jax.random.PRNGKey(3), CFG)
+    prompt = list(range(5, 15))
+    plain = generate_tokens(params, CFG, prompt, max_new_tokens=20)
+    # pick the 5th generated token as a fake EOS: both paths must stop there
+    eos = plain[5]
+    want = generate_tokens(params, CFG, prompt, max_new_tokens=20, eos_id=eos)
+    got = generate_tokens_speculative(params, CFG, prompt, max_new_tokens=20, eos_id=eos)
+    assert got == want
+
+
+def test_acceptance_amortizes_on_forced_repetition():
+    """A model trained into a short loop must emit well over one token per
+    verify round (each round = one weight stream): train a tiny model to
+    reproduce a strict 4-token cycle, then check both exact parity on the
+    long periodic generation AND the measured tokens/round."""
+    from kakveda_tpu.models.train import fit
+
+    corpus = "abcd" * 200
+    cfg = LlamaConfig(
+        vocab_size=264, d_model=32, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=48, max_seq_len=256, dtype=jnp.float32,
+    )
+    params, losses = fit(cfg, corpus, steps=60, batch=2, seq_len=32, lr=5e-3, log_every=0)
+    assert losses[-1] < losses[0]
+    from kakveda_tpu.models.tokenizer import ByteTokenizer
+
+    prompt = ByteTokenizer().encode("abcdabcdabcd")
+    want = generate_tokens(params, cfg, prompt, max_new_tokens=40)
+    got, stats = generate_tokens_speculative(
+        params, cfg, prompt, max_new_tokens=40, k=4, return_stats=True
+    )
+    assert got == want
+    # The trained model settles into a periodic generation (deterministic
+    # seeds), so the bigram lookup hits nearly every round: measured 5.0
+    # tokens/round (= perfect k+1 acceptance) at these seeds.
+    assert stats["tokens_per_round"] > 2.0, stats
+    assert stats["rounds"] <= 40
