@@ -111,6 +111,7 @@ def hf_config_to_llama(hf: Dict[str, Any], *, dtype=jnp.bfloat16) -> LlamaConfig
         moe_kw = dict(
             n_experts=int(hf["num_local_experts"]),
             n_experts_per_tok=int(hf.get("num_experts_per_tok", 2)),
+            router_aux_coef=float(hf.get("router_aux_loss_coef", 0.0)),
         )
 
     vocab = int(hf["vocab_size"])

@@ -77,6 +77,9 @@ class LlamaConfig:
     n_experts: int = 0
     n_experts_per_tok: int = 2
     expert_capacity_factor: float = 0.0
+    # Load-balancing aux-loss coefficient for MoE fine-tunes (HF Mixtral's
+    # router_aux_loss_coef); 0 disables the aux term in lm_loss.
+    router_aux_coef: float = 0.0
 
     @property
     def head_dim(self) -> int:
@@ -467,14 +470,19 @@ def _mlp_block(x: jax.Array, layer: Params) -> jax.Array:
     return (gate * up) @ wmat(layer["w_down"], dt)
 
 
-def mlp_block(x: jax.Array, layer: Params, cfg: LlamaConfig) -> jax.Array:
+def mlp_block(
+    x: jax.Array, layer: Params, cfg: LlamaConfig, return_aux: bool = False
+):
     """Dense SwiGLU or sparse-MoE MLP, keyed on the layer's params
-    (MoE layers carry a ``router``; models/moe.py)."""
+    (MoE layers carry a ``router``; models/moe.py). With ``return_aux``
+    returns ``(out, aux)`` — aux is the layer's load-balancing loss
+    (0 for dense layers)."""
     if "router" in layer:
         from kakveda_tpu.models.moe import moe_mlp
 
-        return moe_mlp(x, layer, cfg)
-    return _mlp_block(x, layer)
+        return moe_mlp(x, layer, cfg, return_aux=return_aux)
+    out = _mlp_block(x, layer)
+    return (out, jnp.zeros((), jnp.float32)) if return_aux else out
 
 
 def forward(
@@ -485,14 +493,17 @@ def forward(
     mesh: Optional[Mesh] = None,
     cp_axis: Optional[str] = None,
     positions: Optional[jax.Array] = None,
-) -> jax.Array:
+    with_aux: bool = False,
+):
     """Full-sequence forward: tokens [B, S] -> logits [B, S, vocab].
 
     With ``mesh``+``cp_axis`` the sequence axis is context-parallel and
     attention runs as a ring over that axis; RoPE positions are the *global*
     positions, threaded in by the caller via ``positions`` when the local
     shard doesn't start at 0 (handled automatically under jit because the
-    whole [B, S] array is logically global).
+    whole [B, S] array is logically global). ``with_aux`` returns
+    ``(logits, aux)`` where aux is the summed MoE load-balancing loss
+    across layers (0 for dense models).
     """
     b, s = tokens.shape
     if positions is None:
@@ -500,14 +511,18 @@ def forward(
     cos, sin = _rope_freqs(cfg, positions)
 
     x = params["embed"].astype(cfg.dtype)[tokens]
+    aux = jnp.zeros((), jnp.float32)
     for layer in params["layers"]:
         h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
         x = x + _attention_block(h, layer, cfg, cos, sin, mesh, cp_axis)
         h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
-        x = x + mlp_block(h, layer, cfg)
+        m, a = mlp_block(h, layer, cfg, return_aux=True)
+        x = x + m
+        aux = aux + a
 
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    return (x @ wmat(params["lm_head"], cfg.dtype)).astype(jnp.float32)
+    logits = (x @ wmat(params["lm_head"], cfg.dtype)).astype(jnp.float32)
+    return (logits, aux) if with_aux else logits
 
 
 # ---------------------------------------------------------------------------

@@ -59,8 +59,12 @@ def expert_capacity(n_tokens: int, cfg: LlamaConfig) -> int:
     return min(n_tokens, max(1, math.ceil(n_tokens * k / e * f)))
 
 
-def moe_mlp(x: jax.Array, layer: Params, cfg: LlamaConfig) -> jax.Array:
-    """Sparse-MoE SwiGLU MLP: x [B, S, D] -> [B, S, D].
+def moe_mlp(
+    x: jax.Array, layer: Params, cfg: LlamaConfig, return_aux: bool = False
+):
+    """Sparse-MoE SwiGLU MLP: x [B, S, D] -> [B, S, D] (or ``(out, aux)``
+    with ``return_aux`` — aux is this layer's load-balancing loss, which
+    the training objective adds at ``cfg.router_aux_coef``).
 
     Layer params: ``router`` [D, E], stacked ``we_gate``/``we_up``
     [E, D, F], ``we_down`` [E, F, D] (llama.init_params / Mixtral
@@ -73,7 +77,7 @@ def moe_mlp(x: jax.Array, layer: Params, cfg: LlamaConfig) -> jax.Array:
     xf = x.reshape(t, d)
 
     logits = xf.astype(jnp.float32) @ layer["router"].astype(jnp.float32)
-    w, idx, _ = router_topk(logits, k)  # [T, k]
+    w, idx, probs = router_topk(logits, k)  # [T, k]
 
     cap = expert_capacity(t, cfg)
 
@@ -111,7 +115,10 @@ def moe_mlp(x: jax.Array, layer: Params, cfg: LlamaConfig) -> jax.Array:
     y_rows = ye.reshape(e * cap, d)[jnp.minimum(slot, e * cap - 1)]
     contrib = y_rows * (w_flat[order] * keep.astype(jnp.float32))[:, None].astype(dt)
     out = jnp.zeros((t, d), dt).at[tok_sorted, :].add(contrib)
-    return out.reshape(b, s, d)
+    out = out.reshape(b, s, d)
+    if return_aux:
+        return out, load_balancing_loss(probs, idx, e)
+    return out
 
 
 def load_balancing_loss(router_probs: jax.Array, expert_idx: jax.Array, n_experts: int) -> jax.Array:

@@ -53,6 +53,11 @@ def lm_loss(
     mesh: Optional[Mesh] = None,
     cp_axis: Optional[str] = None,
 ) -> jax.Array:
+    """CE loss; MoE configs with ``router_aux_coef > 0`` add the summed
+    load-balancing aux loss (models/moe.py, HF router_aux_loss_coef)."""
+    if cfg.n_experts and cfg.router_aux_coef > 0.0:
+        logits, aux = forward(params, cfg, tokens, mesh=mesh, cp_axis=cp_axis, with_aux=True)
+        return lm_loss_from_logits(logits, tokens) + cfg.router_aux_coef * aux
     logits = forward(params, cfg, tokens, mesh=mesh, cp_axis=cp_axis)
     return lm_loss_from_logits(logits, tokens)
 

@@ -172,6 +172,24 @@ def test_load_balancing_loss_uniform_is_one():
     assert float(load_balancing_loss(probs_bad, idx_bad, e)) > 3.9
 
 
+def test_aux_loss_wired_into_training_objective():
+    """router_aux_coef > 0 adds the summed per-layer load-balancing loss
+    to lm_loss; the aux term sits in [1, E] per layer."""
+    from kakveda_tpu.models.train import lm_loss
+
+    cfg0 = _moe_cfg()
+    cfg1 = _moe_cfg(router_aux_coef=0.5)
+    params = init_params(jax.random.PRNGKey(6), cfg0)
+    tokens = jnp.asarray(np.random.default_rng(6).integers(0, 64, size=(2, 16)))
+    base = float(lm_loss(params, cfg0, tokens))
+    with_aux = float(lm_loss(params, cfg1, tokens))
+    per_layer_aux = (with_aux - base) / (0.5 * cfg0.n_layers)
+    assert 1.0 - 1e-3 <= per_layer_aux <= cfg0.n_experts + 1e-3, per_layer_aux
+    # aux still differentiates
+    g = jax.grad(lm_loss)(params, cfg1, tokens)
+    assert np.isfinite(float(jnp.abs(g["layers"][0]["router"]).max()))
+
+
 def test_moe_gradients_reach_router_and_experts():
     from kakveda_tpu.models.train import lm_loss
 
