@@ -50,17 +50,20 @@ __all__ = ["hf_config_to_llama", "load_hf_checkpoint", "shard_params"]
 _VOCAB_MULTIPLE = 8
 
 
-_SUPPORTED_FAMILIES = ("llama", "mistral", "qwen2", "mixtral")
+_SUPPORTED_FAMILIES = ("llama", "mistral", "qwen2", "mixtral", "gemma")
 
 
 def hf_config_to_llama(hf: Dict[str, Any], *, dtype=jnp.bfloat16) -> LlamaConfig:
     """Map an HF ``config.json`` dict to :class:`LlamaConfig`.
 
-    Four HF families share the Llama block structure and load onto the one
+    Five HF families share the Llama block structure and load onto the one
     runtime: ``llama`` (the baseline), ``mistral`` (adds a sliding attention
     window and sometimes an explicit head_dim), ``qwen2`` (adds q/k/v
-    projection biases), and ``mixtral`` (replaces the dense MLP with a
-    sparse MoE block — models/moe.py). Anything else is rejected loudly."""
+    projection biases), ``mixtral`` (replaces the dense MLP with a sparse
+    MoE block — models/moe.py), and ``gemma`` (GeGLU activation,
+    sqrt(d_model) embedding scale, explicit head_dim; its (1+w) RMSNorm
+    convention is absorbed at conversion by storing the materialized 1+w
+    weights). Anything else is rejected loudly."""
     family = hf.get("model_type") or "llama"
     if family not in _SUPPORTED_FAMILIES:
         raise ValueError(
@@ -132,6 +135,8 @@ def hf_config_to_llama(hf: Dict[str, Any], *, dtype=jnp.bfloat16) -> LlamaConfig
         attn_bias=bool(hf.get("attention_bias", family == "qwen2")),
         sliding_window=window,
         head_dim_opt=head_dim,
+        act_fn="gelu_tanh" if family == "gemma" else "silu",
+        scale_embed=family == "gemma",
         **kw,
     )
 
@@ -231,6 +236,9 @@ def load_hf_checkpoint(
     with open(os.path.join(path, "config.json")) as f:
         hf_cfg = json.load(f)
     cfg = hf_config_to_llama(hf_cfg, dtype=compute_dtype or param_dtype)
+    # Gemma applies RMSNorm gain as (1 + w) with zero-init weights; storing
+    # the materialized 1+w keeps every forward path convention-free.
+    norm_off = 1.0 if hf_cfg.get("model_type") == "gemma" else 0.0
 
     params = _empty_tree(cfg)
     seen = set()
@@ -255,7 +263,7 @@ def load_hf_checkpoint(
         if base == "embed_tokens.weight":
             put(params, "embed", _pad_vocab_rows(arr, cfg.vocab_size), transpose=False)
         elif base == "norm.weight":
-            put(params, "final_norm", arr, transpose=False)
+            put(params, "final_norm", arr + norm_off, transpose=False)
         elif name == "lm_head.weight":
             put(params, "lm_head", _pad_vocab_rows(arr, cfg.vocab_size), transpose=True)
         elif base.startswith("layers."):
@@ -263,9 +271,9 @@ def load_hf_checkpoint(
             layer = params["layers"][int(idx)]
             match rest:
                 case "input_layernorm.weight":
-                    put(layer, "attn_norm", arr, transpose=False)
+                    put(layer, "attn_norm", arr + norm_off, transpose=False)
                 case "post_attention_layernorm.weight":
-                    put(layer, "mlp_norm", arr, transpose=False)
+                    put(layer, "mlp_norm", arr + norm_off, transpose=False)
                 case "self_attn.q_proj.weight":
                     put(layer, "wq", arr, transpose=True)
                 case "self_attn.k_proj.weight":
@@ -312,7 +320,9 @@ def load_hf_checkpoint(
         params["layers"][li][key] = jnp.stack(lst)
 
     if params["lm_head"] is None:
-        if not hf_cfg.get("tie_word_embeddings", False):
+        # Gemma ties by class default and omits the key from config.json.
+        tie_default = hf_cfg.get("model_type") == "gemma"
+        if not hf_cfg.get("tie_word_embeddings", tie_default):
             raise ValueError("checkpoint has no lm_head and tie_word_embeddings is false")
         params["lm_head"] = params["embed"].T
 

@@ -70,6 +70,11 @@ class LlamaConfig:
     attn_bias: bool = False
     sliding_window: int = 0
     head_dim_opt: int = 0  # 0 = derive from d_model // n_heads
+    # Gemma-family deltas: tanh-GELU gate activation (GeGLU) and
+    # sqrt(d_model) embedding scaling. Gemma's (1+w) RMSNorm convention
+    # needs NO flag — conversion stores the materialized 1+w weights.
+    act_fn: str = "silu"  # "silu" | "gelu_tanh"
+    scale_embed: bool = False
     # Sparse Mixture-of-Experts MLP (Mixtral family; models/moe.py).
     # n_experts == 0 means dense. expert_capacity_factor <= 0 means no-drop
     # dispatch (exact; decode + parity tests); positive caps each expert at
@@ -463,11 +468,25 @@ def _attention_block(
     return attn.reshape(b, s, cfg.n_heads * hd) @ wmat(layer["wo"], dt)
 
 
-def _mlp_block(x: jax.Array, layer: Params) -> jax.Array:
+def _act(x: jax.Array, act_fn: str) -> jax.Array:
+    if act_fn == "gelu_tanh":  # Gemma's GeGLU gate
+        return jax.nn.gelu(x, approximate=True)
+    return jax.nn.silu(x)
+
+
+def _mlp_block(x: jax.Array, layer: Params, act_fn: str = "silu") -> jax.Array:
     dt = x.dtype
-    gate = jax.nn.silu(x @ wmat(layer["w_gate"], dt))
+    gate = _act(x @ wmat(layer["w_gate"], dt), act_fn)
     up = x @ wmat(layer["w_up"], dt)
     return (gate * up) @ wmat(layer["w_down"], dt)
+
+
+def embed_tokens(params: Params, cfg: LlamaConfig, tokens: jax.Array) -> jax.Array:
+    """Token embedding at compute dtype; Gemma scales by sqrt(d_model)."""
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    if cfg.scale_embed:
+        x = x * jnp.asarray(math.sqrt(cfg.d_model), cfg.dtype)
+    return x
 
 
 def mlp_block(
@@ -481,7 +500,7 @@ def mlp_block(
         from kakveda_tpu.models.moe import moe_mlp
 
         return moe_mlp(x, layer, cfg, return_aux=return_aux)
-    out = _mlp_block(x, layer)
+    out = _mlp_block(x, layer, cfg.act_fn)
     return (out, jnp.zeros((), jnp.float32)) if return_aux else out
 
 
@@ -510,7 +529,7 @@ def forward(
         positions = jnp.broadcast_to(jnp.arange(s), (b, s))
     cos, sin = _rope_freqs(cfg, positions)
 
-    x = params["embed"].astype(cfg.dtype)[tokens]
+    x = embed_tokens(params, cfg, tokens)
     aux = jnp.zeros((), jnp.float32)
     for layer in params["layers"]:
         h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
@@ -581,7 +600,7 @@ def decode_step(
     cos, sin = _rope_freqs(cfg, positions)
     hd = cfg.head_dim
 
-    x = params["embed"].astype(cfg.dtype)[tokens]
+    x = embed_tokens(params, cfg, tokens)
     new_k: list = []
     new_v: list = []
     for li, layer in enumerate(params["layers"]):

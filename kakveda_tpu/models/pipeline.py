@@ -48,6 +48,7 @@ from kakveda_tpu.models.llama import (
     Params,
     _attention_block,
     _rope_freqs,
+    embed_tokens,
     mlp_block,
     param_specs,
     rms_norm,
@@ -123,7 +124,7 @@ def pp_forward(
     positions = jnp.broadcast_to(jnp.arange(s), (mb, s))
     cos, sin = _rope_freqs(cfg, positions)
 
-    x = stacked["embed"].astype(cfg.dtype)[tokens]
+    x = embed_tokens(stacked, cfg, tokens)
     x_mb = x.reshape(n_micro, mb, s, -1)
 
     n_ticks = n_micro + n_stages - 1

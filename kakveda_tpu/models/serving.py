@@ -106,6 +106,7 @@ def _step_chunk_jit(params, cfg: LlamaConfig, cache, last, slot_pos, kv_valid, p
     from kakveda_tpu.models.llama import (
         _rope_freqs,
         apply_rope,
+        embed_tokens,
         mlp_block,
         qkv_proj,
         rms_norm,
@@ -126,7 +127,7 @@ def _step_chunk_jit(params, cfg: LlamaConfig, cache, last, slot_pos, kv_valid, p
         tokens = nxt[:, None].astype(jnp.int32)
         positions = (slot_pos - pos_offset)[:, None]  # logical positions
         cos, sin = _rope_freqs(cfg, positions)
-        x = params["embed"].astype(cfg.dtype)[tokens]
+        x = embed_tokens(params, cfg, tokens)
         new_k, new_v = [], []
         # Validity for reads this step: slots < own write index, plus self.
         # A sliding window (Mistral) folds in here — the query's slot index

@@ -295,6 +295,57 @@ def test_mixtral_runtime_serving_end_to_end(tmp_path):
     assert rt.generate("summarize the article", max_tokens=8).text == res.text
 
 
+def _make_gemma_checkpoint(path, *, vocab=256, seed=0):
+    hf_cfg = transformers.GemmaConfig(
+        vocab_size=vocab,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        head_dim=32,  # != hidden/heads (16) — gemma-7b-style explicit head_dim
+        max_position_embeddings=128,
+        rms_norm_eps=1e-6,
+        rope_theta=10000.0,
+    )
+    torch.manual_seed(seed)
+    model = transformers.GemmaForCausalLM(hf_cfg).eval()
+    # zero-init (1+w) norms hide conversion bugs; randomize them
+    with torch.no_grad():
+        for lyr in model.model.layers:
+            lyr.input_layernorm.weight.normal_(0.0, 0.2)
+            lyr.post_attention_layernorm.weight.normal_(0.0, 0.2)
+        model.model.norm.weight.normal_(0.0, 0.2)
+    model.save_pretrained(str(path), safe_serialization=True)
+    return model
+
+
+def test_logit_parity_gemma(tmp_path):
+    # Gemma: GeGLU gate, sqrt(d_model) embedding scale, (1+w) norms
+    # (materialized at conversion), explicit head_dim != d_model/heads,
+    # tied embeddings.
+    model = _make_gemma_checkpoint(tmp_path, seed=12)
+    params, cfg = _assert_parity(model, tmp_path, vocab=256)
+    assert cfg.act_fn == "gelu_tanh" and cfg.scale_embed
+    assert cfg.head_dim == 32
+    # norms carry the +1 offset: random N(0, 0.2) weights center near 1
+    m = float(np.mean(np.asarray(params["final_norm"])))
+    assert 0.7 < m < 1.3, m
+
+
+def test_gemma_decode_cache_matches_full_forward(tmp_path):
+    _make_gemma_checkpoint(tmp_path, seed=13)
+    params, cfg = load_hf_checkpoint(str(tmp_path), param_dtype=jnp.float32)
+    prompt = list(range(5, 21))
+    greedy_cached = generate_tokens(params, cfg, prompt, max_new_tokens=8)
+
+    toks = list(prompt)
+    for _ in range(8):
+        logits = forward(params, cfg, jnp.asarray([toks]))
+        toks.append(int(jnp.argmax(logits[0, -1])))
+    assert greedy_cached == toks[len(prompt) :]
+
+
 def test_rejects_unknown_family_and_unknown_scaling(tmp_path):
     with pytest.raises(ValueError, match="model_type"):
         hf_config_to_llama({"model_type": "gpt2", "vocab_size": 8})
