@@ -578,8 +578,25 @@ class LlamaRuntime:
         ids = self.tokenizer.encode(prompt)[-self.cfg.max_seq_len // 2 :]
         from kakveda_tpu.core import profiling
 
-        with profiling.annotate("llama.generate"):
-            new_ids = self._generate_ids_chunked([ids], max_tokens)[0]
+        meta_extra = {}
+        if os.environ.get("KAKVEDA_SPEC", "") == "1":
+            # Single-sequence latency mode: draft-free speculative decoding
+            # (models/speculative.py) — token-identical to the chunked
+            # greedy path, 1..k+1 tokens per weight stream. Trade-off: the
+            # whole generation is ONE device program, so concurrent warn
+            # batches lose their per-chunk preemption points; leave it off
+            # when the chip is shared.
+            from kakveda_tpu.models.speculative import generate_tokens_speculative
+
+            with profiling.annotate("llama.generate_spec"):
+                new_ids, stats = generate_tokens_speculative(
+                    self.params, self.cfg, ids, max_new_tokens=max_tokens,
+                    eos_id=self.tokenizer.EOS, return_stats=True,
+                )
+            meta_extra = {"speculative": True, "tokens_per_round": round(stats["tokens_per_round"], 2)}
+        else:
+            with profiling.annotate("llama.generate"):
+                new_ids = self._generate_ids_chunked([ids], max_tokens)[0]
         text = self.tokenizer.decode(new_ids)
         return GenerateResult(
             text=text,
@@ -588,5 +605,6 @@ class LlamaRuntime:
                 "model": model or self.model_label,
                 "latency_ms": int((time.perf_counter() - started) * 1000),
                 "tokens_generated": len(new_ids),
+                **meta_extra,
             },
         )

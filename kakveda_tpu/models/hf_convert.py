@@ -237,8 +237,14 @@ def load_hf_checkpoint(
         hf_cfg = json.load(f)
     cfg = hf_config_to_llama(hf_cfg, dtype=compute_dtype or param_dtype)
     # Gemma applies RMSNorm gain as (1 + w) with zero-init weights; storing
-    # the materialized 1+w keeps every forward path convention-free.
-    norm_off = 1.0 if hf_cfg.get("model_type") == "gemma" else 0.0
+    # the materialized 1+w keeps every forward path convention-free. The
+    # materialized gains stay FLOAT32 (norm_dtype) — cast to bf16 their
+    # spacing near 1.0 is 2^-8, which would discard the zero-centered
+    # parameterization's precision; rms_norm applies f32 gains in f32
+    # (HF GemmaRMSNorm's convention).
+    is_gemma = hf_cfg.get("model_type") == "gemma"
+    norm_off = 1.0 if is_gemma else 0.0
+    norm_dtype = jnp.float32 if is_gemma else None
 
     params = _empty_tree(cfg)
     seen = set()
@@ -247,9 +253,11 @@ def load_hf_checkpoint(
     # at the end into the [E, ...] arrays the MoE block wants.
     staged: Dict[Tuple[int, str], list] = {}
 
-    def put(slot: Dict[str, Any] | Params, key: str, arr: np.ndarray, *, transpose: bool) -> None:
+    def put(
+        slot: Dict[str, Any] | Params, key: str, arr: np.ndarray, *, transpose: bool, dtype=None
+    ) -> None:
         a = arr.T if transpose else arr
-        slot[key] = jnp.asarray(a).astype(param_dtype)
+        slot[key] = jnp.asarray(a).astype(dtype or param_dtype)
 
     def stage_expert(li: int, key: str, ei: int, arr: np.ndarray, *, transpose: bool) -> None:
         lst = staged.setdefault((li, key), [None] * cfg.n_experts)
@@ -263,7 +271,7 @@ def load_hf_checkpoint(
         if base == "embed_tokens.weight":
             put(params, "embed", _pad_vocab_rows(arr, cfg.vocab_size), transpose=False)
         elif base == "norm.weight":
-            put(params, "final_norm", arr + norm_off, transpose=False)
+            put(params, "final_norm", arr + norm_off, transpose=False, dtype=norm_dtype)
         elif name == "lm_head.weight":
             put(params, "lm_head", _pad_vocab_rows(arr, cfg.vocab_size), transpose=True)
         elif base.startswith("layers."):
@@ -271,9 +279,9 @@ def load_hf_checkpoint(
             layer = params["layers"][int(idx)]
             match rest:
                 case "input_layernorm.weight":
-                    put(layer, "attn_norm", arr + norm_off, transpose=False)
+                    put(layer, "attn_norm", arr + norm_off, transpose=False, dtype=norm_dtype)
                 case "post_attention_layernorm.weight":
-                    put(layer, "mlp_norm", arr + norm_off, transpose=False)
+                    put(layer, "mlp_norm", arr + norm_off, transpose=False, dtype=norm_dtype)
                 case "self_attn.q_proj.weight":
                     put(layer, "wq", arr, transpose=True)
                 case "self_attn.k_proj.weight":

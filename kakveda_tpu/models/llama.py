@@ -263,6 +263,12 @@ def qkv_proj(
 def rms_norm(x: jax.Array, w: jax.Array, eps: float) -> jax.Array:
     x32 = x.astype(jnp.float32)
     scale = jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+    if w.dtype == jnp.float32 and x.dtype != jnp.float32:
+        # f32 gain weights under a low-precision compute dtype apply in
+        # f32 BEFORE the downcast — Gemma's convention (its materialized
+        # 1+w gains stay f32 at conversion; bf16 spacing near 1.0 is 2^-8,
+        # which would swamp the zero-centered parameterization).
+        return ((x32 * scale) * w).astype(x.dtype)
     return (x32 * scale).astype(x.dtype) * w.astype(x.dtype)
 
 

@@ -332,6 +332,14 @@ def test_logit_parity_gemma(tmp_path):
     m = float(np.mean(np.asarray(params["final_norm"])))
     assert 0.7 < m < 1.3, m
 
+    # At bf16 param_dtype the materialized 1+w gains must stay f32 (bf16
+    # spacing near 1.0 is 2^-8 — it would swamp the zero-centered
+    # parameterization); non-gemma norms follow param_dtype as before.
+    bf_params, _ = load_hf_checkpoint(str(tmp_path), param_dtype=jnp.bfloat16)
+    assert bf_params["final_norm"].dtype == jnp.float32
+    assert bf_params["layers"][0]["attn_norm"].dtype == jnp.float32
+    assert bf_params["layers"][0]["wq"].dtype == jnp.bfloat16
+
 
 def test_gemma_decode_cache_matches_full_forward(tmp_path):
     _make_gemma_checkpoint(tmp_path, seed=13)

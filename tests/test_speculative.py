@@ -112,6 +112,25 @@ def test_pp_place_stacked_int8():
     assert stacked["stages"]["wq"]["s"].sharding.spec == P("pp")
 
 
+def test_runtime_spec_mode_matches_chunked(monkeypatch):
+    """KAKVEDA_SPEC=1 routes LlamaRuntime.generate through the speculative
+    path with identical text and a tokens_per_round meta field."""
+    from kakveda_tpu.models.generate import LlamaRuntime
+
+    cfg = LlamaConfig(
+        vocab_size=264, d_model=32, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=48, max_seq_len=256, dtype=jnp.float32,
+    )
+    rt = LlamaRuntime(cfg=cfg, seed=0)
+    monkeypatch.delenv("KAKVEDA_SPEC", raising=False)
+    plain = rt.generate("hello failure world", max_tokens=16)
+    monkeypatch.setenv("KAKVEDA_SPEC", "1")
+    spec = rt.generate("hello failure world", max_tokens=16)
+    assert spec.text == plain.text
+    assert spec.meta["speculative"] is True and spec.meta["tokens_per_round"] >= 1.0
+    assert "speculative" not in plain.meta
+
+
 def test_speculative_eos_truncation():
     params = init_params(jax.random.PRNGKey(3), CFG)
     prompt = list(range(5, 15))
