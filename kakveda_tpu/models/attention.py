@@ -54,7 +54,7 @@ _NEG_INF = -1e30
 # ---------------------------------------------------------------------------
 
 
-def _gqa_xla(q, k, v, pos0, kv_valid, window: int = 0):
+def _gqa_xla(q, k, v, pos0, kv_valid, window: int = 0, softcap: float = 0.0):
     b, s, h, d = q.shape
     _, kv, l, _ = k.shape
     r = h // kv
@@ -63,6 +63,9 @@ def _gqa_xla(q, k, v, pos0, kv_valid, window: int = 0):
     # the matmul over KV instead of materializing repeated K/V.
     q5 = q.reshape(b, s, kv, r, d).transpose(0, 2, 1, 3, 4)
     scores = jnp.einsum("bgsrd,bgld->bgsrl", q5, k).astype(jnp.float32) * scale
+    if softcap:
+        # Gemma-2 attention-logit softcapping: cap·tanh(s/cap), pre-mask.
+        scores = softcap * jnp.tanh(scores / softcap)
     q_pos = pos0 + jnp.arange(s)
     l_pos = jnp.arange(l)
     mask = q_pos[:, None] >= l_pos[None, :]  # [S, L]
@@ -282,14 +285,18 @@ def gqa_cache_attention(
     kv_valid: jax.Array | None = None,
     *,
     window: int = 0,
+    softcap: float = 0.0,
     use_flash: bool | None = None,
 ) -> jax.Array:
     """Cached GQA attention — dispatches to the Pallas flash kernel on TPU
     (inference shapes that fit its tiling), XLA grouped einsum otherwise.
-    ``window`` > 0 applies sliding-window attention (Mistral) in both paths.
+    ``window`` > 0 applies sliding-window attention (Mistral) in both paths;
+    ``softcap`` > 0 (Gemma-2 logit capping) always takes the XLA path.
     ``KAKVEDA_FLASH=0`` forces the XLA path."""
     b, s, h, d = q.shape
     _, kv, l, _ = k.shape
+    if softcap:
+        return _gqa_xla(q, k, v, pos0, kv_valid, window=window, softcap=softcap)
     if use_flash is None:
         env = os.environ.get("KAKVEDA_FLASH", "auto")
         use_flash = (

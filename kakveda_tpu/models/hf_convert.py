@@ -50,20 +50,23 @@ __all__ = ["hf_config_to_llama", "load_hf_checkpoint", "shard_params"]
 _VOCAB_MULTIPLE = 8
 
 
-_SUPPORTED_FAMILIES = ("llama", "mistral", "qwen2", "mixtral", "gemma")
+_SUPPORTED_FAMILIES = ("llama", "mistral", "qwen2", "mixtral", "gemma", "gemma2")
+_GEMMA_FAMILIES = ("gemma", "gemma2")
 
 
 def hf_config_to_llama(hf: Dict[str, Any], *, dtype=jnp.bfloat16) -> LlamaConfig:
     """Map an HF ``config.json`` dict to :class:`LlamaConfig`.
 
-    Five HF families share the Llama block structure and load onto the one
+    Six HF families share the Llama block structure and load onto the one
     runtime: ``llama`` (the baseline), ``mistral`` (adds a sliding attention
     window and sometimes an explicit head_dim), ``qwen2`` (adds q/k/v
     projection biases), ``mixtral`` (replaces the dense MLP with a sparse
-    MoE block — models/moe.py), and ``gemma`` (GeGLU activation,
-    sqrt(d_model) embedding scale, explicit head_dim; its (1+w) RMSNorm
-    convention is absorbed at conversion by storing the materialized 1+w
-    weights). Anything else is rejected loudly."""
+    MoE block — models/moe.py), ``gemma`` (GeGLU activation, sqrt(d_model)
+    embedding scale, explicit head_dim; its (1+w) RMSNorm convention is
+    absorbed at conversion by storing the materialized 1+w weights), and
+    ``gemma2`` (gemma plus alternating per-layer sliding windows,
+    attention/final logit softcapping, an explicit query scale, and
+    sandwich post-norms). Anything else is rejected loudly."""
     family = hf.get("model_type") or "llama"
     if family not in _SUPPORTED_FAMILIES:
         raise ValueError(
@@ -116,6 +119,19 @@ def hf_config_to_llama(hf: Dict[str, Any], *, dtype=jnp.bfloat16) -> LlamaConfig
             n_experts_per_tok=int(hf.get("num_experts_per_tok", 2)),
             router_aux_coef=float(hf.get("router_aux_loss_coef", 0.0)),
         )
+    if family == "gemma2":
+        hd_real = head_dim or int(hf["hidden_size"]) // n_heads
+        qpas = float(hf.get("query_pre_attn_scalar") or 0.0)
+        qs = qpas**-0.5 if qpas else 0.0
+        if qs and abs(qs - hd_real**-0.5) < 1e-12:
+            qs = 0.0  # equals the default head_dim scale; keep canonical
+        moe_kw.update(
+            alt_window=window > 0,
+            attn_softcap=float(hf.get("attn_logit_softcapping") or 0.0),
+            final_softcap=float(hf.get("final_logit_softcapping") or 0.0),
+            query_scale=qs,
+            post_norms=True,
+        )
 
     vocab = int(hf["vocab_size"])
     padded = -(-vocab // _VOCAB_MULTIPLE) * _VOCAB_MULTIPLE
@@ -135,8 +151,8 @@ def hf_config_to_llama(hf: Dict[str, Any], *, dtype=jnp.bfloat16) -> LlamaConfig
         attn_bias=bool(hf.get("attention_bias", family == "qwen2")),
         sliding_window=window,
         head_dim_opt=head_dim,
-        act_fn="gelu_tanh" if family == "gemma" else "silu",
-        scale_embed=family == "gemma",
+        act_fn="gelu_tanh" if family in _GEMMA_FAMILIES else "silu",
+        scale_embed=family in _GEMMA_FAMILIES,
         **kw,
     )
 
@@ -205,6 +221,8 @@ def _empty_tree(cfg: LlamaConfig) -> Params:
         keys += ["w_gate", "w_up", "w_down"]
     if cfg.attn_bias:
         keys += ["bq", "bk", "bv"]
+    if cfg.post_norms:
+        keys += ["post_attn_norm", "post_ffw_norm"]
     return {
         "embed": None,
         "layers": [{k: None for k in keys} for _ in range(cfg.n_layers)],
@@ -242,7 +260,7 @@ def load_hf_checkpoint(
     # spacing near 1.0 is 2^-8, which would discard the zero-centered
     # parameterization's precision; rms_norm applies f32 gains in f32
     # (HF GemmaRMSNorm's convention).
-    is_gemma = hf_cfg.get("model_type") == "gemma"
+    is_gemma = hf_cfg.get("model_type") in _GEMMA_FAMILIES
     norm_off = 1.0 if is_gemma else 0.0
     norm_dtype = jnp.float32 if is_gemma else None
 
@@ -281,7 +299,15 @@ def load_hf_checkpoint(
                 case "input_layernorm.weight":
                     put(layer, "attn_norm", arr + norm_off, transpose=False, dtype=norm_dtype)
                 case "post_attention_layernorm.weight":
+                    # Gemma-2's post_attention_layernorm is a SANDWICH norm
+                    # (applied to the attention output); everywhere else it
+                    # is the pre-MLP norm.
+                    key = "post_attn_norm" if cfg.post_norms else "mlp_norm"
+                    put(layer, key, arr + norm_off, transpose=False, dtype=norm_dtype)
+                case "pre_feedforward_layernorm.weight":
                     put(layer, "mlp_norm", arr + norm_off, transpose=False, dtype=norm_dtype)
+                case "post_feedforward_layernorm.weight":
+                    put(layer, "post_ffw_norm", arr + norm_off, transpose=False, dtype=norm_dtype)
                 case "self_attn.q_proj.weight":
                     put(layer, "wq", arr, transpose=True)
                 case "self_attn.k_proj.weight":
@@ -329,7 +355,7 @@ def load_hf_checkpoint(
 
     if params["lm_head"] is None:
         # Gemma ties by class default and omits the key from config.json.
-        tie_default = hf_cfg.get("model_type") == "gemma"
+        tie_default = hf_cfg.get("model_type") in _GEMMA_FAMILIES
         if not hf_cfg.get("tie_word_embeddings", tie_default):
             raise ValueError("checkpoint has no lm_head and tie_word_embeddings is false")
         params["lm_head"] = params["embed"].T

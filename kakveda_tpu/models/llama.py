@@ -75,6 +75,24 @@ class LlamaConfig:
     # needs NO flag — conversion stores the materialized 1+w weights.
     act_fn: str = "silu"  # "silu" | "gelu_tanh"
     scale_embed: bool = False
+    # Gemma-2 deltas: alternating per-layer sliding window (even layers
+    # slide, odd run full causal), tanh softcapping of attention scores
+    # and final logits, an explicit query scale (0 = head_dim**-0.5), and
+    # sandwich norms (post-attention / post-feedforward RMSNorms inside
+    # each residual branch).
+    alt_window: bool = False
+    attn_softcap: float = 0.0
+    final_softcap: float = 0.0
+    query_scale: float = 0.0
+    post_norms: bool = False
+
+    def layer_window(self, li: int) -> int:
+        """Effective sliding window for layer ``li`` (0 = full causal)."""
+        if not self.sliding_window:
+            return 0
+        if self.alt_window and li % 2 == 1:
+            return 0
+        return self.sliding_window
     # Sparse Mixture-of-Experts MLP (Mixtral family; models/moe.py).
     # n_experts == 0 means dense. expert_capacity_factor <= 0 means no-drop
     # dispatch (exact; decode + parity tests); positive caps each expert at
@@ -146,6 +164,9 @@ def init_params(rng: jax.Array, cfg: LlamaConfig) -> Params:
             layer["bq"] = jnp.zeros((cfg.n_heads * hd,), jnp.float32)
             layer["bk"] = jnp.zeros((cfg.n_kv_heads * hd,), jnp.float32)
             layer["bv"] = jnp.zeros((cfg.n_kv_heads * hd,), jnp.float32)
+        if cfg.post_norms:
+            layer["post_attn_norm"] = jnp.ones((cfg.d_model,), jnp.float32)
+            layer["post_ffw_norm"] = jnp.ones((cfg.d_model,), jnp.float32)
         layers.append(layer)
     return {
         "embed": dense(keys[-2], cfg.d_model, (cfg.vocab_size, cfg.d_model)),
@@ -182,6 +203,8 @@ def param_specs(cfg: LlamaConfig) -> Params:
     if cfg.attn_bias:
         # Column-parallel biases follow their projection's out axis.
         layer.update({"bq": P("tp"), "bk": P("tp"), "bv": P("tp")})
+    if cfg.post_norms:
+        layer.update({"post_attn_norm": P(), "post_ffw_norm": P()})
     return {
         "embed": P("tp", None),  # vocab-sharded table
         "layers": [dict(layer) for _ in range(cfg.n_layers)],
@@ -253,11 +276,25 @@ def qkv_proj(
         q = q + layer["bq"].astype(dt)
         k = k + layer["bk"].astype(dt)
         v = v + layer["bv"].astype(dt)
+    if cfg.query_scale:
+        # The kernels scale scores by head_dim**-0.5; fold an explicit
+        # query scale (Gemma-2's query_pre_attn_scalar**-0.5) into q so
+        # every kernel stays convention-free. Commutes with RoPE
+        # (rotations are linear).
+        q = q * jnp.asarray(cfg.query_scale * math.sqrt(hd), dt)
     return (
         q.reshape(b, s, cfg.n_heads, hd),
         k.reshape(b, s, cfg.n_kv_heads, hd),
         v.reshape(b, s, cfg.n_kv_heads, hd),
     )
+
+
+def softcap_logits(logits: jax.Array, cap: float) -> jax.Array:
+    """Gemma-2 tanh logit softcapping: cap·tanh(x/cap); identity at cap=0.
+    The ONE definition shared by every decode path."""
+    if not cap:
+        return logits
+    return cap * jnp.tanh(logits / cap)
 
 
 def rms_norm(x: jax.Array, w: jax.Array, eps: float) -> jax.Array:
@@ -346,6 +383,7 @@ def ring_attention_local(
     n_chunks: int,
     key_block: int = 2048,
     window: int = 0,
+    softcap: float = 0.0,
 ) -> jax.Array:
     """Ring attention body — runs *inside* shard_map, sequence sharded over
     ``axis_name``. Each step attends the local queries against the currently
@@ -389,6 +427,8 @@ def ring_attention_local(
             v_sub = jax.lax.slice_in_dim(v_cur, j, j + jb, axis=1)
             k_pos = src * s_l + j + jnp.arange(jb)
             scores = jnp.einsum("bqgrd,bkgd->bgrqk", q5, k_sub).astype(jnp.float32) * scale
+            if softcap:
+                scores = softcap_logits(scores, softcap)
             keep2d = q_pos[:, None] >= k_pos[None, :]
             if window:
                 keep2d &= (q_pos[:, None] - k_pos[None, :]) < window
@@ -429,10 +469,12 @@ def _attention_block(
     sin: jax.Array,
     mesh: Optional[Mesh],
     cp_axis: Optional[str],
+    li: int = 0,
 ) -> jax.Array:
     b, s, _ = x.shape
     hd = cfg.head_dim
     dt = x.dtype
+    window = cfg.layer_window(li)
 
     q, k, v = qkv_proj(x, layer, cfg, dt)
 
@@ -454,7 +496,8 @@ def _attention_block(
                 ring_attention_local,
                 axis_name=cp_axis,
                 n_chunks=n_cp,
-                window=cfg.sliding_window,
+                window=window,
+                softcap=cfg.attn_softcap,
             ),
             mesh=mesh,
             in_specs=(spec, spec, spec),
@@ -468,7 +511,7 @@ def _attention_block(
 
         attn = _gqa_xla(
             q, k.transpose(0, 2, 1, 3), v.transpose(0, 2, 1, 3), 0, None,
-            window=cfg.sliding_window,
+            window=window, softcap=cfg.attn_softcap,
         )
 
     return attn.reshape(b, s, cfg.n_heads * hd) @ wmat(layer["wo"], dt)
@@ -537,16 +580,22 @@ def forward(
 
     x = embed_tokens(params, cfg, tokens)
     aux = jnp.zeros((), jnp.float32)
-    for layer in params["layers"]:
+    for li, layer in enumerate(params["layers"]):
         h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
-        x = x + _attention_block(h, layer, cfg, cos, sin, mesh, cp_axis)
+        attn = _attention_block(h, layer, cfg, cos, sin, mesh, cp_axis, li)
+        if "post_attn_norm" in layer:  # Gemma-2 sandwich norm
+            attn = rms_norm(attn, layer["post_attn_norm"], cfg.norm_eps)
+        x = x + attn
         h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
         m, a = mlp_block(h, layer, cfg, return_aux=True)
+        if "post_ffw_norm" in layer:
+            m = rms_norm(m, layer["post_ffw_norm"], cfg.norm_eps)
         x = x + m
         aux = aux + a
 
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     logits = (x @ wmat(params["lm_head"], cfg.dtype)).astype(jnp.float32)
+    logits = softcap_logits(logits, cfg.final_softcap)
     return (logits, aux) if with_aux else logits
 
 
@@ -629,15 +678,25 @@ def decode_step(
         # Fused cached attention: Pallas flash on TPU, grouped XLA einsum
         # elsewhere — either way K/V are read once, not n_rep times, and
         # the causal mask (q_pos >= slot) also excludes unwritten slots.
-        attn = gqa_cache_attention(q, k_all, v_all, pos0, kv_valid, window=cfg.sliding_window)
-        x = x + attn.reshape(b, s, cfg.n_heads * hd) @ wmat(layer["wo"], dt)
+        attn = gqa_cache_attention(
+            q, k_all, v_all, pos0, kv_valid,
+            window=cfg.layer_window(li), softcap=cfg.attn_softcap,
+        )
+        attn = attn.reshape(b, s, cfg.n_heads * hd) @ wmat(layer["wo"], dt)
+        if "post_attn_norm" in layer:  # Gemma-2 sandwich norm
+            attn = rms_norm(attn, layer["post_attn_norm"], cfg.norm_eps)
+        x = x + attn
 
         h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
-        x = x + mlp_block(h, layer, cfg)
+        m = mlp_block(h, layer, cfg)
+        if "post_ffw_norm" in layer:
+            m = rms_norm(m, layer["post_ffw_norm"], cfg.norm_eps)
+        x = x + m
 
     if last_only:
         x = x[:, -1:, :]
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     logits = (x @ wmat(params["lm_head"], cfg.dtype)).astype(jnp.float32)
+    logits = softcap_logits(logits, cfg.final_softcap)
     new_cache = {"pos": pos0 + s, "k": new_k, "v": new_v}
     return logits, new_cache

@@ -94,9 +94,15 @@ def _stage_apply(x: jax.Array, stage_layers: Params, cfg: LlamaConfig, cos, sin)
 
     def layer_step(h, layer):
         a = rms_norm(h, layer["attn_norm"], cfg.norm_eps)
-        h = h + _attention_block(a, layer, cfg, cos, sin, None, None)
+        attn = _attention_block(a, layer, cfg, cos, sin, None, None)
+        if "post_attn_norm" in layer:  # Gemma-2 sandwich norm
+            attn = rms_norm(attn, layer["post_attn_norm"], cfg.norm_eps)
+        h = h + attn
         a = rms_norm(h, layer["mlp_norm"], cfg.norm_eps)
-        h = h + mlp_block(a, layer, cfg)
+        m = mlp_block(a, layer, cfg)
+        if "post_ffw_norm" in layer:
+            m = rms_norm(m, layer["post_ffw_norm"], cfg.norm_eps)
+        h = h + m
         return h, None
 
     x, _ = jax.lax.scan(layer_step, x, stage_layers)
@@ -119,6 +125,11 @@ def pp_forward(
     b, s = tokens.shape
     if b % n_micro:
         raise ValueError(f"batch {b} does not split into {n_micro} microbatches")
+    if cfg.alt_window:
+        # The stage body scans layers with ONE static attention mask;
+        # Gemma-2's per-layer alternating window would need per-iteration
+        # masks. Serve those models on the tp/ep paths instead.
+        raise ValueError("pipeline parallelism does not support alternating windows")
     mb = b // n_micro
 
     positions = jnp.broadcast_to(jnp.arange(s), (mb, s))
@@ -175,9 +186,10 @@ def pp_forward(
 
     y = y_mb.reshape(b, s, -1)
     y = rms_norm(y, stacked["final_norm"], cfg.norm_eps)
-    from kakveda_tpu.models.llama import wmat
+    from kakveda_tpu.models.llama import softcap_logits, wmat
 
-    return (y @ wmat(stacked["lm_head"], cfg.dtype)).astype(jnp.float32)
+    logits = (y @ wmat(stacked["lm_head"], cfg.dtype)).astype(jnp.float32)
+    return softcap_logits(logits, cfg.final_softcap)
 
 
 def place_stacked(stacked: Params, cfg: LlamaConfig, mesh: Mesh) -> Params:

@@ -110,6 +110,7 @@ def _step_chunk_jit(params, cfg: LlamaConfig, cache, last, slot_pos, kv_valid, p
         mlp_block,
         qkv_proj,
         rms_norm,
+        softcap_logits,
         wmat,
     )
 
@@ -132,11 +133,14 @@ def _step_chunk_jit(params, cfg: LlamaConfig, cache, last, slot_pos, kv_valid, p
         # Validity for reads this step: slots < own write index, plus self.
         # A sliding window (Mistral) folds in here — the query's slot index
         # IS slot_pos[b], so the band is (slot_pos − window, slot_pos].
+        # Alternating windows (Gemma-2) need per-layer masks.
         col = jnp.arange(max_len)[None, :]
-        step_valid = kv_valid & (col <= slot_pos[:, None])
+        base_valid = kv_valid & (col <= slot_pos[:, None])
+        windowed_valid = base_valid
         if cfg.sliding_window:
-            step_valid &= col > (slot_pos[:, None] - cfg.sliding_window)
+            windowed_valid = base_valid & (col > (slot_pos[:, None] - cfg.sliding_window))
         for li in range(cfg.n_layers):
+            step_valid = windowed_valid if cfg.layer_window(li) else base_valid
             layer = params["layers"][li]
             h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
             dt = h.dtype
@@ -155,12 +159,21 @@ def _step_chunk_jit(params, cfg: LlamaConfig, cache, last, slot_pos, kv_valid, p
             new_v.append(v_all)
             # Attention over the slot's valid prefix. pos0=max_len makes the
             # kernel's scalar causal mask a no-op; step_valid does the work.
-            attn = gqa_cache_attention(q, k_all, v_all, jnp.asarray(max_len), step_valid)
-            x = x + attn.reshape(b, 1, cfg.n_heads * hd) @ wmat(layer["wo"], dt)
+            attn = gqa_cache_attention(
+                q, k_all, v_all, jnp.asarray(max_len), step_valid, softcap=cfg.attn_softcap
+            )
+            attn = attn.reshape(b, 1, cfg.n_heads * hd) @ wmat(layer["wo"], dt)
+            if "post_attn_norm" in layer:
+                attn = rms_norm(attn, layer["post_attn_norm"], cfg.norm_eps)
+            x = x + attn
             h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
-            x = x + mlp_block(h, layer, cfg)
+            m = mlp_block(h, layer, cfg)
+            if "post_ffw_norm" in layer:
+                m = rms_norm(m, layer["post_ffw_norm"], cfg.norm_eps)
+            x = x + m
         x = rms_norm(x, params["final_norm"], cfg.norm_eps)
         logits = (x @ wmat(params["lm_head"], cfg.dtype)).astype(jnp.float32)[:, -1, :]
+        logits = softcap_logits(logits, cfg.final_softcap)
         if cfg.effective_vocab is not None:
             logits = logits.at[:, cfg.effective_vocab :].set(-jnp.inf)
         return (new_k, new_v, logits, slot_pos + 1, rng), nxt

@@ -354,6 +354,80 @@ def test_gemma_decode_cache_matches_full_forward(tmp_path):
     assert greedy_cached == toks[len(prompt) :]
 
 
+def _make_gemma2_checkpoint(path, *, vocab=256, seed=0, sliding_window=8):
+    hf_cfg = transformers.Gemma2Config(
+        vocab_size=vocab,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=4,  # even+odd layers: alternation must matter
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        head_dim=32,
+        max_position_embeddings=128,
+        rms_norm_eps=1e-6,
+        rope_theta=10000.0,
+        sliding_window=sliding_window,
+        attn_logit_softcapping=20.0,
+        final_logit_softcapping=10.0,
+        query_pre_attn_scalar=64,  # != head_dim (32) → explicit query scale
+    )
+    torch.manual_seed(seed)
+    model = transformers.Gemma2ForCausalLM(hf_cfg).eval()
+    with torch.no_grad():
+        for lyr in model.model.layers:
+            for nm in (
+                lyr.input_layernorm,
+                lyr.post_attention_layernorm,
+                lyr.pre_feedforward_layernorm,
+                lyr.post_feedforward_layernorm,
+            ):
+                nm.weight.normal_(0.0, 0.2)
+        model.model.norm.weight.normal_(0.0, 0.2)
+    model.save_pretrained(str(path), safe_serialization=True)
+    return model
+
+
+def test_logit_parity_gemma2(tmp_path):
+    # Gemma-2: alternating sliding windows over a 17-token sequence
+    # (window 8 < seq, so even/odd layers genuinely mask differently),
+    # attention + final softcapping, query_pre_attn_scalar != head_dim,
+    # sandwich post-norms.
+    model = _make_gemma2_checkpoint(tmp_path, seed=14)
+    params, cfg = _assert_parity(model, tmp_path, vocab=256)
+    assert cfg.alt_window and cfg.sliding_window == 8
+    assert cfg.attn_softcap == 20.0 and cfg.final_softcap == 10.0
+    assert cfg.post_norms and abs(cfg.query_scale - 64**-0.5) < 1e-12
+    assert "post_attn_norm" in params["layers"][0]
+
+
+def test_gemma2_decode_cache_matches_full_forward(tmp_path):
+    _make_gemma2_checkpoint(tmp_path, seed=15)
+    params, cfg = load_hf_checkpoint(str(tmp_path), param_dtype=jnp.float32)
+    prompt = list(range(5, 25))  # long enough that the window alternation bites
+    greedy_cached = generate_tokens(params, cfg, prompt, max_new_tokens=8)
+
+    toks = list(prompt)
+    for _ in range(8):
+        logits = forward(params, cfg, jnp.asarray([toks]))
+        toks.append(int(jnp.argmax(logits[0, -1])))
+    assert greedy_cached == toks[len(prompt) :]
+
+
+def test_gemma2_continuous_batcher_matches_solo(tmp_path):
+    """The continuous batcher's per-slot validity masks must implement the
+    alternating window + softcaps + sandwich norms identically to the
+    plain cached decode."""
+    from kakveda_tpu.models.serving import ContinuousBatcher
+
+    _make_gemma2_checkpoint(tmp_path, seed=16)
+    params, cfg = load_hf_checkpoint(str(tmp_path), param_dtype=jnp.float32)
+    prompts = [list(range(4, 18)), list(range(30, 39)), list(range(50, 70))]
+    cb = ContinuousBatcher(params, cfg, batch_slots=3, max_len=96)
+    cont = cb.run_all(prompts, max_new_tokens=8)
+    solo = [generate_tokens(params, cfg, p, max_new_tokens=8) for p in prompts]
+    assert cont == solo
+
+
 def test_rejects_unknown_family_and_unknown_scaling(tmp_path):
     with pytest.raises(ValueError, match="model_type"):
         hf_config_to_llama({"model_type": "gpt2", "vocab_size": 8})

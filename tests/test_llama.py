@@ -65,6 +65,22 @@ def test_ring_attention_matches_dense(params):
     np.testing.assert_allclose(np.asarray(dense), np.asarray(ring), atol=2e-3, rtol=1e-3)
 
 
+def test_ring_attention_softcap_and_alt_window(params):
+    """Gemma-2-style attn softcapping + alternating per-layer windows must
+    survive the ring (cp) path identically to the dense path — the softcap
+    is applied inside every ring sub-block before masking."""
+    import dataclasses
+
+    cfg2 = dataclasses.replace(CFG, attn_softcap=5.0, sliding_window=8, alt_window=True)
+    mesh = create_mesh("dp:1,cp:4,tp:2")
+    tokens = jnp.asarray(np.random.default_rng(3).integers(3, 259, size=(2, 32)), jnp.int32)
+    dense = forward(params, cfg2, tokens)
+    # the deltas must actually change the logits vs the plain config
+    assert np.abs(np.asarray(dense) - np.asarray(forward(params, CFG, tokens))).max() > 1e-3
+    ring = forward(params, cfg2, tokens, mesh=mesh, cp_axis="cp")
+    np.testing.assert_allclose(np.asarray(dense), np.asarray(ring), atol=2e-3, rtol=1e-3)
+
+
 def test_decode_matches_forward(params):
     """Prefill+incremental decode logits must match the full forward pass."""
     rng = np.random.default_rng(2)

@@ -75,6 +75,29 @@ def test_pp_forward_moe_layers():
     np.testing.assert_allclose(got, want, atol=1e-4, rtol=1e-4)
 
 
+def test_pp_forward_gemma2_style_layers():
+    """Sandwich post-norms, uniform sliding window, softcaps and query
+    scale all ride the stage scan; alternating windows are rejected loudly
+    (the scan applies one static mask)."""
+    import dataclasses
+
+    cfg = dataclasses.replace(
+        CFG, post_norms=True, sliding_window=6, attn_softcap=5.0,
+        final_softcap=10.0, query_scale=0.1,
+    )
+    params = init_params(jax.random.PRNGKey(6), cfg)
+    toks = _tokens(4, 12, seed=6)
+    want = np.asarray(forward(params, cfg, toks))
+    mesh = create_mesh("pp:2")
+    stacked = place_stacked(split_stages(params, cfg, 2), cfg, mesh)
+    got = np.asarray(pp_forward(stacked, cfg, toks, mesh, n_micro=2))
+    np.testing.assert_allclose(got, want, atol=1e-4, rtol=1e-4)
+
+    alt = dataclasses.replace(cfg, alt_window=True)
+    with pytest.raises(ValueError, match="alternating"):
+        pp_forward(place_stacked(split_stages(params, alt, 2), alt, mesh), alt, toks, mesh)
+
+
 def test_pp_train_step_reduces_loss():
     mesh = create_mesh("pp:2")
     step, init_state = make_pp_train_step(CFG, mesh, n_micro=2, lr=1e-2)
