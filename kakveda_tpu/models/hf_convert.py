@@ -50,23 +50,24 @@ __all__ = ["hf_config_to_llama", "load_hf_checkpoint", "shard_params"]
 _VOCAB_MULTIPLE = 8
 
 
-_SUPPORTED_FAMILIES = ("llama", "mistral", "qwen2", "mixtral", "gemma", "gemma2")
+_SUPPORTED_FAMILIES = ("llama", "mistral", "qwen2", "qwen3", "mixtral", "gemma", "gemma2")
 _GEMMA_FAMILIES = ("gemma", "gemma2")
 
 
 def hf_config_to_llama(hf: Dict[str, Any], *, dtype=jnp.bfloat16) -> LlamaConfig:
     """Map an HF ``config.json`` dict to :class:`LlamaConfig`.
 
-    Six HF families share the Llama block structure and load onto the one
+    Seven HF families share the Llama block structure and load onto the one
     runtime: ``llama`` (the baseline), ``mistral`` (adds a sliding attention
     window and sometimes an explicit head_dim), ``qwen2`` (adds q/k/v
-    projection biases), ``mixtral`` (replaces the dense MLP with a sparse
-    MoE block — models/moe.py), ``gemma`` (GeGLU activation, sqrt(d_model)
-    embedding scale, explicit head_dim; its (1+w) RMSNorm convention is
-    absorbed at conversion by storing the materialized 1+w weights), and
-    ``gemma2`` (gemma plus alternating per-layer sliding windows,
-    attention/final logit softcapping, an explicit query scale, and
-    sandwich post-norms). Anything else is rejected loudly."""
+    projection biases), ``qwen3`` (per-head q/k RMSNorm), ``mixtral``
+    (replaces the dense MLP with a sparse MoE block — models/moe.py),
+    ``gemma`` (GeGLU activation, sqrt(d_model) embedding scale, explicit
+    head_dim; its (1+w) RMSNorm convention is absorbed at conversion by
+    storing the materialized 1+w weights), and ``gemma2`` (gemma plus
+    alternating per-layer sliding windows, attention/final logit
+    softcapping, an explicit query scale, and sandwich post-norms).
+    Anything else is rejected loudly."""
     family = hf.get("model_type") or "llama"
     if family not in _SUPPORTED_FAMILIES:
         raise ValueError(
@@ -86,11 +87,11 @@ def hf_config_to_llama(hf: Dict[str, Any], *, dtype=jnp.bfloat16) -> LlamaConfig
         )
 
     # Sliding-window attention: Mistral applies it whenever the config sets
-    # one; Qwen2 additionally gates on use_sliding_window and only past
-    # max_window_layers — the mixed-layer form has no support here, so it
-    # fails loudly rather than serving wrong attention.
+    # one; Qwen2/Qwen3 additionally gate on use_sliding_window and only
+    # past max_window_layers — the mixed-layer form has no support here, so
+    # it fails loudly rather than serving wrong attention.
     window = int(hf.get("sliding_window") or 0)
-    if family == "qwen2" and window:
+    if family in ("qwen2", "qwen3") and window:
         if not hf.get("use_sliding_window", False):
             window = 0
         else:
@@ -125,6 +126,20 @@ def hf_config_to_llama(hf: Dict[str, Any], *, dtype=jnp.bfloat16) -> LlamaConfig
         qs = qpas**-0.5 if qpas else 0.0
         if qs and abs(qs - hd_real**-0.5) < 1e-12:
             qs = 0.0  # equals the default head_dim scale; keep canonical
+        # The runtime assumes gemma2's default alternation (even layers
+        # slide, odd full). A config that spells out a DIFFERENT
+        # layer_types pattern must fail loudly, not serve wrong masks.
+        lt = hf.get("layer_types")
+        if lt is not None and window:
+            want = [
+                "sliding_attention" if i % 2 == 0 else "full_attention"
+                for i in range(int(hf["num_hidden_layers"]))
+            ]
+            if list(lt) != want:
+                raise ValueError(
+                    "gemma2 layer_types deviates from the even-slide/odd-full "
+                    "alternation; this pattern is not supported"
+                )
         moe_kw.update(
             alt_window=window > 0,
             attn_softcap=float(hf.get("attn_logit_softcapping") or 0.0),
@@ -149,6 +164,7 @@ def hf_config_to_llama(hf: Dict[str, Any], *, dtype=jnp.bfloat16) -> LlamaConfig
         norm_eps=float(hf.get("rms_norm_eps", 1e-5)),
         dtype=dtype,
         attn_bias=bool(hf.get("attention_bias", family == "qwen2")),
+        qk_norm=family == "qwen3",
         sliding_window=window,
         head_dim_opt=head_dim,
         act_fn="gelu_tanh" if family in _GEMMA_FAMILIES else "silu",
@@ -223,6 +239,8 @@ def _empty_tree(cfg: LlamaConfig) -> Params:
         keys += ["bq", "bk", "bv"]
     if cfg.post_norms:
         keys += ["post_attn_norm", "post_ffw_norm"]
+    if cfg.qk_norm:
+        keys += ["q_norm", "k_norm"]
     return {
         "embed": None,
         "layers": [{k: None for k in keys} for _ in range(cfg.n_layers)],
@@ -328,6 +346,10 @@ def load_hf_checkpoint(
                     put(layer, "w_up", arr, transpose=True)
                 case "mlp.down_proj.weight":
                     put(layer, "w_down", arr, transpose=True)
+                case "self_attn.q_norm.weight":
+                    put(layer, "q_norm", arr, transpose=False)
+                case "self_attn.k_norm.weight":
+                    put(layer, "k_norm", arr, transpose=False)
                 case "self_attn.rotary_emb.inv_freq":
                     pass  # derived, not a parameter
                 case "block_sparse_moe.gate.weight":

@@ -85,6 +85,8 @@ class LlamaConfig:
     final_softcap: float = 0.0
     query_scale: float = 0.0
     post_norms: bool = False
+    # Qwen3-style per-head q/k RMSNorm (over head_dim, applied pre-RoPE).
+    qk_norm: bool = False
 
     def layer_window(self, li: int) -> int:
         """Effective sliding window for layer ``li`` (0 = full causal)."""
@@ -167,6 +169,9 @@ def init_params(rng: jax.Array, cfg: LlamaConfig) -> Params:
         if cfg.post_norms:
             layer["post_attn_norm"] = jnp.ones((cfg.d_model,), jnp.float32)
             layer["post_ffw_norm"] = jnp.ones((cfg.d_model,), jnp.float32)
+        if cfg.qk_norm:
+            layer["q_norm"] = jnp.ones((hd,), jnp.float32)
+            layer["k_norm"] = jnp.ones((hd,), jnp.float32)
         layers.append(layer)
     return {
         "embed": dense(keys[-2], cfg.d_model, (cfg.vocab_size, cfg.d_model)),
@@ -205,6 +210,8 @@ def param_specs(cfg: LlamaConfig) -> Params:
         layer.update({"bq": P("tp"), "bk": P("tp"), "bv": P("tp")})
     if cfg.post_norms:
         layer.update({"post_attn_norm": P(), "post_ffw_norm": P()})
+    if cfg.qk_norm:
+        layer.update({"q_norm": P(), "k_norm": P()})
     return {
         "embed": P("tp", None),  # vocab-sharded table
         "layers": [dict(layer) for _ in range(cfg.n_layers)],
@@ -282,11 +289,14 @@ def qkv_proj(
         # every kernel stays convention-free. Commutes with RoPE
         # (rotations are linear).
         q = q * jnp.asarray(cfg.query_scale * math.sqrt(hd), dt)
-    return (
-        q.reshape(b, s, cfg.n_heads, hd),
-        k.reshape(b, s, cfg.n_kv_heads, hd),
-        v.reshape(b, s, cfg.n_kv_heads, hd),
-    )
+    q = q.reshape(b, s, cfg.n_heads, hd)
+    k = k.reshape(b, s, cfg.n_kv_heads, hd)
+    v = v.reshape(b, s, cfg.n_kv_heads, hd)
+    if "q_norm" in layer:
+        # Qwen3 per-head q/k RMSNorm over head_dim, pre-RoPE.
+        q = rms_norm(q, layer["q_norm"], cfg.norm_eps)
+        k = rms_norm(k, layer["k_norm"], cfg.norm_eps)
+    return q, k, v
 
 
 def softcap_logits(logits: jax.Array, cap: float) -> jax.Array:

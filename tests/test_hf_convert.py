@@ -413,6 +413,43 @@ def test_gemma2_decode_cache_matches_full_forward(tmp_path):
     assert greedy_cached == toks[len(prompt) :]
 
 
+def test_logit_parity_qwen3_qk_norm(tmp_path):
+    # Qwen3: per-head q/k RMSNorm over head_dim (pre-RoPE), no qkv bias,
+    # explicit head_dim.
+    hf_cfg = transformers.Qwen3Config(
+        vocab_size=256,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        head_dim=32,
+        max_position_embeddings=128,
+        rms_norm_eps=1e-6,
+        rope_theta=10000.0,
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(17)
+    model = transformers.Qwen3ForCausalLM(hf_cfg).eval()
+    with torch.no_grad():  # randomize the qk norms (ones-init hides bugs)
+        for lyr in model.model.layers:
+            lyr.self_attn.q_norm.weight.normal_(1.0, 0.2)
+            lyr.self_attn.k_norm.weight.normal_(1.0, 0.2)
+    model.save_pretrained(str(tmp_path), safe_serialization=True)
+    params, cfg = _assert_parity(model, tmp_path, vocab=256)
+    assert cfg.qk_norm and not cfg.attn_bias and cfg.head_dim == 32
+    assert params["layers"][0]["q_norm"].shape == (32,)
+
+    # cached decode inherits the qk-norm path
+    prompt = list(range(5, 19))
+    greedy_cached = generate_tokens(params, cfg, prompt, max_new_tokens=6)
+    toks = list(prompt)
+    for _ in range(6):
+        logits = forward(params, cfg, jnp.asarray([toks]))
+        toks.append(int(jnp.argmax(logits[0, -1])))
+    assert greedy_cached == toks[len(prompt) :]
+
+
 def test_gemma2_continuous_batcher_matches_solo(tmp_path):
     """The continuous batcher's per-slot validity masks must implement the
     alternating window + softcaps + sandwich norms identically to the
