@@ -454,8 +454,14 @@ def setup(app: web.Application) -> None:
             # target "model" (runtime default) or "model:<name>" (explicit
             # model — reference's per-model variant, app.py:1226-1258).
             chosen = target.split(":", 1)[1] if target.startswith("model:") else None
-            gen = await off_loop(lambda: ctx.model.generate(prompt, model=chosen))
-            text, meta = gen.text, gen.meta
+            try:
+                gen = await off_loop(lambda: ctx.model.generate(prompt, model=chosen))
+                text, meta = gen.text, gen.meta
+            except ValueError as e:
+                # Stale/hand-crafted model label (multi-model runtimes
+                # reject unknown labels): surface in the UI, not a 500.
+                text = f"model error: {e}"
+                meta = {"provider": "error", "model": chosen, "error": str(e)}
         t1 = time.time()
         tokens_in, tokens_out = estimate_tokens(prompt), estimate_tokens(text)
         ctx.db.execute(

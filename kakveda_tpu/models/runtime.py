@@ -152,11 +152,76 @@ class OllamaRuntime:
             return res
 
 
+class MultiModelRuntime:
+    """Several HF checkpoints behind one runtime, routed by model label —
+    the playground's model dropdown with real choices, like the reference's
+    Ollama installed-model list (services/dashboard/app.py:286-306) but
+    served in-process on the TPU.
+
+    ``KAKVEDA_HF_CKPTS=/ckpts/llama-1b:/ckpts/qwen3-0.6b`` (os.pathsep-
+    separated checkpoint directories; any supported family — see
+    models/hf_convert.py). Labels are the directory basenames; the first
+    entry is the default model. Checkpoints load LAZILY on first use, so
+    only models actually requested occupy HBM — co-residency is the
+    operator's budget call (each loaded model holds its full weight set
+    on device)."""
+
+    name = "tpu"
+
+    def __init__(self, paths: list, *, quant: Optional[str] = None, mesh=None):
+        import threading
+
+        if not paths:
+            raise ValueError("MultiModelRuntime needs at least one checkpoint path")
+        if quant not in (None, "none", "int8"):
+            # Fail at construction (= server startup), not on the first
+            # generate request — parity with LlamaRuntime.from_env.
+            raise ValueError(f"unknown quant mode {quant!r} (int8|none)")
+        self._paths = {os.path.basename(os.path.normpath(p)): p for p in paths}
+        if len(self._paths) != len(paths):
+            raise ValueError(f"duplicate checkpoint basenames in {paths}")
+        self._default = os.path.basename(os.path.normpath(paths[0]))
+        self._quant = quant
+        self._mesh = mesh
+        self._loaded: Dict[str, Any] = {}
+        self._load_lock = threading.Lock()
+
+    def _get(self, model: Optional[str]):
+        label = model or self._default
+        if label not in self._paths:
+            raise ValueError(
+                f"unknown model {label!r}; available: {sorted(self._paths)}"
+            )
+        if label not in self._loaded:
+            # Serialize checkpoint loads: concurrent first requests for one
+            # label would otherwise each convert + upload the full weight
+            # set (double HBM for the same model).
+            with self._load_lock:
+                if label not in self._loaded:
+                    from kakveda_tpu.models.generate import LlamaRuntime
+
+                    self._loaded[label] = LlamaRuntime.from_hf(
+                        self._paths[label], mesh=self._mesh, quant=self._quant
+                    )
+        return self._loaded[label]
+
+    def list_models(self) -> list:
+        return list(self._paths)
+
+    def generate(self, prompt: str, *, model: Optional[str] = None, max_tokens: int = 256) -> GenerateResult:
+        return self._get(model).generate(prompt, model=model, max_tokens=max_tokens)
+
+    def generate_batch(self, prompts: list, *, model: Optional[str] = None, max_tokens: int = 256) -> list:
+        return self._get(model).generate_batch(prompts, model=model, max_tokens=max_tokens)
+
+
 _RUNTIMES: Dict[str, Any] = {}
 
 
 def get_runtime(name: Optional[str] = None) -> ModelRuntime:
-    """Resolve the configured runtime (KAKVEDA_MODEL_RUNTIME: stub|tpu|ollama)."""
+    """Resolve the configured runtime (KAKVEDA_MODEL_RUNTIME: stub|tpu|ollama).
+    With ``KAKVEDA_HF_CKPTS`` set, ``tpu`` serves every listed checkpoint
+    behind one multi-model router."""
     name = (name or os.environ.get("KAKVEDA_MODEL_RUNTIME", "stub")).lower()
     if name in _RUNTIMES:
         return _RUNTIMES[name]
@@ -165,9 +230,16 @@ def get_runtime(name: Optional[str] = None) -> ModelRuntime:
     elif name == "ollama":
         rt = OllamaRuntime()
     elif name == "tpu":
-        from kakveda_tpu.models.generate import LlamaRuntime
+        multi = os.environ.get("KAKVEDA_HF_CKPTS")
+        if multi:
+            quant = os.environ.get("KAKVEDA_QUANT") or None
+            rt = MultiModelRuntime(
+                [p for p in multi.split(os.pathsep) if p], quant=quant
+            )
+        else:
+            from kakveda_tpu.models.generate import LlamaRuntime
 
-        rt = LlamaRuntime.from_env()
+            rt = LlamaRuntime.from_env()
     else:
         raise ValueError(f"unknown model runtime: {name!r}")
     _RUNTIMES[name] = rt

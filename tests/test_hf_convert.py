@@ -465,6 +465,50 @@ def test_gemma2_continuous_batcher_matches_solo(tmp_path):
     assert cont == solo
 
 
+def test_multi_model_runtime_routes_by_label(tmp_path, monkeypatch):
+    """KAKVEDA_HF_CKPTS serves several checkpoints behind one runtime:
+    labels come from dir basenames, loading is lazy, and generation routes
+    to the right weights (different checkpoints → different logits)."""
+    import os
+
+    from kakveda_tpu.models.runtime import MultiModelRuntime, get_runtime, list_models
+
+    d1 = tmp_path / "llama-tiny"
+    d2 = tmp_path / "qwen3-tiny"
+    _make_hf_checkpoint(d1, vocab=256, seed=20)
+    _write_tokenizer(d1)
+    hf_cfg = transformers.Qwen3Config(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=32, max_position_embeddings=128, tie_word_embeddings=False,
+    )
+    torch.manual_seed(21)
+    transformers.Qwen3ForCausalLM(hf_cfg).eval().save_pretrained(str(d2), safe_serialization=True)
+    _write_tokenizer(d2)
+
+    rt = MultiModelRuntime([str(d1), str(d2)])
+    assert rt.list_models() == ["llama-tiny", "qwen3-tiny"]
+    assert not rt._loaded  # lazy: nothing loaded yet
+    r1 = rt.generate("the quick brown fox", model="llama-tiny", max_tokens=6)
+    assert set(rt._loaded) == {"llama-tiny"}  # only the requested model
+    r2 = rt.generate("the quick brown fox", model="qwen3-tiny", max_tokens=6)
+    assert r1.meta["provider"] == r2.meta["provider"] == "tpu"
+    # default model = first entry
+    rd = rt.generate("the quick brown fox", max_tokens=6)
+    assert rd.text == r1.text
+    with pytest.raises(ValueError, match="available"):
+        rt.generate("x", model="nope")
+
+    # env-driven construction through the registry
+    monkeypatch.setenv("KAKVEDA_MODEL_RUNTIME", "tpu")
+    monkeypatch.setenv("KAKVEDA_HF_CKPTS", os.pathsep.join([str(d1), str(d2)]))
+    from kakveda_tpu.models import runtime as runtime_mod
+
+    monkeypatch.setattr(runtime_mod, "_RUNTIMES", {})
+    env_rt = get_runtime()
+    assert list_models(env_rt) == ["llama-tiny", "qwen3-tiny"]
+
+
 def test_rejects_unknown_family_and_unknown_scaling(tmp_path):
     with pytest.raises(ValueError, match="model_type"):
         hf_config_to_llama({"model_type": "gpt2", "vocab_size": 8})
