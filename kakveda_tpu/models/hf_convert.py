@@ -50,24 +50,27 @@ __all__ = ["hf_config_to_llama", "load_hf_checkpoint", "shard_params"]
 _VOCAB_MULTIPLE = 8
 
 
-_SUPPORTED_FAMILIES = ("llama", "mistral", "qwen2", "qwen3", "mixtral", "gemma", "gemma2")
+_SUPPORTED_FAMILIES = (
+    "llama", "mistral", "qwen2", "qwen3", "mixtral", "gemma", "gemma2", "phi3",
+)
 _GEMMA_FAMILIES = ("gemma", "gemma2")
 
 
 def hf_config_to_llama(hf: Dict[str, Any], *, dtype=jnp.bfloat16) -> LlamaConfig:
     """Map an HF ``config.json`` dict to :class:`LlamaConfig`.
 
-    Seven HF families share the Llama block structure and load onto the one
+    Eight HF families share the Llama block structure and load onto the one
     runtime: ``llama`` (the baseline), ``mistral`` (adds a sliding attention
     window and sometimes an explicit head_dim), ``qwen2`` (adds q/k/v
     projection biases), ``qwen3`` (per-head q/k RMSNorm), ``mixtral``
     (replaces the dense MLP with a sparse MoE block — models/moe.py),
     ``gemma`` (GeGLU activation, sqrt(d_model) embedding scale, explicit
     head_dim; its (1+w) RMSNorm convention is absorbed at conversion by
-    storing the materialized 1+w weights), and ``gemma2`` (gemma plus
+    storing the materialized 1+w weights), ``gemma2`` (gemma plus
     alternating per-layer sliding windows, attention/final logit
-    softcapping, an explicit query scale, and sandwich post-norms).
-    Anything else is rejected loudly."""
+    softcapping, an explicit query scale, and sandwich post-norms), and
+    ``phi3`` (fused qkv / gate_up projections split at conversion, longrope
+    per-dim frequency scaling). Anything else is rejected loudly."""
     family = hf.get("model_type") or "llama"
     if family not in _SUPPORTED_FAMILIES:
         raise ValueError(
@@ -77,14 +80,58 @@ def hf_config_to_llama(hf: Dict[str, Any], *, dtype=jnp.bfloat16) -> LlamaConfig
     kw: Dict[str, Any] = {}
     if rope:
         rtype = rope.get("rope_type") or rope.get("type")
-        if rtype != "llama3":
-            raise ValueError(f"unsupported rope_scaling type: {rtype!r} (only 'llama3')")
-        kw = dict(
-            rope_factor=float(rope["factor"]),
-            rope_low_freq_factor=float(rope.get("low_freq_factor", 1.0)),
-            rope_high_freq_factor=float(rope.get("high_freq_factor", 4.0)),
-            rope_original_max_len=int(rope.get("original_max_position_embeddings", 8192)),
-        )
+        if rtype == "llama3":
+            kw = dict(
+                rope_factor=float(rope["factor"]),
+                rope_low_freq_factor=float(rope.get("low_freq_factor", 1.0)),
+                rope_high_freq_factor=float(rope.get("high_freq_factor", 4.0)),
+                rope_original_max_len=int(rope.get("original_max_position_embeddings", 8192)),
+            )
+        elif rtype == "longrope" and family == "phi3":
+            # Phi-3 longrope: per-dim frequency divisors, selected
+            # DYNAMICALLY at runtime (short while the sequence fits the
+            # original pretraining context, long beyond it — HF's
+            # dynamic_rope_update semantics); the cos/sin attention
+            # scaling is static from the config's extension ratio.
+            import math as _math
+
+            orig = int(
+                hf.get("original_max_position_embeddings")
+                or hf.get("max_position_embeddings")
+            )
+            maxp = int(hf.get("max_position_embeddings", orig))
+            scale = maxp / orig
+            if rope.get("attention_factor") is not None:
+                # HF honors an explicit attention_factor verbatim.
+                attn_scale = float(rope["attention_factor"])
+            else:
+                attn_scale = (
+                    _math.sqrt(1.0 + _math.log(scale) / _math.log(orig))
+                    if scale > 1.0
+                    else 1.0
+                )
+            hd_half = (
+                int(hf.get("head_dim") or int(hf["hidden_size"]) // int(hf["num_attention_heads"]))
+                // 2
+            )
+            short = tuple(float(f) for f in rope["short_factor"])
+            long = tuple(float(f) for f in rope["long_factor"])
+            if len(short) != hd_half or len(long) != hd_half:
+                raise ValueError(
+                    f"longrope factor lists must have head_dim//2={hd_half} entries "
+                    f"(got {len(short)}/{len(long)})"
+                )
+            kw = dict(
+                rope_dim_factors=short,
+                rope_dim_factors_long=long,
+                rope_original_max_len=orig,
+                rope_attn_scaling=attn_scale,
+            )
+        else:
+            raise ValueError(
+                f"unsupported rope_scaling type: {rtype!r} "
+                "(llama3; longrope for phi3)"
+            )
 
     # Sliding-window attention: Mistral applies it whenever the config sets
     # one; Qwen2/Qwen3 additionally gate on use_sliding_window and only
@@ -350,6 +397,17 @@ def load_hf_checkpoint(
                     put(layer, "q_norm", arr, transpose=False)
                 case "self_attn.k_norm.weight":
                     put(layer, "k_norm", arr, transpose=False)
+                case "self_attn.qkv_proj.weight":
+                    # Phi-3 fuses q/k/v into one [nq+2·nkv, d_model] matrix.
+                    nq = cfg.n_heads * cfg.head_dim
+                    nkv = cfg.n_kv_heads * cfg.head_dim
+                    put(layer, "wq", arr[:nq], transpose=True)
+                    put(layer, "wk", arr[nq : nq + nkv], transpose=True)
+                    put(layer, "wv", arr[nq + nkv :], transpose=True)
+                case "mlp.gate_up_proj.weight":
+                    # Phi-3 fuses gate/up into one [2·d_ff, d_model] matrix.
+                    put(layer, "w_gate", arr[: cfg.d_ff], transpose=True)
+                    put(layer, "w_up", arr[cfg.d_ff :], transpose=True)
                 case "self_attn.rotary_emb.inv_freq":
                     pass  # derived, not a parameter
                 case "block_sparse_moe.gate.weight":

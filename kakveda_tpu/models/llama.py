@@ -87,6 +87,14 @@ class LlamaConfig:
     post_norms: bool = False
     # Qwen3-style per-head q/k RMSNorm (over head_dim, applied pre-RoPE).
     qk_norm: bool = False
+    # Phi-3 longrope: per-dimension inverse-frequency divisors (length
+    # head_dim/2, tuples so the config stays hashable). HF semantics are
+    # DYNAMIC: short factors while the running sequence fits the original
+    # pretraining context (rope_original_max_len), long factors once it
+    # exceeds it; the attention scaling on cos/sin is static.
+    rope_dim_factors: tuple = ()  # short factors
+    rope_dim_factors_long: tuple = ()
+    rope_attn_scaling: float = 1.0
 
     def layer_window(self, li: int) -> int:
         """Effective sliding window for layer ``li`` (0 = full causal)."""
@@ -329,6 +337,26 @@ def _rope_freqs(cfg: LlamaConfig, positions: jax.Array) -> Tuple[jax.Array, jax.
     """
     half = cfg.head_dim // 2
     inv = 1.0 / (cfg.rope_theta ** (jnp.arange(half, dtype=jnp.float32) / half))
+    if cfg.rope_dim_factors:
+        # Phi-3 longrope: per-dim frequency divisors. HF switches short →
+        # long factors once the running sequence exceeds the original
+        # pretraining context (seq_len = max position + 1). The regime is
+        # selected PER ROW — batch-global selection (what a shared HF
+        # inv_freq buffer does) would let one long sequence flip its
+        # co-batched neighbors' rotations, breaking batched-vs-solo
+        # parity in the continuous batcher. A traced select; no retrace.
+        inv_short = inv / jnp.asarray(cfg.rope_dim_factors, jnp.float32)
+        if cfg.rope_dim_factors_long:
+            inv_long = inv / jnp.asarray(cfg.rope_dim_factors_long, jnp.float32)
+            long_row = (
+                jnp.max(positions, axis=-1, keepdims=True) + 1
+                > cfg.rope_original_max_len
+            )  # [..., 1]
+            ang = positions[..., None].astype(jnp.float32)
+            ang = jnp.where(long_row[..., None], ang * inv_long, ang * inv_short)
+            scale = cfg.rope_attn_scaling
+            return jnp.cos(ang) * scale, jnp.sin(ang) * scale
+        inv = inv_short
     if cfg.rope_factor != 1.0:
         wavelen = 2.0 * math.pi / inv
         low_wl = cfg.rope_original_max_len / cfg.rope_low_freq_factor
@@ -339,6 +367,11 @@ def _rope_freqs(cfg: LlamaConfig, positions: jax.Array) -> Tuple[jax.Array, jax.
         blended = (1.0 - smooth) * inv / cfg.rope_factor + smooth * inv
         inv = jnp.where(wavelen > low_wl, inv / cfg.rope_factor, jnp.where(wavelen < high_wl, inv, blended))
     ang = positions[..., None].astype(jnp.float32) * inv  # [..., half]
+    if cfg.rope_attn_scaling != 1.0:
+        return (
+            jnp.cos(ang) * cfg.rope_attn_scaling,
+            jnp.sin(ang) * cfg.rope_attn_scaling,
+        )
     return jnp.cos(ang), jnp.sin(ang)
 
 

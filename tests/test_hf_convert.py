@@ -465,6 +465,87 @@ def test_gemma2_continuous_batcher_matches_solo(tmp_path):
     assert cont == solo
 
 
+def _make_phi3_checkpoint(path, *, vocab=256, seed=0, long_context=False):
+    rope = None
+    if long_context:
+        rng = np.random.default_rng(seed)
+        rope = {
+            "type": "longrope",
+            # head_dim/2 = 8 per-dim divisors
+            "short_factor": [float(x) for x in rng.uniform(1.0, 1.5, 8)],
+            "long_factor": [float(x) for x in rng.uniform(2.0, 6.0, 8)],
+        }
+    hf_cfg = transformers.Phi3Config(
+        vocab_size=vocab,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=256 if long_context else 128,
+        original_max_position_embeddings=128,
+        rms_norm_eps=1e-5,
+        rope_theta=10000.0,
+        rope_scaling=rope,
+        sliding_window=None,
+        tie_word_embeddings=False,
+        pad_token_id=0,  # default 32000 exceeds the tiny vocab
+    )
+    torch.manual_seed(seed)
+    model = transformers.Phi3ForCausalLM(hf_cfg).eval()
+    model.save_pretrained(str(path), safe_serialization=True)
+    return model
+
+
+def test_logit_parity_phi3_fused_projections(tmp_path):
+    # Phi-3: fused qkv_proj and gate_up_proj split at conversion.
+    model = _make_phi3_checkpoint(tmp_path, seed=22)
+    params, cfg = _assert_parity(model, tmp_path, vocab=256)
+    assert params["layers"][0]["wq"].shape == (64, 64)
+    assert params["layers"][0]["w_gate"].shape == (64, 128)
+
+
+def test_logit_parity_phi3_longrope(tmp_path):
+    # longrope with max_position > original: HF switches short → long
+    # factors dynamically when the sequence exceeds the original context;
+    # attention scaling is static. Parity in BOTH regimes.
+    model = _make_phi3_checkpoint(tmp_path, seed=23, long_context=True)
+    params, cfg = _assert_parity(model, tmp_path, vocab=256)  # short regime (17 tokens)
+    assert len(cfg.rope_dim_factors) == len(cfg.rope_dim_factors_long) == 8
+    assert cfg.rope_attn_scaling > 1.0 and cfg.rope_original_max_len == 128
+
+    # long regime: 140 tokens > original_max (128)
+    ids = np.random.default_rng(5).integers(0, 256, size=(1, 140), dtype=np.int64)
+    ours = np.asarray(forward(params, cfg, jnp.asarray(ids)))[:, :, :256]
+    np.testing.assert_allclose(ours, _hf_logits(model, ids), rtol=2e-4, atol=2e-4)
+
+    # cached decode inherits the scaled rope
+    prompt = list(range(5, 19))
+    greedy_cached = generate_tokens(params, cfg, prompt, max_new_tokens=6)
+    toks = list(prompt)
+    for _ in range(6):
+        logits = forward(params, cfg, jnp.asarray([toks]))
+        toks.append(int(jnp.argmax(logits[0, -1])))
+    assert greedy_cached == toks[len(prompt) :]
+
+
+def test_phi3_longrope_mixed_regime_batch_matches_solo(tmp_path):
+    """One slot deep in the long-rope regime must not flip a co-batched
+    short sequence's rotations: regime selection is per row, so
+    continuous-batched output equals solo output for both."""
+    from kakveda_tpu.models.serving import ContinuousBatcher
+
+    _make_phi3_checkpoint(tmp_path, seed=24, long_context=True)
+    params, cfg = load_hf_checkpoint(str(tmp_path), param_dtype=jnp.float32)
+    rng = np.random.default_rng(2)
+    long_p = [int(x) for x in rng.integers(5, 250, 126)]  # crosses 128 while decoding
+    short_p = [int(x) for x in rng.integers(5, 250, 12)]
+    solo = [generate_tokens(params, cfg, p, max_new_tokens=10) for p in (long_p, short_p)]
+    cb = ContinuousBatcher(params, cfg, batch_slots=2, max_len=256)
+    cont = cb.run_all([long_p, short_p], max_new_tokens=10)
+    assert cont == solo
+
+
 def test_multi_model_runtime_routes_by_label(tmp_path, monkeypatch):
     """KAKVEDA_HF_CKPTS serves several checkpoints behind one runtime:
     labels come from dir basenames, loading is lazy, and generation routes
