@@ -1,9 +1,12 @@
-"""In-tree JAX Llama — the framework's on-pod model runtime.
+"""In-tree JAX transformer core — the framework's on-pod model runtime.
 
 Replaces the reference's HTTP hop to an external Ollama daemon
-(reference: services/dashboard/app.py:1182-1258) with a Llama-family
-transformer that lives on the same TPU mesh as the GFKB index, so the
-scenario runner, playground and LLM failure-classifier share the pod.
+(reference: services/dashboard/app.py:1182-1258) with a transformer that
+lives on the same TPU mesh as the GFKB index, so the scenario runner,
+playground and LLM failure-classifier share the pod. One forward serves
+eight HF families — Llama, Mistral, Qwen2/3, Gemma/Gemma-2, Phi-3,
+Mixtral — every family delta a flag on :class:`LlamaConfig`
+(models/hf_convert.py maps the checkpoints).
 
 Design is TPU-first, pure functional JAX (no framework classes):
 
