@@ -68,6 +68,17 @@ def _sample_top_p(rng, logits, temperature, top_p):
 
 
 
+def _prefill_width(plen: int, chunk: int) -> int:
+    """Prompt-window width under chunked prefill: unchanged when the prompt
+    fits one chunk (prefill takes the single-shot branch — rounding would
+    only widen it), else the next chunk multiple. The ONE place the
+    rounding lives: DecodeSession's pack width and the runtime's cache
+    sizing must agree on it."""
+    if chunk <= 0 or plen <= chunk:
+        return plen
+    return -(-plen // chunk) * chunk
+
+
 def _bucket_len(need: int, cap: int) -> int:
     """Power-of-two cache window ≥ need (capped): the window is part of the
     compiled program signature, so exact-fit lengths would recompile for
@@ -78,15 +89,17 @@ def _bucket_len(need: int, cap: int) -> int:
     return min(ml, cap)
 
 
-def _pack_prompts(prompts: list[list[int]], ml: int):
+def _pack_prompts(prompts: list[list[int]], ml: int, plen: Optional[int] = None):
     """Left-pad a ragged prompt batch into the shared convention used by
     every batched decode path: (tokens [B, plen] i32, kv_valid [B, ml]
     bool, pos_offset [B] i32, plen). Sequence i's real tokens occupy
     columns [off_i, plen); its cache rows [off_i, …) are valid and its
-    RoPE positions are slot − off_i."""
+    RoPE positions are slot − off_i. An explicit ``plen`` (≥ the longest
+    prompt) widens the left padding — chunked prefill uses it to round
+    the prompt window to a chunk multiple."""
     import numpy as onp
 
-    plen = max(len(p) for p in prompts)
+    plen = max(plen or 0, max(len(p) for p in prompts))
     toks = onp.zeros((len(prompts), plen), onp.int32)
     valid = onp.zeros((len(prompts), ml), bool)
     offsets = onp.zeros((len(prompts),), onp.int32)
@@ -275,6 +288,45 @@ def _prefill_jit(params, cfg: LlamaConfig, prompt, cache, kv_valid, pos_offset):
     return last, cache
 
 
+def prefill(
+    params,
+    cfg: LlamaConfig,
+    prompt: jax.Array,  # [B, P] left-padded
+    cache,
+    kv_valid,
+    pos_offset,
+    chunk: int = 0,
+):
+    """Prefill the cache for a left-padded prompt batch; returns
+    (last_logits [B, V] vocab-masked, cache).
+
+    ``chunk`` > 0 processes the prompt in fixed-size pieces, each an
+    incremental ``decode_step`` over the shared cache — bounding the
+    per-dispatch activation footprint to O(chunk · d_ff) instead of
+    O(P · d_ff). That is the long-context prefill path: a 128k-token
+    prompt's single-shot [P, d_ff] transients run to gigabytes, while
+    chunked prefill compiles ONE chunk-shaped program reused P/chunk
+    times. The prompt width must be a chunk multiple — callers widen the
+    left padding via ``_pack_prompts(..., plen=rounded)`` so the caller's
+    kv_valid/pos_offset mirrors stay authoritative. Exactness: cached
+    attention makes chunked and single-shot prefill mathematically
+    identical; parity is tested.
+    """
+    if chunk <= 0 or prompt.shape[1] <= chunk:
+        return _prefill_jit(params, cfg, prompt, cache, kv_valid, pos_offset)
+    if prompt.shape[1] % chunk:
+        raise ValueError(
+            f"chunked prefill needs the prompt width ({prompt.shape[1]}) padded "
+            f"to a multiple of chunk={chunk} (pack with plen=rounded)"
+        )
+    last = None
+    for s in range(0, prompt.shape[1], chunk):
+        last, cache = _prefill_jit(
+            params, cfg, prompt[:, s : s + chunk], cache, kv_valid, pos_offset
+        )
+    return last, cache
+
+
 def _generate_fused_jit(
     params,
     cfg: LlamaConfig,
@@ -382,6 +434,7 @@ class DecodeSession:
         max_len: Optional[int] = None,
         temperature: float = 0.0,
         rng: Optional[jax.Array] = None,
+        prefill_chunk: int = 0,
     ):
         import numpy as onp
 
@@ -391,18 +444,28 @@ class DecodeSession:
         self.chunk_steps = chunk_steps
         self.greedy = temperature <= 0.0
         self.temperature = jnp.asarray(max(temperature, 1e-6), jnp.float32)
-        plen = max(len(p) for p in prompts)
+        natural_plen = max(len(p) for p in prompts)
+        # Chunked prefill widens the prompt window to a chunk multiple
+        # (extra left padding) so every piece hits one compiled shape; the
+        # padding can consume up to chunk−1 decode slots when the window
+        # is capped at max_seq_len — the price of retrace-free prefill.
+        plen = _prefill_width(natural_plen, prefill_chunk)
         ml = max_len or cfg.max_seq_len
         if plen + 1 > ml:
-            raise ValueError(f"longest prompt ({plen}) leaves no room (max_len={ml})")
+            raise ValueError(
+                f"longest prompt ({natural_plen}"
+                + (f", padded to {plen} for prefill_chunk={prefill_chunk}" if plen != natural_plen else "")
+                + f") leaves no room (max_len={ml})"
+            )
         bsz = len(prompts)
-        toks, valid, offsets, _ = _pack_prompts(prompts, ml)
+        toks, valid, offsets, plen = _pack_prompts(prompts, ml, plen=plen)
         self.kv_valid = jnp.asarray(valid)
         self.pos_offset = jnp.asarray(offsets)
         self.rng = rng if rng is not None else jax.random.PRNGKey(0)
         cache = init_cache(cfg, batch=bsz, max_len=ml)
-        self._last, self._cache = _prefill_jit(
-            params, cfg, jnp.asarray(toks), cache, self.kv_valid, self.pos_offset
+        self._last, self._cache = prefill(
+            params, cfg, jnp.asarray(toks), cache, self.kv_valid, self.pos_offset,
+            chunk=prefill_chunk,
         )
         self._pos = plen
         self._max_len = ml
@@ -525,8 +588,14 @@ class LlamaRuntime:
         import numpy as onp
 
         plen = max(len(p) for p in ids)
+        # Long-context serving: KAKVEDA_PREFILL_CHUNK=512 (etc.) prefills
+        # in fixed pieces, bounding activation memory per dispatch.
+        pchunk = int(os.environ.get("KAKVEDA_PREFILL_CHUNK", "0"))
+        plen = _prefill_width(plen, pchunk)
         ml = _bucket_len(plen + max_tokens + 1, self.cfg.max_seq_len)
-        sess = DecodeSession(self.params, self.cfg, ids, chunk_steps=16, max_len=ml)
+        sess = DecodeSession(
+            self.params, self.cfg, ids, chunk_steps=16, max_len=ml, prefill_chunk=pchunk
+        )
         eos = self.tokenizer.EOS
         outs: list[list[int]] = [[] for _ in ids]
         done = [False] * len(ids)

@@ -95,3 +95,53 @@ def test_per_request_temperature():
     g2, s2 = run(seed=2)
     assert g1 == solo and g2 == solo  # greedy slot unaffected by sampling
     assert s1 != s2  # sampled slot actually samples (different keys differ)
+
+
+def test_chunked_prefill_matches_single_shot():
+    """Chunked prefill (fixed-size pieces over the shared cache) must
+    produce byte-identical greedy generations to single-shot prefill —
+    including ragged prompt lengths that force extra left padding to
+    reach the chunk multiple."""
+    from kakveda_tpu.models.generate import DecodeSession
+
+    params = init_params(jax.random.PRNGKey(3), CFG)
+    prompts = [list(range(5, 32)), list(range(40, 49))]  # 27 and 9 tokens
+
+    def run(prefill_chunk):
+        sess = DecodeSession(
+            params, CFG, prompts, chunk_steps=8, max_len=96, prefill_chunk=prefill_chunk
+        )
+        out = []
+        while True:
+            c = sess.step_chunk(8)
+            if c is None or len(out) >= 2:
+                break
+            out.append(c)
+        return np.concatenate(out, axis=1).tolist()
+
+    single = run(0)
+    for chunk in (8, 16):  # 27 rounds up to 32; both chunk sizes divide it
+        assert run(chunk) == single, chunk
+
+
+def test_chunked_prefill_env_serving_path(monkeypatch):
+    """KAKVEDA_PREFILL_CHUNK routes LlamaRuntime serving through chunked
+    prefill with identical output; a prompt that fits one chunk skips the
+    rounding entirely (no widened window)."""
+    from kakveda_tpu.models.generate import LlamaRuntime, _prefill_width
+
+    cfg = LlamaConfig(
+        vocab_size=264, d_model=32, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=48, max_seq_len=256, dtype=jax.numpy.float32,
+    )
+    rt = LlamaRuntime(cfg=cfg, seed=0)
+    monkeypatch.delenv("KAKVEDA_PREFILL_CHUNK", raising=False)
+    plain = rt.generate("hello failure world, summarize with citations", max_tokens=12)
+    monkeypatch.setenv("KAKVEDA_PREFILL_CHUNK", "8")
+    chunked = rt.generate("hello failure world, summarize with citations", max_tokens=12)
+    assert chunked.text == plain.text
+
+    # short prompts never round (a chunk >= the prompt would only pad)
+    assert _prefill_width(10, 512) == 10
+    assert _prefill_width(513, 512) == 1024
+    assert _prefill_width(27, 8) == 32
