@@ -258,6 +258,19 @@ def _measure_ingest(n_traces: int, batch: int) -> tuple[float, float]:
     return ours_tps, seq_tps
 
 
+def _preset_cfg(preset: str):
+    """Model shapes for the serving benches: '1b' = TinyLlama-1.1B (the
+    small-open-checkpoint serving class), else the tiny CPU smoke shape."""
+    from kakveda_tpu.models.llama import LlamaConfig
+
+    if preset == "1b":
+        return LlamaConfig(
+            vocab_size=32000, d_model=2048, n_layers=22, n_heads=32,
+            n_kv_heads=4, d_ff=5632, max_seq_len=2048,
+        )
+    return LlamaConfig(max_seq_len=1024)
+
+
 def _measure_decode(preset: str, bsz: int, steps: int) -> dict:
     """Serving bench: prefill + steady-state decode tokens/sec and MFU on
     the current chip, via the fused whole-generation-on-device decode
@@ -272,16 +285,9 @@ def _measure_decode(preset: str, bsz: int, steps: int) -> dict:
     import jax.numpy as jnp
 
     from kakveda_tpu.models.generate import _generate_fused_jit
-    from kakveda_tpu.models.llama import LlamaConfig, init_cache, init_params
+    from kakveda_tpu.models.llama import init_cache, init_params
 
-    if preset == "1b":
-        # TinyLlama-1.1B shapes — the "small open checkpoint" serving class.
-        cfg = LlamaConfig(
-            vocab_size=32000, d_model=2048, n_layers=22, n_heads=32,
-            n_kv_heads=4, d_ff=5632, max_seq_len=2048,
-        )
-    else:
-        cfg = LlamaConfig()  # tiny — CPU smoke shape
+    cfg = _preset_cfg(preset)
 
     params = jax.tree.map(
         lambda x: x.astype(jnp.bfloat16), init_params(jax.random.PRNGKey(0), cfg)
@@ -405,16 +411,10 @@ def _measure_spec(preset: str, steps: int, k: int) -> dict:
     import jax.numpy as jnp
 
     from kakveda_tpu.models.generate import generate_tokens_fused
-    from kakveda_tpu.models.llama import LlamaConfig, init_params
+    from kakveda_tpu.models.llama import init_params
     from kakveda_tpu.models.speculative import generate_tokens_speculative
 
-    if preset == "1b":
-        cfg = LlamaConfig(
-            vocab_size=32000, d_model=2048, n_layers=22, n_heads=32,
-            n_kv_heads=4, d_ff=5632, max_seq_len=2048,
-        )
-    else:
-        cfg = LlamaConfig(max_seq_len=1024)
+    cfg = _preset_cfg(preset)
 
     params = jax.tree.map(
         lambda x: x.astype(jnp.bfloat16), init_params(jax.random.PRNGKey(0), cfg)

@@ -30,6 +30,7 @@ from kakveda_tpu.models.llama import (
     decode_step,
     init_cache,
     init_params,
+    mask_pad_vocab,
 )
 from kakveda_tpu.models.runtime import GenerateResult
 from kakveda_tpu.models.tokenizer import ByteTokenizer
@@ -44,10 +45,7 @@ def _last_logits(logits: jax.Array, cfg: LlamaConfig) -> jax.Array:
     """[B, S, V] -> [B, V] of the final position, with padded-vocab columns
     masked out so sampling can never emit a token the tokenizer lacks
     (converted checkpoints pad vocab to a TP-friendly multiple)."""
-    last = logits[:, -1, :]
-    if cfg.effective_vocab is not None:
-        last = last.at[:, cfg.effective_vocab :].set(-jnp.inf)
-    return last
+    return mask_pad_vocab(logits[:, -1, :], cfg)
 
 
 @jax.jit
@@ -268,9 +266,7 @@ def _decode_chunk_jit(
             params, cfg, nxt[:, None].astype(jnp.int32), cache,
             kv_valid=kv_valid, pos_offset=pos_offset,
         )
-        nl = logits[:, -1, :]
-        if cfg.effective_vocab is not None:
-            nl = nl.at[:, cfg.effective_vocab :].set(-jnp.inf)
+        nl = mask_pad_vocab(logits[:, -1, :], cfg)
         return (nl, cache, rng), nxt
 
     (last, cache, rng), toks = jax.lax.scan(body, (last, cache, rng), None, length=n_steps)
@@ -282,9 +278,7 @@ def _prefill_jit(params, cfg: LlamaConfig, prompt, cache, kv_valid, pos_offset):
     logits, cache = decode_step(
         params, cfg, prompt, cache, kv_valid=kv_valid, pos_offset=pos_offset, last_only=True
     )
-    last = logits[:, -1, :]
-    if cfg.effective_vocab is not None:
-        last = last.at[:, cfg.effective_vocab :].set(-jnp.inf)
+    last = mask_pad_vocab(logits[:, -1, :], cfg)
     return last, cache
 
 

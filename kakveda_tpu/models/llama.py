@@ -310,6 +310,15 @@ def qkv_proj(
     return q, k, v
 
 
+def mask_pad_vocab(logits: jax.Array, cfg: LlamaConfig) -> jax.Array:
+    """−inf the padded vocab columns (converted checkpoints pad the table
+    to a TP-friendly multiple; sampling must never emit a pad id). Works
+    on [..., V]; identity when the vocab isn't padded."""
+    if cfg.effective_vocab is None:
+        return logits
+    return logits.at[..., cfg.effective_vocab :].set(-jnp.inf)
+
+
 def softcap_logits(logits: jax.Array, cap: float) -> jax.Array:
     """Gemma-2 tanh logit softcapping: cap·tanh(x/cap); identity at cap=0.
     The ONE definition shared by every decode path."""

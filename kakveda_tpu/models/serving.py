@@ -52,7 +52,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from kakveda_tpu.models.llama import LlamaConfig, Params, decode_step, init_cache
+from kakveda_tpu.models.llama import (
+    LlamaConfig,
+    Params,
+    decode_step,
+    init_cache,
+    mask_pad_vocab,
+)
 
 
 @partial(jax.jit, static_argnames=("cfg",), donate_argnums=(2,))
@@ -81,9 +87,7 @@ def _admit_jit(params, cfg: LlamaConfig, cache, last, prompt, slot, kv_valid, po
         jax.lax.dynamic_update_slice(cv, sv, (slot, 0, 0, 0))
         for cv, sv in zip(cache["v"], scratch["v"])
     ]
-    nl = logits[:, -1, :]
-    if cfg.effective_vocab is not None:
-        nl = nl.at[:, cfg.effective_vocab :].set(-jnp.inf)
+    nl = mask_pad_vocab(logits[:, -1, :], cfg)
     last = jax.lax.dynamic_update_slice(last, nl, (slot, 0))
     # cache["pos"] is managed per-slot on host (slot positions differ);
     # the batch cache carries pos=0 and step passes explicit positions.
@@ -174,8 +178,7 @@ def _step_chunk_jit(params, cfg: LlamaConfig, cache, last, slot_pos, kv_valid, p
         x = rms_norm(x, params["final_norm"], cfg.norm_eps)
         logits = (x @ wmat(params["lm_head"], cfg.dtype)).astype(jnp.float32)[:, -1, :]
         logits = softcap_logits(logits, cfg.final_softcap)
-        if cfg.effective_vocab is not None:
-            logits = logits.at[:, cfg.effective_vocab :].set(-jnp.inf)
+        logits = mask_pad_vocab(logits, cfg)
         return (new_k, new_v, logits, slot_pos + 1, rng), nxt
 
     (ck, cv, last, slot_pos, rng), toks = jax.lax.scan(

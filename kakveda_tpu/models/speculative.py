@@ -49,7 +49,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from kakveda_tpu.models.llama import LlamaConfig, Params, decode_step, init_cache
+from kakveda_tpu.models.llama import (
+    LlamaConfig,
+    Params,
+    decode_step,
+    init_cache,
+    mask_pad_vocab,
+)
 
 
 @partial(jax.jit, static_argnames=("cfg", "k", "max_new"))
@@ -67,10 +73,6 @@ def _spec_decode_jit(
     ``buf[0, plen : n_decided]`` are the generated tokens (≥ max_new of
     them decided; caller truncates)."""
     ml = buf.shape[1]
-    eff = cfg.effective_vocab
-
-    def mask_vocab(lg):
-        return lg.at[:, eff:].set(-jnp.inf) if eff is not None else lg
 
     def cond(carry):
         _, _, _, vl, _ = carry
@@ -78,7 +80,7 @@ def _spec_decode_jit(
 
     def body(carry):
         buf, cache, last, vl, rounds = carry
-        t0 = jnp.argmax(mask_vocab(last), axis=-1)[0]  # token for slot vl
+        t0 = jnp.argmax(mask_pad_vocab(last, cfg), axis=-1)[0]  # token for slot vl
         buf = jax.lax.dynamic_update_index_in_dim(buf, t0[None], vl, axis=1)
 
         # Bigram prompt lookup over decided slots [1, vl]: most recent j
@@ -100,7 +102,7 @@ def _spec_decode_jit(
         chunk = jnp.concatenate([t0[None][None], draft], axis=1)  # [1, k+1]
         cache = dict(cache, pos=vl)
         logits, cache = decode_step(params, cfg, chunk, cache)
-        preds = jnp.argmax(mask_vocab(logits.reshape(k + 1, -1)), axis=-1)  # [k+1]
+        preds = jnp.argmax(mask_pad_vocab(logits.reshape(k + 1, -1), cfg), axis=-1)  # [k+1]
 
         # Longest accepted draft prefix: d_{i+1} must equal the model's
         # greedy continuation p_i given everything before it.

@@ -51,6 +51,17 @@ def _hf_logits(model, ids: np.ndarray) -> np.ndarray:
         return model(torch.from_numpy(ids)).logits.float().numpy()
 
 
+def _assert_decode_matches_forward(params, cfg, prompt, n=8):
+    """Cached greedy decode must reproduce the full forward's argmax chain —
+    the serving-path invariant every converted family asserts."""
+    greedy_cached = generate_tokens(params, cfg, prompt, max_new_tokens=n)
+    toks = list(prompt)
+    for _ in range(n):
+        logits = forward(params, cfg, jnp.asarray([toks]))
+        toks.append(int(jnp.argmax(logits[0, -1])))
+    assert greedy_cached == toks[len(prompt) :]
+
+
 def _assert_parity(model, path, *, vocab):
     params, cfg = load_hf_checkpoint(str(path), param_dtype=jnp.float32)
     rng = np.random.default_rng(0)
@@ -104,14 +115,7 @@ def test_decode_cache_matches_full_forward(tmp_path):
     # full forward on a converted checkpoint, not just on random init.
     _make_hf_checkpoint(tmp_path, vocab=256, seed=4)
     params, cfg = load_hf_checkpoint(str(tmp_path), param_dtype=jnp.float32)
-    prompt = list(range(5, 20))
-    greedy_cached = generate_tokens(params, cfg, prompt, max_new_tokens=8)
-
-    toks = list(prompt)
-    for _ in range(8):
-        logits = forward(params, cfg, jnp.asarray([toks]))
-        toks.append(int(jnp.argmax(logits[0, -1])))
-    assert greedy_cached == toks[len(prompt) :]
+    _assert_decode_matches_forward(params, cfg, list(range(5, 20)), n=8)
 
 
 def _write_tokenizer(path, *, vocab_target=256):
@@ -230,27 +234,13 @@ def test_mistral_decode_cache_matches_full_forward(tmp_path):
     # cancel); greedy parity with the parity-tested full forward proves it.
     _make_mistral_checkpoint(tmp_path, sliding_window=8, seed=8)
     params, cfg = load_hf_checkpoint(str(tmp_path), param_dtype=jnp.float32)
-    prompt = list(range(5, 25))
-    greedy_cached = generate_tokens(params, cfg, prompt, max_new_tokens=8)
-
-    toks = list(prompt)
-    for _ in range(8):
-        logits = forward(params, cfg, jnp.asarray([toks]))
-        toks.append(int(jnp.argmax(logits[0, -1])))
-    assert greedy_cached == toks[len(prompt) :]
+    _assert_decode_matches_forward(params, cfg, list(range(5, 25)), n=8)
 
 
 def test_qwen2_decode_cache_matches_full_forward(tmp_path):
     _make_qwen2_checkpoint(tmp_path, seed=9)
     params, cfg = load_hf_checkpoint(str(tmp_path), param_dtype=jnp.float32)
-    prompt = list(range(3, 17))
-    greedy_cached = generate_tokens(params, cfg, prompt, max_new_tokens=8)
-
-    toks = list(prompt)
-    for _ in range(8):
-        logits = forward(params, cfg, jnp.asarray([toks]))
-        toks.append(int(jnp.argmax(logits[0, -1])))
-    assert greedy_cached == toks[len(prompt) :]
+    _assert_decode_matches_forward(params, cfg, list(range(3, 17)), n=8)
 
 
 def _make_mixtral_checkpoint(path, *, vocab=256, seed=0):
@@ -344,14 +334,7 @@ def test_logit_parity_gemma(tmp_path):
 def test_gemma_decode_cache_matches_full_forward(tmp_path):
     _make_gemma_checkpoint(tmp_path, seed=13)
     params, cfg = load_hf_checkpoint(str(tmp_path), param_dtype=jnp.float32)
-    prompt = list(range(5, 21))
-    greedy_cached = generate_tokens(params, cfg, prompt, max_new_tokens=8)
-
-    toks = list(prompt)
-    for _ in range(8):
-        logits = forward(params, cfg, jnp.asarray([toks]))
-        toks.append(int(jnp.argmax(logits[0, -1])))
-    assert greedy_cached == toks[len(prompt) :]
+    _assert_decode_matches_forward(params, cfg, list(range(5, 21)), n=8)
 
 
 def _make_gemma2_checkpoint(path, *, vocab=256, seed=0, sliding_window=8):
@@ -403,14 +386,8 @@ def test_logit_parity_gemma2(tmp_path):
 def test_gemma2_decode_cache_matches_full_forward(tmp_path):
     _make_gemma2_checkpoint(tmp_path, seed=15)
     params, cfg = load_hf_checkpoint(str(tmp_path), param_dtype=jnp.float32)
-    prompt = list(range(5, 25))  # long enough that the window alternation bites
-    greedy_cached = generate_tokens(params, cfg, prompt, max_new_tokens=8)
-
-    toks = list(prompt)
-    for _ in range(8):
-        logits = forward(params, cfg, jnp.asarray([toks]))
-        toks.append(int(jnp.argmax(logits[0, -1])))
-    assert greedy_cached == toks[len(prompt) :]
+    # prompt long enough that the window alternation bites
+    _assert_decode_matches_forward(params, cfg, list(range(5, 25)), n=8)
 
 
 def test_logit_parity_qwen3_qk_norm(tmp_path):
@@ -441,13 +418,7 @@ def test_logit_parity_qwen3_qk_norm(tmp_path):
     assert params["layers"][0]["q_norm"].shape == (32,)
 
     # cached decode inherits the qk-norm path
-    prompt = list(range(5, 19))
-    greedy_cached = generate_tokens(params, cfg, prompt, max_new_tokens=6)
-    toks = list(prompt)
-    for _ in range(6):
-        logits = forward(params, cfg, jnp.asarray([toks]))
-        toks.append(int(jnp.argmax(logits[0, -1])))
-    assert greedy_cached == toks[len(prompt) :]
+    _assert_decode_matches_forward(params, cfg, list(range(5, 19)), n=6)
 
 
 def test_gemma2_continuous_batcher_matches_solo(tmp_path):
@@ -520,13 +491,7 @@ def test_logit_parity_phi3_longrope(tmp_path):
     np.testing.assert_allclose(ours, _hf_logits(model, ids), rtol=2e-4, atol=2e-4)
 
     # cached decode inherits the scaled rope
-    prompt = list(range(5, 19))
-    greedy_cached = generate_tokens(params, cfg, prompt, max_new_tokens=6)
-    toks = list(prompt)
-    for _ in range(6):
-        logits = forward(params, cfg, jnp.asarray([toks]))
-        toks.append(int(jnp.argmax(logits[0, -1])))
-    assert greedy_cached == toks[len(prompt) :]
+    _assert_decode_matches_forward(params, cfg, list(range(5, 19)), n=6)
 
 
 def test_phi3_longrope_mixed_regime_batch_matches_solo(tmp_path):
