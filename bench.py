@@ -268,7 +268,7 @@ def _preset_cfg(preset: str):
             vocab_size=32000, d_model=2048, n_layers=22, n_heads=32,
             n_kv_heads=4, d_ff=5632, max_seq_len=2048,
         )
-    return LlamaConfig(max_seq_len=1024)
+    return LlamaConfig()
 
 
 def _measure_decode(preset: str, bsz: int, steps: int) -> dict:
@@ -608,13 +608,7 @@ def _measure_mixed_decode(n: int, dim: int, preset: str, chunk_steps: int) -> di
     idle_p50 = warn_p50(30)
 
     # --- generation storm ------------------------------------------------
-    if preset == "1b":
-        cfg = LlamaConfig(
-            vocab_size=32000, d_model=2048, n_layers=22, n_heads=32,
-            n_kv_heads=4, d_ff=5632, max_seq_len=2048,
-        )
-    else:
-        cfg = LlamaConfig()
+    cfg = _preset_cfg(preset)
     params = jax.tree.map(
         lambda x: x.astype(jnp.bfloat16), init_params(jax.random.PRNGKey(0), cfg)
     )
@@ -937,13 +931,7 @@ def _bench_continuous(backend: str) -> dict:
     from kakveda_tpu.models.serving import ContinuousBatcher
 
     preset = os.environ.get("KAKVEDA_BENCH_DECODE_PRESET", "1b" if backend == "tpu" else "tiny")
-    if preset == "1b":
-        cfg = LlamaConfig(
-            vocab_size=32000, d_model=2048, n_layers=22, n_heads=32,
-            n_kv_heads=4, d_ff=5632, max_seq_len=2048,
-        )
-    else:
-        cfg = LlamaConfig()
+    cfg = _preset_cfg(preset)
     params = jax.tree.map(
         lambda x: x.astype(jnp.bfloat16), init_params(jax.random.PRNGKey(0), cfg)
     )

@@ -24,3 +24,20 @@ import pytest  # noqa: E402
 @pytest.fixture()
 def tmp_data_dir(tmp_path):
     return tmp_path / "data"
+
+
+def assert_decode_matches_forward(params, cfg, prompt, n=8):
+    """Cached greedy decode must reproduce the full forward's argmax chain —
+    the serving-path invariant every model family asserts. Shared by
+    test_hf_convert.py and test_moe.py (import from conftest)."""
+    import jax.numpy as jnp
+
+    from kakveda_tpu.models.generate import generate_tokens
+    from kakveda_tpu.models.llama import forward
+
+    greedy_cached = generate_tokens(params, cfg, prompt, max_new_tokens=n)
+    toks = list(prompt)
+    for _ in range(n):
+        logits = forward(params, cfg, jnp.asarray([toks]))
+        toks.append(int(jnp.argmax(logits[0, -1])))
+    assert greedy_cached == toks[len(prompt) :]

@@ -18,6 +18,8 @@ import pytest
 import jax
 import jax.numpy as jnp
 
+from conftest import assert_decode_matches_forward
+
 from kakveda_tpu.models.generate import LlamaRuntime, generate_tokens
 from kakveda_tpu.models.hf_convert import hf_config_to_llama, load_hf_checkpoint
 from kakveda_tpu.models.llama import forward
@@ -49,17 +51,6 @@ def _make_hf_checkpoint(path, *, vocab=256, tie=False, rope_scaling=None, seed=0
 def _hf_logits(model, ids: np.ndarray) -> np.ndarray:
     with torch.no_grad():
         return model(torch.from_numpy(ids)).logits.float().numpy()
-
-
-def _assert_decode_matches_forward(params, cfg, prompt, n=8):
-    """Cached greedy decode must reproduce the full forward's argmax chain —
-    the serving-path invariant every converted family asserts."""
-    greedy_cached = generate_tokens(params, cfg, prompt, max_new_tokens=n)
-    toks = list(prompt)
-    for _ in range(n):
-        logits = forward(params, cfg, jnp.asarray([toks]))
-        toks.append(int(jnp.argmax(logits[0, -1])))
-    assert greedy_cached == toks[len(prompt) :]
 
 
 def _assert_parity(model, path, *, vocab):
@@ -115,7 +106,7 @@ def test_decode_cache_matches_full_forward(tmp_path):
     # full forward on a converted checkpoint, not just on random init.
     _make_hf_checkpoint(tmp_path, vocab=256, seed=4)
     params, cfg = load_hf_checkpoint(str(tmp_path), param_dtype=jnp.float32)
-    _assert_decode_matches_forward(params, cfg, list(range(5, 20)), n=8)
+    assert_decode_matches_forward(params, cfg, list(range(5, 20)), n=8)
 
 
 def _write_tokenizer(path, *, vocab_target=256):
@@ -234,13 +225,13 @@ def test_mistral_decode_cache_matches_full_forward(tmp_path):
     # cancel); greedy parity with the parity-tested full forward proves it.
     _make_mistral_checkpoint(tmp_path, sliding_window=8, seed=8)
     params, cfg = load_hf_checkpoint(str(tmp_path), param_dtype=jnp.float32)
-    _assert_decode_matches_forward(params, cfg, list(range(5, 25)), n=8)
+    assert_decode_matches_forward(params, cfg, list(range(5, 25)), n=8)
 
 
 def test_qwen2_decode_cache_matches_full_forward(tmp_path):
     _make_qwen2_checkpoint(tmp_path, seed=9)
     params, cfg = load_hf_checkpoint(str(tmp_path), param_dtype=jnp.float32)
-    _assert_decode_matches_forward(params, cfg, list(range(3, 17)), n=8)
+    assert_decode_matches_forward(params, cfg, list(range(3, 17)), n=8)
 
 
 def _make_mixtral_checkpoint(path, *, vocab=256, seed=0):
@@ -334,7 +325,7 @@ def test_logit_parity_gemma(tmp_path):
 def test_gemma_decode_cache_matches_full_forward(tmp_path):
     _make_gemma_checkpoint(tmp_path, seed=13)
     params, cfg = load_hf_checkpoint(str(tmp_path), param_dtype=jnp.float32)
-    _assert_decode_matches_forward(params, cfg, list(range(5, 21)), n=8)
+    assert_decode_matches_forward(params, cfg, list(range(5, 21)), n=8)
 
 
 def _make_gemma2_checkpoint(path, *, vocab=256, seed=0, sliding_window=8):
@@ -387,7 +378,7 @@ def test_gemma2_decode_cache_matches_full_forward(tmp_path):
     _make_gemma2_checkpoint(tmp_path, seed=15)
     params, cfg = load_hf_checkpoint(str(tmp_path), param_dtype=jnp.float32)
     # prompt long enough that the window alternation bites
-    _assert_decode_matches_forward(params, cfg, list(range(5, 25)), n=8)
+    assert_decode_matches_forward(params, cfg, list(range(5, 25)), n=8)
 
 
 def test_logit_parity_qwen3_qk_norm(tmp_path):
@@ -418,7 +409,7 @@ def test_logit_parity_qwen3_qk_norm(tmp_path):
     assert params["layers"][0]["q_norm"].shape == (32,)
 
     # cached decode inherits the qk-norm path
-    _assert_decode_matches_forward(params, cfg, list(range(5, 19)), n=6)
+    assert_decode_matches_forward(params, cfg, list(range(5, 19)), n=6)
 
 
 def test_gemma2_continuous_batcher_matches_solo(tmp_path):
@@ -491,7 +482,7 @@ def test_logit_parity_phi3_longrope(tmp_path):
     np.testing.assert_allclose(ours, _hf_logits(model, ids), rtol=2e-4, atol=2e-4)
 
     # cached decode inherits the scaled rope
-    _assert_decode_matches_forward(params, cfg, list(range(5, 19)), n=6)
+    assert_decode_matches_forward(params, cfg, list(range(5, 19)), n=6)
 
 
 def test_phi3_longrope_mixed_regime_batch_matches_solo(tmp_path):

@@ -112,16 +112,11 @@ def test_moe_forward_and_decode_parity():
     """Full forward on an MoE config, and the cached decode path must
     reproduce its greedy continuation exactly (dispatch inside decode
     operates on T = B tokens)."""
+    from conftest import assert_decode_matches_forward
+
     cfg = _moe_cfg()
     params = init_params(jax.random.PRNGKey(3), cfg)
-    prompt = list(range(5, 17))
-    greedy_cached = generate_tokens(params, cfg, prompt, max_new_tokens=6)
-
-    toks = list(prompt)
-    for _ in range(6):
-        logits = forward(params, cfg, jnp.asarray([toks]))
-        toks.append(int(jnp.argmax(logits[0, -1])))
-    assert greedy_cached == toks[len(prompt) :]
+    assert_decode_matches_forward(params, cfg, list(range(5, 17)), n=6)
 
 
 def test_moe_ep_sharded_forward_parity():
