@@ -1,9 +1,12 @@
-"""Benchmarks: warn p50 @1M GFKB, streaming-ingest throughput, decode MFU.
+"""Benchmarks: warn p50 @1M GFKB, ingest throughput, serving + mining.
 
-One `python bench.py` run measures all three and prints ONE JSON line —
-headline = the warn north star, with ingest + decode under
-``extra_metrics`` so the driver's BENCH_r{N}.json carries every number.
-``KAKVEDA_BENCH_METRIC=warn|ingest|decode`` runs a single metric instead.
+One `python bench.py` run measures warn, ingest, decode MFU (+curve,
++int8), speculative decode, continuous batching, warn-under-ingest,
+warn-under-decode and pattern mining, and prints ONE JSON line —
+headline = the warn north star, with the rest under ``extra_metrics`` so
+the driver's BENCH_r{N}.json carries every number.
+``KAKVEDA_BENCH_METRIC=warn|ingest|decode|spec|continuous|mixed|
+mixed-decode|mine`` runs a single metric instead.
 
 == warn: pre-flight warning p50 latency at a 1M-entry GFKB.
 
@@ -1042,6 +1045,7 @@ def main() -> int:
         _bench_ingest,
         _bench_decode,
         _bench_spec,
+        _bench_continuous,
         _bench_mixed,
         _bench_mixed_decode,
         _bench_mine,

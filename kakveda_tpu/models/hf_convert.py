@@ -1,14 +1,13 @@
-"""HF checkpoint → kakveda param pytree (Llama / Mistral / Qwen2 families).
+"""HF checkpoint → kakveda param pytree (eight model families).
 
 The reference delegates all real-model inference to an external Ollama
 daemon (reference: services/dashboard/app.py:1182-1258) — which is also how
 it supports many model families. Here real weights load directly onto the
 TPU mesh: point ``KAKVEDA_HF_CKPT`` at any local HF-format checkpoint
-directory of a supported family (TinyLlama-1.1B, Llama-3-8B,
-Mistral-7B, Qwen2.5-…, …) and ``runtime=tpu`` serves it in-process.
-Family deltas handled by the one runtime: Mistral's sliding attention
-window + explicit head_dim, Qwen2's q/k/v biases (see
-:func:`hf_config_to_llama`).
+directory of a supported family — Llama, Mistral, Qwen2, Qwen3, Gemma,
+Gemma-2, Phi-3, Mixtral — and ``runtime=tpu`` serves it in-process
+(``KAKVEDA_HF_CKPTS`` serves several at once). Every family delta is a
+config flag on one runtime (see :func:`hf_config_to_llama`).
 
 Conversion notes (all verified by the logit-parity tests in
 tests/test_hf_convert.py against ``transformers.LlamaForCausalLM``):

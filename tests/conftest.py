@@ -26,18 +26,25 @@ def tmp_data_dir(tmp_path):
     return tmp_path / "data"
 
 
-def assert_decode_matches_forward(params, cfg, prompt, n=8):
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def decode_parity():
     """Cached greedy decode must reproduce the full forward's argmax chain —
-    the serving-path invariant every model family asserts. Shared by
-    test_hf_convert.py and test_moe.py (import from conftest)."""
+    the serving-path invariant every model family asserts. A fixture (not a
+    conftest import) so it works under any pytest import mode."""
     import jax.numpy as jnp
 
     from kakveda_tpu.models.generate import generate_tokens
     from kakveda_tpu.models.llama import forward
 
-    greedy_cached = generate_tokens(params, cfg, prompt, max_new_tokens=n)
-    toks = list(prompt)
-    for _ in range(n):
-        logits = forward(params, cfg, jnp.asarray([toks]))
-        toks.append(int(jnp.argmax(logits[0, -1])))
-    assert greedy_cached == toks[len(prompt) :]
+    def check(params, cfg, prompt, n=8):
+        greedy_cached = generate_tokens(params, cfg, prompt, max_new_tokens=n)
+        toks = list(prompt)
+        for _ in range(n):
+            logits = forward(params, cfg, jnp.asarray([toks]))
+            toks.append(int(jnp.argmax(logits[0, -1])))
+        assert greedy_cached == toks[len(prompt) :]
+
+    return check

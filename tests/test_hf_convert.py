@@ -18,8 +18,6 @@ import pytest
 import jax
 import jax.numpy as jnp
 
-from conftest import assert_decode_matches_forward
-
 from kakveda_tpu.models.generate import LlamaRuntime, generate_tokens
 from kakveda_tpu.models.hf_convert import hf_config_to_llama, load_hf_checkpoint
 from kakveda_tpu.models.llama import forward
@@ -101,12 +99,12 @@ def test_vocab_padding_masks_sampling(tmp_path):
     assert out and all(t < 250 for t in out)
 
 
-def test_decode_cache_matches_full_forward(tmp_path):
+def test_decode_cache_matches_full_forward(tmp_path, decode_parity):
     # The serving path (KV-cache decode) must agree with the parity-tested
     # full forward on a converted checkpoint, not just on random init.
     _make_hf_checkpoint(tmp_path, vocab=256, seed=4)
     params, cfg = load_hf_checkpoint(str(tmp_path), param_dtype=jnp.float32)
-    assert_decode_matches_forward(params, cfg, list(range(5, 20)), n=8)
+    decode_parity(params, cfg, list(range(5, 20)), n=8)
 
 
 def _write_tokenizer(path, *, vocab_target=256):
@@ -220,18 +218,18 @@ def test_logit_parity_mistral_sliding_window(tmp_path):
     assert np.abs(ours_win - ours_full).max() > 1e-3
 
 
-def test_mistral_decode_cache_matches_full_forward(tmp_path):
+def test_mistral_decode_cache_matches_full_forward(tmp_path, decode_parity):
     # The cached decode path applies the window in slot space (offsets
     # cancel); greedy parity with the parity-tested full forward proves it.
     _make_mistral_checkpoint(tmp_path, sliding_window=8, seed=8)
     params, cfg = load_hf_checkpoint(str(tmp_path), param_dtype=jnp.float32)
-    assert_decode_matches_forward(params, cfg, list(range(5, 25)), n=8)
+    decode_parity(params, cfg, list(range(5, 25)), n=8)
 
 
-def test_qwen2_decode_cache_matches_full_forward(tmp_path):
+def test_qwen2_decode_cache_matches_full_forward(tmp_path, decode_parity):
     _make_qwen2_checkpoint(tmp_path, seed=9)
     params, cfg = load_hf_checkpoint(str(tmp_path), param_dtype=jnp.float32)
-    assert_decode_matches_forward(params, cfg, list(range(3, 17)), n=8)
+    decode_parity(params, cfg, list(range(3, 17)), n=8)
 
 
 def _make_mixtral_checkpoint(path, *, vocab=256, seed=0):
@@ -322,10 +320,10 @@ def test_logit_parity_gemma(tmp_path):
     assert bf_params["layers"][0]["wq"].dtype == jnp.bfloat16
 
 
-def test_gemma_decode_cache_matches_full_forward(tmp_path):
+def test_gemma_decode_cache_matches_full_forward(tmp_path, decode_parity):
     _make_gemma_checkpoint(tmp_path, seed=13)
     params, cfg = load_hf_checkpoint(str(tmp_path), param_dtype=jnp.float32)
-    assert_decode_matches_forward(params, cfg, list(range(5, 21)), n=8)
+    decode_parity(params, cfg, list(range(5, 21)), n=8)
 
 
 def _make_gemma2_checkpoint(path, *, vocab=256, seed=0, sliding_window=8):
@@ -374,14 +372,14 @@ def test_logit_parity_gemma2(tmp_path):
     assert "post_attn_norm" in params["layers"][0]
 
 
-def test_gemma2_decode_cache_matches_full_forward(tmp_path):
+def test_gemma2_decode_cache_matches_full_forward(tmp_path, decode_parity):
     _make_gemma2_checkpoint(tmp_path, seed=15)
     params, cfg = load_hf_checkpoint(str(tmp_path), param_dtype=jnp.float32)
     # prompt long enough that the window alternation bites
-    assert_decode_matches_forward(params, cfg, list(range(5, 25)), n=8)
+    decode_parity(params, cfg, list(range(5, 25)), n=8)
 
 
-def test_logit_parity_qwen3_qk_norm(tmp_path):
+def test_logit_parity_qwen3_qk_norm(tmp_path, decode_parity):
     # Qwen3: per-head q/k RMSNorm over head_dim (pre-RoPE), no qkv bias,
     # explicit head_dim.
     hf_cfg = transformers.Qwen3Config(
@@ -409,7 +407,7 @@ def test_logit_parity_qwen3_qk_norm(tmp_path):
     assert params["layers"][0]["q_norm"].shape == (32,)
 
     # cached decode inherits the qk-norm path
-    assert_decode_matches_forward(params, cfg, list(range(5, 19)), n=6)
+    decode_parity(params, cfg, list(range(5, 19)), n=6)
 
 
 def test_gemma2_continuous_batcher_matches_solo(tmp_path):
@@ -467,7 +465,7 @@ def test_logit_parity_phi3_fused_projections(tmp_path):
     assert params["layers"][0]["w_gate"].shape == (64, 128)
 
 
-def test_logit_parity_phi3_longrope(tmp_path):
+def test_logit_parity_phi3_longrope(tmp_path, decode_parity):
     # longrope with max_position > original: HF switches short → long
     # factors dynamically when the sequence exceeds the original context;
     # attention scaling is static. Parity in BOTH regimes.
@@ -482,7 +480,7 @@ def test_logit_parity_phi3_longrope(tmp_path):
     np.testing.assert_allclose(ours, _hf_logits(model, ids), rtol=2e-4, atol=2e-4)
 
     # cached decode inherits the scaled rope
-    assert_decode_matches_forward(params, cfg, list(range(5, 19)), n=6)
+    decode_parity(params, cfg, list(range(5, 19)), n=6)
 
 
 def test_phi3_longrope_mixed_regime_batch_matches_solo(tmp_path):

@@ -13,7 +13,6 @@ import pytest
 import jax
 import jax.numpy as jnp
 
-from kakveda_tpu.models.generate import generate_tokens
 from kakveda_tpu.models.llama import (
     LlamaConfig,
     forward,
@@ -108,15 +107,13 @@ def test_capacity_drop_changes_output_but_stays_finite():
     assert np.abs(exact - dropped).max() > 1e-6  # the cap actually bit
 
 
-def test_moe_forward_and_decode_parity():
+def test_moe_forward_and_decode_parity(decode_parity):
     """Full forward on an MoE config, and the cached decode path must
     reproduce its greedy continuation exactly (dispatch inside decode
     operates on T = B tokens)."""
-    from conftest import assert_decode_matches_forward
-
     cfg = _moe_cfg()
     params = init_params(jax.random.PRNGKey(3), cfg)
-    assert_decode_matches_forward(params, cfg, list(range(5, 17)), n=6)
+    decode_parity(params, cfg, list(range(5, 17)), n=6)
 
 
 def test_moe_ep_sharded_forward_parity():
