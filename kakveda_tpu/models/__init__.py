@@ -1,3 +1,14 @@
-"""Model runtimes: deterministic stub, in-tree JAX Llama, Ollama-compat client."""
+"""Model runtimes: deterministic stub, the in-tree JAX transformer core
+(eight HF families — Llama/Mistral/Qwen2+3/Gemma+2/Phi-3/Mixtral — over
+dp/cp/tp/ep/pp), and an Ollama-compat client.
 
-from kakveda_tpu.models.runtime import GenerateResult, ModelRuntime, StubRuntime, get_runtime  # noqa: F401
+Heavy imports stay lazy: importing this package must not initialize jax
+(the stub tier and the HTTP layer run without it)."""
+
+from kakveda_tpu.models.runtime import (  # noqa: F401
+    GenerateResult,
+    ModelRuntime,
+    MultiModelRuntime,
+    StubRuntime,
+    get_runtime,
+)
