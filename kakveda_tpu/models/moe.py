@@ -117,18 +117,25 @@ def moe_mlp(
     out = jnp.zeros((t, d), dt).at[tok_sorted, :].add(contrib)
     out = out.reshape(b, s, d)
     if return_aux:
-        return out, load_balancing_loss(probs, idx, e)
+        return out, load_balancing_loss(probs, idx, e, k)
     return out
 
 
-def load_balancing_loss(router_probs: jax.Array, expert_idx: jax.Array, n_experts: int) -> jax.Array:
+def load_balancing_loss(
+    router_probs: jax.Array, expert_idx: jax.Array, n_experts: int, top_k: int = 1
+) -> jax.Array:
     """Switch/Mixtral auxiliary load-balancing loss: E · Σ_e f_e · P_e,
-    where f_e is the fraction of (token, choice) assignments routed to
-    expert e and P_e the mean router probability of e. Minimized (=1) by
-    uniform routing; add ``coef · loss`` to the LM loss when fine-tuning a
-    MoE config (HF ``router_aux_loss_coef``)."""
+    where f_e is the per-TOKEN fraction routed to expert e (assignment
+    counts / T — each token contributes ``top_k`` counts, matching HF
+    ``load_balancing_loss_func``'s sum of one-hot means over the top-k
+    slots; normalizing by T·k instead would shrink the term by 1/k and
+    silently under-weight HF-sourced ``router_aux_loss_coef`` values) and
+    P_e the mean router probability of e. Minimized (=top_k) by uniform
+    routing; add ``coef · loss`` to the LM loss when fine-tuning a MoE
+    config (HF ``router_aux_loss_coef``)."""
     probs = router_probs.reshape(-1, n_experts)
     idx = expert_idx.reshape(-1)
-    f = jnp.zeros((n_experts,), jnp.float32).at[idx].add(1.0) / jnp.maximum(idx.size, 1)
+    t = jnp.maximum(idx.size // max(top_k, 1), 1)
+    f = jnp.zeros((n_experts,), jnp.float32).at[idx].add(1.0) / t
     p = jnp.mean(probs, axis=0)
     return n_experts * jnp.sum(f * p)

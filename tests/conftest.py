@@ -37,14 +37,17 @@ def decode_parity():
     import jax.numpy as jnp
 
     from kakveda_tpu.models.generate import generate_tokens
-    from kakveda_tpu.models.llama import forward
+    from kakveda_tpu.models.llama import forward, mask_pad_vocab
 
     def check(params, cfg, prompt, n=8):
         greedy_cached = generate_tokens(params, cfg, prompt, max_new_tokens=n)
         toks = list(prompt)
         for _ in range(n):
             logits = forward(params, cfg, jnp.asarray([toks]))
-            toks.append(int(jnp.argmax(logits[0, -1])))
+            # Same padded-vocab masking as the decode path — without it a
+            # checkpoint with effective_vocab set could argmax a pad column
+            # here and spuriously fail (or hide a masking bug).
+            toks.append(int(jnp.argmax(mask_pad_vocab(logits[0, -1], cfg))))
         assert greedy_cached == toks[len(prompt) :]
 
     return check

@@ -151,22 +151,26 @@ def test_specs_for_mesh_drops_absent_axes():
     assert specs["layers"][0]["we_down"] == P(None, "tp", None)
 
 
-def test_load_balancing_loss_uniform_is_one():
+def test_load_balancing_loss_uniform_is_top_k():
+    # HF load_balancing_loss_func convention: counts normalize by T (each
+    # token contributes top_k assignments), so the uniform minimum is
+    # top_k and the one-expert collapse approaches E·top_k.
     t, e, k = 64, 4, 2
     probs = jnp.full((t, e), 1.0 / e)
     # perfectly balanced assignments
     idx = jnp.asarray(np.stack([np.arange(t) % e, (np.arange(t) + 1) % e], -1))
-    loss = float(load_balancing_loss(probs, idx, e))
-    assert abs(loss - 1.0) < 1e-5
-    # collapse onto one expert: loss rises toward E
+    loss = float(load_balancing_loss(probs, idx, e, k))
+    assert abs(loss - k) < 1e-5
+    # collapse onto one expert: loss rises toward E·k
     probs_bad = jnp.zeros((t, e)).at[:, 0].set(1.0)
     idx_bad = jnp.zeros((t, k), jnp.int32)
-    assert float(load_balancing_loss(probs_bad, idx_bad, e)) > 3.9
+    assert float(load_balancing_loss(probs_bad, idx_bad, e, k)) > 2 * 3.9
 
 
 def test_aux_loss_wired_into_training_objective():
     """router_aux_coef > 0 adds the summed per-layer load-balancing loss
-    to lm_loss; the aux term sits in [1, E] per layer."""
+    to lm_loss; the aux term sits in [top_k, E·top_k] per layer (HF
+    normalization)."""
     from kakveda_tpu.models.train import lm_loss
 
     cfg0 = _moe_cfg()
@@ -176,7 +180,8 @@ def test_aux_loss_wired_into_training_objective():
     base = float(lm_loss(params, cfg0, tokens))
     with_aux = float(lm_loss(params, cfg1, tokens))
     per_layer_aux = (with_aux - base) / (0.5 * cfg0.n_layers)
-    assert 1.0 - 1e-3 <= per_layer_aux <= cfg0.n_experts + 1e-3, per_layer_aux
+    k = cfg0.n_experts_per_tok
+    assert k - 1e-3 <= per_layer_aux <= cfg0.n_experts * k + 1e-3, per_layer_aux
     # aux still differentiates
     g = jax.grad(lm_loss)(params, cfg1, tokens)
     assert np.isfinite(float(jnp.abs(g["layers"][0]["router"]).max()))
