@@ -109,6 +109,11 @@ class _JsonFormatter(logging.Formatter):
         for key in ("request_id", "path", "method", "status_code", "duration_ms"):
             if hasattr(record, key):
                 extra[key] = getattr(record, key)
+        if record.exc_info and record.exc_info[0] is not None:
+            # Server-side exceptions (aiohttp logs them with exc_info) must
+            # reach the JSON stream — a 500 with no traceback in the logs
+            # is undebuggable in production.
+            extra["exc"] = self.formatException(record.exc_info)
         return _json_record(record.levelname, record.getMessage(), extra)
 
 

@@ -16,6 +16,7 @@ import asyncio
 from kakveda_tpu.core.schemas import TracePayload, WarningRequest
 from kakveda_tpu.dashboard.core import CTX_KEY, require_login, require_roles
 from kakveda_tpu.dashboard.db import new_trace_id
+from kakveda_tpu.models.runtime import UnknownModelError
 
 
 async def off_loop(fn, *args, **kwargs):
@@ -457,9 +458,12 @@ def setup(app: web.Application) -> None:
             try:
                 gen = await off_loop(lambda: ctx.model.generate(prompt, model=chosen))
                 text, meta = gen.text, gen.meta
-            except ValueError as e:
+            except UnknownModelError as e:
                 # Stale/hand-crafted model label (multi-model runtimes
                 # reject unknown labels): surface in the UI, not a 500.
+                # ONLY the label rejection — other ValueErrors ('no decode
+                # room', prompt too long) are real serving faults and must
+                # reach the error middleware.
                 text = f"model error: {e}"
                 meta = {"provider": "error", "model": chosen, "error": str(e)}
         t1 = time.time()

@@ -7,8 +7,10 @@ Heavy imports stay lazy: importing this package must not initialize jax
 
 from kakveda_tpu.models.runtime import (  # noqa: F401
     GenerateResult,
+    HBMBudgetError,
     ModelRuntime,
     MultiModelRuntime,
     StubRuntime,
+    UnknownModelError,
     get_runtime,
 )

@@ -274,9 +274,10 @@ def _decode_chunk_jit(
 
 
 @partial(jax.jit, static_argnames=("cfg",))
-def _prefill_jit(params, cfg: LlamaConfig, prompt, cache, kv_valid, pos_offset):
+def _prefill_jit(params, cfg: LlamaConfig, prompt, cache, kv_valid, pos_offset, seq_total=None):
     logits, cache = decode_step(
-        params, cfg, prompt, cache, kv_valid=kv_valid, pos_offset=pos_offset, last_only=True
+        params, cfg, prompt, cache, kv_valid=kv_valid, pos_offset=pos_offset,
+        last_only=True, seq_total=seq_total,
     )
     last = mask_pad_vocab(logits[:, -1, :], cfg)
     return last, cache
@@ -313,10 +314,17 @@ def prefill(
             f"chunked prefill needs the prompt width ({prompt.shape[1]}) padded "
             f"to a multiple of chunk={chunk} (pack with plen=rounded)"
         )
+    # Phi-3 longrope selects short/long factors from the sequence length:
+    # each chunk must see the FULL per-row prompt length (width − left pad),
+    # not its own max position, or early chunks of a long prompt rotate K/V
+    # in the short regime while single-shot prefill uses long throughout.
+    seq_total = None
+    if cfg.rope_dim_factors_long:
+        seq_total = jnp.asarray(prompt.shape[1], jnp.int32) - pos_offset
     last = None
     for s in range(0, prompt.shape[1], chunk):
         last, cache = _prefill_jit(
-            params, cfg, prompt[:, s : s + chunk], cache, kv_valid, pos_offset
+            params, cfg, prompt[:, s : s + chunk], cache, kv_valid, pos_offset, seq_total
         )
     return last, cache
 
@@ -503,6 +511,17 @@ class LlamaRuntime:
         self.tokenizer = tokenizer if tokenizer is not None else ByteTokenizer()
         if self.cfg.vocab_size < self.tokenizer.vocab_size:
             raise ValueError("model vocab smaller than tokenizer vocab")
+        if self.cfg.effective_vocab is None and self.tokenizer.vocab_size < self.cfg.vocab_size:
+            # The table is padded past the tokenizer (tp-friendly multiple):
+            # without effective_vocab the pad-vocab mask is a no-op and a
+            # random-init/underspecified model can argmax an id the
+            # tokenizer cannot decode — ByteTokenizer.decode then raises
+            # mid-request (observed as stochastic playground 500s). Every
+            # decode path masks via mask_pad_vocab(cfg), so clamping here
+            # covers chunked, engine, speculative and batch serving alike.
+            import dataclasses as _dc
+
+            self.cfg = _dc.replace(self.cfg, effective_vocab=self.tokenizer.vocab_size)
         self.params = params if params is not None else init_params(jax.random.PRNGKey(seed), self.cfg)
         if quant == "int8":
             # Weight-only int8 serving: halves the HBM weight stream that
@@ -514,6 +533,11 @@ class LlamaRuntime:
             raise ValueError(f"unknown quant mode {quant!r} (int8|none)")
         self.quant = quant
         self.model_label = model_label or f"llama-{self.cfg.n_layers}L-{self.cfg.d_model}d"
+        import threading
+
+        self._engine = None
+        self._engine_lock = threading.Lock()
+        self._retired = False
 
     @classmethod
     def from_env(cls) -> "LlamaRuntime":
@@ -569,9 +593,75 @@ class LlamaRuntime:
 
         ckptr = ocp.StandardCheckpointer()
         self.params = ckptr.restore(path, self.params)
+        with self._engine_lock:
+            if self._engine is not None:
+                # The engine captured the old param tree at construction;
+                # drop it so the next online request rebuilds on the new
+                # weights instead of serving the stale ones.
+                self._engine.close()
+                self._engine = None
 
     def list_models(self) -> list:
         return [self.model_label]
+
+    def engine(self):
+        """The shared online ServingEngine (continuous batching), or None
+        when disabled. KAKVEDA_SERVE_CONTINUOUS=0 opts out (falls back to
+        one decode stream per call); KAKVEDA_SERVE_SLOTS / _SERVE_WINDOW /
+        _SERVE_CHUNK size the pool. Lazy: offline users (training, bench
+        static paths) never pay for the loop thread."""
+        if os.environ.get("KAKVEDA_SERVE_CONTINUOUS", "1") == "0" or self._retired:
+            return None
+        if self._engine is None:
+            with self._engine_lock:
+                if self._retired:
+                    # Evicted by MultiModelRuntime's HBM budget: never
+                    # rebuild the KV pool — an in-flight generate falls
+                    # back to the solo decode (params stay alive only as
+                    # long as its caller holds this runtime).
+                    return None
+                if self._engine is None:
+                    from kakveda_tpu.models.serving import ServingEngine
+
+                    window = int(
+                        os.environ.get(
+                            "KAKVEDA_SERVE_WINDOW", min(512, self.cfg.max_seq_len)
+                        )
+                    )
+                    try:
+                        self._engine = ServingEngine(
+                            self.params, self.cfg,
+                            batch_slots=int(os.environ.get("KAKVEDA_SERVE_SLOTS", "8")),
+                            max_len=min(window, self.cfg.max_seq_len),
+                            chunk_steps=int(os.environ.get("KAKVEDA_SERVE_CHUNK", "8")),
+                            eos_id=self.tokenizer.EOS,
+                        )
+                    except Exception as e:  # noqa: BLE001
+                        # KV-pool allocation can fail on a memory-tight
+                        # chip (the co-residency case the HBM budget
+                        # exists for). Serving must degrade to the solo
+                        # path, not 500 — and not retry the allocation on
+                        # every request.
+                        import logging
+
+                        logging.getLogger("kakveda.serving").warning(
+                            "ServingEngine construction failed; online "
+                            "continuous batching disabled for %s: %s",
+                            self.model_label, e,
+                        )
+                        self._retired = True
+                        return None
+        return self._engine
+
+    def retire(self) -> None:
+        """Tear down the serving engine and bar rebuilding — called by the
+        HBM-budget evictor. In-flight generates finish on the solo path;
+        device memory frees once the last caller drops this runtime."""
+        with self._engine_lock:
+            self._retired = True
+            if self._engine is not None:
+                self._engine.close()
+                self._engine = None
 
     def _generate_ids_chunked(self, ids: list[list[int]], max_tokens: int) -> list[list[int]]:
         """Greedy decode via chunked dispatch (DecodeSession): ~chunk_steps
@@ -618,8 +708,24 @@ class LlamaRuntime:
         ids = [self.tokenizer.encode(p)[-self.cfg.max_seq_len // 2 :] for p in prompts]
         from kakveda_tpu.core import profiling
 
-        with profiling.annotate("llama.generate_batch"):
-            new_ids = self._generate_ids_chunked(ids, max_tokens)
+        eng = self.engine()
+        extra = {}
+        new_ids = None
+        if eng is not None and all(eng.fits(len(i), max_tokens) for i in ids):
+            # Online path: the whole list joins the SHARED slot pool, so a
+            # judge batch and a concurrent playground chat decode together.
+            try:
+                with profiling.annotate("llama.generate_batch_online"):
+                    futs = [eng.submit(i, max_new_tokens=max_tokens) for i in ids]
+                    new_ids = [f.result() for f in futs]
+                extra = {"continuous": True}
+            except RuntimeError:
+                # Engine closed/died between fits() and the results: the
+                # solo path below still serves the request.
+                new_ids = None
+        if new_ids is None:
+            with profiling.annotate("llama.generate_batch"):
+                new_ids = self._generate_ids_chunked(ids, max_tokens)
         latency_ms = int((time.perf_counter() - started) * 1000)
         label = model or self.model_label
         return [
@@ -631,6 +737,7 @@ class LlamaRuntime:
                     "latency_ms": latency_ms,
                     "tokens_generated": len(out),
                     "batched": len(prompts),
+                    **extra,
                 },
             )
             for out in new_ids
@@ -658,8 +765,22 @@ class LlamaRuntime:
                 )
             meta_extra = {"speculative": True, "tokens_per_round": round(stats["tokens_per_round"], 2)}
         else:
-            with profiling.annotate("llama.generate"):
-                new_ids = self._generate_ids_chunked([ids], max_tokens)[0]
+            eng = self.engine()
+            new_ids = None
+            if eng is not None and eng.fits(len(ids), max_tokens):
+                # Online path: join the shared continuous-batching pool —
+                # concurrent requests (other chats, eval rows, judge calls)
+                # decode in ONE batch. Greedy slot parity keeps the output
+                # identical to the solo decode below.
+                try:
+                    with profiling.annotate("llama.generate_online"):
+                        new_ids = eng.generate_ids(ids, max_tokens)
+                    meta_extra = {"continuous": True}
+                except RuntimeError:
+                    new_ids = None  # engine closed/died: solo path below
+            if new_ids is None:
+                with profiling.annotate("llama.generate"):
+                    new_ids = self._generate_ids_chunked([ids], max_tokens)[0]
         text = self.tokenizer.decode(new_ids)
         return GenerateResult(
             text=text,

@@ -294,12 +294,6 @@ def qkv_proj(
         q = q + layer["bq"].astype(dt)
         k = k + layer["bk"].astype(dt)
         v = v + layer["bv"].astype(dt)
-    if cfg.query_scale:
-        # The kernels scale scores by head_dim**-0.5; fold an explicit
-        # query scale (Gemma-2's query_pre_attn_scalar**-0.5) into q so
-        # every kernel stays convention-free. Commutes with RoPE
-        # (rotations are linear).
-        q = q * jnp.asarray(cfg.query_scale * math.sqrt(hd), dt)
     q = q.reshape(b, s, cfg.n_heads, hd)
     k = k.reshape(b, s, cfg.n_kv_heads, hd)
     v = v.reshape(b, s, cfg.n_kv_heads, hd)
@@ -307,6 +301,14 @@ def qkv_proj(
         # Qwen3 per-head q/k RMSNorm over head_dim, pre-RoPE.
         q = rms_norm(q, layer["q_norm"], cfg.norm_eps)
         k = rms_norm(k, layer["k_norm"], cfg.norm_eps)
+    if cfg.query_scale:
+        # The kernels scale scores by head_dim**-0.5; fold an explicit
+        # query scale (Gemma-2's query_pre_attn_scalar**-0.5) into q so
+        # every kernel stays convention-free. Commutes with RoPE
+        # (rotations are linear) — but must apply AFTER the optional
+        # q_norm: RMSNorm is scale-invariant, so a pre-norm fold would be
+        # silently cancelled for any config combining both flags.
+        q = q * jnp.asarray(cfg.query_scale * math.sqrt(hd), dt)
     return q, k, v
 
 
@@ -339,13 +341,24 @@ def rms_norm(x: jax.Array, w: jax.Array, eps: float) -> jax.Array:
     return (x32 * scale).astype(x.dtype) * w.astype(x.dtype)
 
 
-def _rope_freqs(cfg: LlamaConfig, positions: jax.Array) -> Tuple[jax.Array, jax.Array]:
+def _rope_freqs(
+    cfg: LlamaConfig,
+    positions: jax.Array,
+    seq_len: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, jax.Array]:
     """cos/sin tables [..., head_dim/2] for given positions.
 
     With ``rope_factor > 1`` applies Llama-3.1's wavelength-dependent NTK
     scaling (matches HF ``_compute_llama3_parameters``): low-frequency
     components are stretched by ``factor``, high-frequency kept, and the
     band between ``low/high_freq_factor`` wavelength thresholds is blended.
+
+    ``seq_len`` ([B] or scalar) overrides the longrope regime-select
+    length. Chunked prefill MUST pass the full prompt length here: an
+    early chunk's ``max(positions)+1`` is below ``rope_original_max_len``
+    even when the whole prompt is past it, and rotating early-chunk K/V
+    with short factors would diverge from single-shot prefill of the same
+    prompt (whose positions span the full length).
     """
     half = cfg.head_dim // 2
     inv = 1.0 / (cfg.rope_theta ** (jnp.arange(half, dtype=jnp.float32) / half))
@@ -360,10 +373,11 @@ def _rope_freqs(cfg: LlamaConfig, positions: jax.Array) -> Tuple[jax.Array, jax.
         inv_short = inv / jnp.asarray(cfg.rope_dim_factors, jnp.float32)
         if cfg.rope_dim_factors_long:
             inv_long = inv / jnp.asarray(cfg.rope_dim_factors_long, jnp.float32)
-            long_row = (
-                jnp.max(positions, axis=-1, keepdims=True) + 1
-                > cfg.rope_original_max_len
-            )  # [..., 1]
+            if seq_len is None:
+                eff_len = jnp.max(positions, axis=-1, keepdims=True) + 1
+            else:
+                eff_len = jnp.asarray(seq_len, jnp.int32)[..., None]
+            long_row = eff_len > cfg.rope_original_max_len  # [..., 1]
             ang = positions[..., None].astype(jnp.float32)
             ang = jnp.where(long_row[..., None], ang * inv_long, ang * inv_short)
             scale = cfg.rope_attn_scaling
@@ -685,6 +699,7 @@ def decode_step(
     kv_valid: Optional[jax.Array] = None,  # [B, max_len] — False masks pad slots
     pos_offset: Optional[jax.Array] = None,  # [B] — logical-position shift (left-pad)
     last_only: bool = False,
+    seq_total: Optional[jax.Array] = None,  # [B] — full-sequence length for longrope
 ) -> Tuple[jax.Array, Params]:
     """Incremental forward with KV cache; returns (logits [B, S, V], cache).
 
@@ -693,6 +708,12 @@ def decode_step(
     slot − offset_b (so they match the unpadded sequence), and attention
     never reads a pad slot. Both default to the unpadded single-stream
     behavior.
+
+    ``seq_total`` (per-row full prompt length) overrides the Phi-3
+    longrope short/long regime select — REQUIRED for chunked prefill so
+    early chunks rotate with the same regime single-shot prefill would
+    use (see :func:`_rope_freqs`); decode steps leave it None (the running
+    length, HF's dynamic-switch semantics).
 
     ``last_only=True`` computes final-norm + lm_head for the last position
     only (logits [B, 1, V]) — sampling never reads the others, and at
@@ -707,7 +728,7 @@ def decode_step(
     positions = jnp.broadcast_to(jnp.arange(s) + pos0, (b, s))
     if pos_offset is not None:
         positions = positions - pos_offset[:, None]
-    cos, sin = _rope_freqs(cfg, positions)
+    cos, sin = _rope_freqs(cfg, positions, seq_total)
     hd = cfg.head_dim
 
     x = embed_tokens(params, cfg, tokens)

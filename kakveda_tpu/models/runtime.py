@@ -38,6 +38,45 @@ STUB_RESPONSE = (
 )
 
 
+class UnknownModelError(ValueError):
+    """Requested model label isn't among the runtime's checkpoints.
+
+    A distinct type so UI callers can turn ONLY stale-label rejections
+    into a friendly chat reply while real serving errors (no decode room,
+    prompt too long, …) still surface as server errors."""
+
+
+class HBMBudgetError(RuntimeError):
+    """Loading a checkpoint would exceed the runtime's HBM weight budget
+    and nothing (more) can be evicted. Raised BEFORE the upload — the
+    alternative is OOMing the chip that also serves the GFKB index."""
+
+
+def _parse_bytes(s) -> Optional[int]:
+    """'8GiB' | '8G' | '512M' | raw int → bytes (None/'' → None)."""
+    if s is None or s == "":
+        return None
+    if isinstance(s, (int, float)):
+        return int(s)
+    t = str(s).strip().upper().removesuffix("B").removesuffix("I")
+    mult = {"K": 2**10, "M": 2**20, "G": 2**30, "T": 2**40}.get(t[-1:], 1)
+    if mult != 1:
+        t = t[:-1]
+    return int(float(t) * mult)
+
+
+def _tree_bytes(tree) -> int:
+    """Exact on-device bytes of a param tree (int8 pairs count both the
+    int8 matrix and its scales)."""
+    import jax
+
+    return sum(
+        x.size * x.dtype.itemsize
+        for x in jax.tree.leaves(tree)
+        if hasattr(x, "dtype")
+    )
+
+
 @dataclass
 class GenerateResult:
     text: str
@@ -162,13 +201,27 @@ class MultiModelRuntime:
     separated checkpoint directories; any supported family — see
     models/hf_convert.py). Labels are the directory basenames; the first
     entry is the default model. Checkpoints load LAZILY on first use, so
-    only models actually requested occupy HBM — co-residency is the
-    operator's budget call (each loaded model holds its full weight set
-    on device)."""
+    only models actually requested occupy HBM.
+
+    **HBM budget** (``hbm_budget_bytes`` / ``KAKVEDA_HBM_BUDGET=12GiB``):
+    the runtime accounts exact weight bytes per loaded model plus the
+    serving engine's KV pool, and when a new load would cross the budget
+    it LRU-evicts idle models first and raises :class:`HBMBudgetError`
+    (before the upload) if eviction can't make room — never an OOM on the
+    chip that co-hosts the GFKB index. Set the budget to chip HBM minus
+    the index + workspace reserve (docs/performance.md co-residency
+    table). No budget → the pre-round-4 behavior (operator's call)."""
 
     name = "tpu"
 
-    def __init__(self, paths: list, *, quant: Optional[str] = None, mesh=None):
+    def __init__(
+        self,
+        paths: list,
+        *,
+        quant: Optional[str] = None,
+        mesh=None,
+        hbm_budget_bytes: Optional[int] = None,
+    ):
         import threading
 
         if not paths:
@@ -183,27 +236,121 @@ class MultiModelRuntime:
         self._default = os.path.basename(os.path.normpath(paths[0]))
         self._quant = quant
         self._mesh = mesh
-        self._loaded: Dict[str, Any] = {}
-        self._load_lock = threading.Lock()
+        self._budget = (
+            hbm_budget_bytes
+            if hbm_budget_bytes is not None
+            else _parse_bytes(os.environ.get("KAKVEDA_HBM_BUDGET"))
+        )
+        self._loaded: Dict[str, Any] = {}  # label -> LlamaRuntime, LRU order
+        self._bytes: Dict[str, int] = {}  # label -> exact weight+KV bytes
+        self._load_lock = threading.Lock()  # serializes load/evict/budget
+        self._lru_lock = threading.Lock()  # guards _loaded order mutations only
+
+    def _estimate_bytes(self, path: str) -> int:
+        """Pre-load footprint estimate from config.json alone (no weight
+        IO): eval_shape of the param tree (+int8 halving) plus the serving
+        engine's KV pool. Replaced by exact accounting after the load."""
+        import json as _json
+
+        import jax
+        import jax.numpy as jnp
+
+        from kakveda_tpu.models.hf_convert import hf_config_to_llama
+        from kakveda_tpu.models.llama import init_params
+
+        with open(os.path.join(path, "config.json")) as f:
+            cfg = hf_config_to_llama(_json.load(f), dtype=jnp.bfloat16)
+        shapes = jax.eval_shape(lambda k: init_params(k, cfg), jax.random.PRNGKey(0))
+        w = _tree_bytes(shapes)
+        if self._quant == "int8":
+            # Dense matrices drop to 1 byte/elt + per-row f32 scales; the
+            # (unquantized) norms/embeddings are a small fraction. A ~0.55
+            # factor over-estimates slightly — safe direction for a budget.
+            w = int(w * 0.55)
+        return w + self._engine_pool_bytes(cfg)
+
+    @staticmethod
+    def _engine_pool_bytes(cfg) -> int:
+        """KV bytes the shared ServingEngine will pin once this model
+        serves traffic (slots × window × layers × K+V), from the same env
+        knobs LlamaRuntime.engine uses."""
+        import numpy as np
+
+        slots = int(os.environ.get("KAKVEDA_SERVE_SLOTS", "8"))
+        window = min(
+            int(os.environ.get("KAKVEDA_SERVE_WINDOW", min(512, cfg.max_seq_len))),
+            cfg.max_seq_len,
+        )
+        itemsize = np.dtype(cfg.dtype).itemsize
+        return slots * window * cfg.n_layers * 2 * cfg.n_kv_heads * cfg.head_dim * itemsize
+
+    def _evict_lru(self, keep: str) -> bool:
+        """Drop the least-recently-used loaded model (never ``keep``);
+        returns False when nothing is evictable. Caller holds _load_lock.
+
+        ``retire()`` closes the engine under ITS lock and bars a rebuild,
+        so a thread mid-generate on the evicted runtime can't re-pin a KV
+        pool behind the budget's back — it finishes on the solo path and
+        the weights free when the last in-flight caller drops them."""
+        with self._lru_lock:
+            victim = next((lb for lb in self._loaded if lb != keep), None)
+            rt = self._loaded.pop(victim) if victim is not None else None
+        if rt is None:
+            return False
+        self._bytes.pop(victim, None)
+        rt.retire()
+        return True
+
+    def loaded_bytes(self) -> int:
+        return sum(self._bytes.values())
 
     def _get(self, model: Optional[str]):
         label = model or self._default
         if label not in self._paths:
-            raise ValueError(
+            raise UnknownModelError(
                 f"unknown model {label!r}; available: {sorted(self._paths)}"
             )
-        if label not in self._loaded:
-            # Serialize checkpoint loads: concurrent first requests for one
-            # label would otherwise each convert + upload the full weight
-            # set (double HBM for the same model).
-            with self._load_lock:
-                if label not in self._loaded:
-                    from kakveda_tpu.models.generate import LlamaRuntime
-
-                    self._loaded[label] = LlamaRuntime.from_hf(
-                        self._paths[label], mesh=self._mesh, quant=self._quant
+        rt = self._loaded.get(label)
+        if rt is not None:
+            # Hot path: no load lock (a slow checkpoint load on another
+            # label must not stall serving). LRU touch under the cheap
+            # order lock; if the label was just evicted, this request
+            # still runs on the retired runtime it already holds.
+            with self._lru_lock:
+                cur = self._loaded.pop(label, None)
+                if cur is not None:
+                    self._loaded[label] = cur
+            return rt
+        # Serialize checkpoint loads: concurrent first requests for one
+        # label would otherwise each convert + upload the full weight
+        # set (double HBM for the same model).
+        with self._load_lock:
+            rt = self._loaded.get(label)
+            if rt is not None:
+                return rt
+            if self._budget is not None:
+                est = self._estimate_bytes(self._paths[label])
+                while (
+                    self.loaded_bytes() + est > self._budget
+                    and self._evict_lru(keep=label)
+                ):
+                    pass
+                if self.loaded_bytes() + est > self._budget:
+                    raise HBMBudgetError(
+                        f"loading {label!r} needs ~{est / 2**20:.0f} MiB but only "
+                        f"{(self._budget - self.loaded_bytes()) / 2**20:.0f} MiB of the "
+                        f"{self._budget / 2**20:.0f} MiB HBM budget remains "
+                        "(KAKVEDA_HBM_BUDGET) and nothing is left to evict"
                     )
-        return self._loaded[label]
+            from kakveda_tpu.models.generate import LlamaRuntime
+
+            rt = LlamaRuntime.from_hf(
+                self._paths[label], mesh=self._mesh, quant=self._quant
+            )
+            self._bytes[label] = _tree_bytes(rt.params) + self._engine_pool_bytes(rt.cfg)
+            with self._lru_lock:
+                self._loaded[label] = rt
+            return rt
 
     def list_models(self) -> list:
         return list(self._paths)

@@ -1,4 +1,12 @@
-"""Continuous batching for the serving path (design note + prototype).
+"""Continuous batching — THE online serving path.
+
+``ServingEngine`` (bottom of this module) is what LlamaRuntime routes
+``generate``/``generate_batch`` through by default
+(KAKVEDA_SERVE_CONTINUOUS=0 opts out): one daemon loop thread owns a
+shared ContinuousBatcher, concurrent callers block on Futures, and every
+online request — playground chat, eval row, LLM-judge call — joins one
+decode batch. Offline throughput paths (bench, training eval) keep
+calling ``generate_tokens_fused`` directly.
 
 The playground, eval runner and LLM-judge tier all call generate. Static
 batching (`generate_tokens_batch`/`_fused`) decodes a fixed cohort to the
@@ -44,9 +52,12 @@ vector threads through the chunk body; greedy slots stay exact).
 
 from __future__ import annotations
 
+import queue
+import threading
+from concurrent.futures import Future
 from dataclasses import dataclass, field
 from functools import partial
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -232,6 +243,17 @@ class ContinuousBatcher:
         self.results: Dict[int, List[int]] = {}
         self._next_id = 0
 
+    @staticmethod
+    def bucket_for(prompt_len: int, max_len: int) -> int:
+        """Admission pad width: power-of-two ≥ prompt (min 8), capped at
+        the slot window. THE definition shared by admit() and
+        ServingEngine.fits() — the engine's fallback contract (never admit
+        what would truncate) depends on the two staying identical."""
+        bucket = 8
+        while bucket < prompt_len:
+            bucket <<= 1
+        return min(bucket, max_len - 1)
+
     @property
     def has_capacity(self) -> bool:
         return bool(self.free)
@@ -254,10 +276,7 @@ class ContinuousBatcher:
         p = len(prompt_ids)
         if p + 1 >= self.max_len:
             raise ValueError("prompt too long for the slot window")
-        bucket = 8
-        while bucket < p:
-            bucket <<= 1
-        bucket = min(bucket, self.max_len - 1)
+        bucket = self.bucket_for(p, self.max_len)
         off = bucket - p
         slot = self.free.pop()
         rid = self._next_id
@@ -338,3 +357,168 @@ class ContinuousBatcher:
         for rid, toks in self.results.items():
             outs[order[rid]] = toks
         return outs
+
+
+class ServingEngine:
+    """The ONLINE serving path: one shared ContinuousBatcher behind a
+    thread-safe submit API, so every concurrent caller — playground chat,
+    eval runner, LLM-judge tier — joins ONE decode batch instead of each
+    running its own per-request decode stream (the reference's model: one
+    sequential Ollama HTTP hop per request, services/dashboard/app.py:
+    1226-1258).
+
+    A single daemon loop thread owns the batcher (admission and decode
+    chunks never race); callers block on a Future. Requests are admitted
+    mid-decode as slots free up, each with its own max_tokens/temperature.
+    Greedy outputs are slot-for-slot identical to a solo
+    ``generate_tokens`` call (the batcher's parity invariant), so routing
+    online traffic here is a throughput decision, not an accuracy one.
+
+    ``fits()`` mirrors the batcher's admission bucketing: a request whose
+    padded prompt + budget would overrun the slot window is the CALLER's
+    cue to fall back to a solo decode (LlamaRuntime does exactly that) —
+    inside the pool it would truncate where the solo path keeps going.
+    """
+
+    def __init__(
+        self,
+        params: Params,
+        cfg: LlamaConfig,
+        *,
+        batch_slots: int = 8,
+        max_len: int = 512,
+        chunk_steps: int = 8,
+        eos_id: Optional[int] = None,
+        rng: Optional[jax.Array] = None,
+    ):
+        self.cb = ContinuousBatcher(
+            params, cfg, batch_slots=batch_slots, max_len=max_len,
+            chunk_steps=chunk_steps, eos_id=eos_id, rng=rng,
+        )
+        self._q: "queue.Queue[Tuple[List[int], int, float, Future]]" = queue.Queue()
+        self._closed = threading.Event()
+        self._submit_lock = threading.Lock()  # closes the submit/close race
+        self._pend: Dict[int, Future] = {}  # loop-owned; close() fails leftovers
+        self.stats = {"submitted": 0, "completed": 0, "max_active": 0, "chunks": 0}
+        self._thread = threading.Thread(target=self._loop, daemon=True, name="serving-engine")
+        self._thread.start()
+
+    def fits(self, prompt_len: int, max_new_tokens: int) -> bool:
+        """True when the request can run in the pool WITHOUT truncating
+        where a solo decode wouldn't: the admission bucket (power-of-two
+        left-pad) plus the full token budget must fit the slot window."""
+        ml = self.cb.max_len
+        if prompt_len + 1 >= ml:
+            return False
+        bucket = ContinuousBatcher.bucket_for(prompt_len, ml)
+        return bucket + max_new_tokens + 1 <= ml
+
+    def submit(
+        self, prompt_ids: List[int], max_new_tokens: int = 64, temperature: float = 0.0
+    ) -> Future:
+        """Enqueue a request; the Future resolves to the generated id list."""
+        with self._submit_lock:
+            # Atomic with close()'s drain: without the lock a put landing
+            # between close()'s _closed.set() and its queue drain would
+            # enqueue into a dead loop and hang its caller forever.
+            if self._closed.is_set():
+                raise RuntimeError("ServingEngine is closed")
+            fut: Future = Future()
+            self._q.put((list(prompt_ids), max_new_tokens, temperature, fut))
+            self.stats["submitted"] += 1
+            return fut
+
+    def generate_ids(
+        self, prompt_ids: List[int], max_new_tokens: int = 64, temperature: float = 0.0
+    ) -> List[int]:
+        """Blocking submit — what runtime.generate calls from its executor
+        thread while the loop thread decodes for everyone at once."""
+        return self.submit(prompt_ids, max_new_tokens, temperature).result()
+
+    @staticmethod
+    def _fail(fut: Future, err: BaseException) -> None:
+        """set_exception tolerant of losing the race against the loop's
+        set_result (close() can outlive its 5 s join while a chunk compile
+        finishes): whichever side lands second is a no-op, never an
+        InvalidStateError escaping into restore()/eviction."""
+        try:
+            if not fut.done():
+                fut.set_exception(err)
+        except Exception:  # noqa: BLE001 — InvalidStateError: already resolved
+            pass
+
+    def close(self) -> None:
+        with self._submit_lock:
+            self._closed.set()
+        self._thread.join(timeout=5.0)
+        # Fail anything still queued OR already admitted (mid-decode in
+        # _pend) — callers must not hang on a dead loop.
+        while True:
+            try:
+                *_rest, fut = self._q.get_nowait()
+            except queue.Empty:
+                break
+            self._fail(fut, RuntimeError("ServingEngine closed"))
+        for fut in list(self._pend.values()):
+            self._fail(fut, RuntimeError("ServingEngine closed mid-request"))
+        self._pend.clear()
+
+    def _admit_one(self, item) -> None:
+        ids, max_new, temp, fut = item
+        if not fut.set_running_or_notify_cancel():
+            return
+        try:
+            rid = self.cb.admit(ids, max_new_tokens=max_new, temperature=temp)
+        except Exception as e:  # noqa: BLE001 — admission errors belong to the caller
+            self._fail(fut, e)
+            return
+        self._pend[rid] = fut
+
+    def _loop(self) -> None:
+        try:
+            while not self._closed.is_set():
+                if not self.cb.slots:
+                    # Idle: block for the next request (bounded so close()
+                    # is prompt) instead of spinning on an empty pool.
+                    try:
+                        self._admit_one(self._q.get(timeout=0.1))
+                    except queue.Empty:
+                        continue
+                # Admit everything already waiting while slots are free —
+                # new arrivals join mid-decode at the next chunk boundary.
+                while self.cb.has_capacity:
+                    try:
+                        self._admit_one(self._q.get_nowait())
+                    except queue.Empty:
+                        break
+                if not self.cb.slots:
+                    continue
+                self.stats["max_active"] = max(self.stats["max_active"], self.cb.active)
+                finished = self.cb.step()
+                self.stats["chunks"] += 1
+                for rid in finished:
+                    self.stats["completed"] += 1
+                    fut = self._pend.pop(rid, None)
+                    toks = self.cb.results.pop(rid, [])
+                    if fut is not None and not fut.done():
+                        try:
+                            fut.set_result(toks)
+                        except Exception:  # noqa: BLE001 — close() won the race
+                            pass
+        except BaseException as e:  # noqa: BLE001 — a dead loop must not strand callers
+            # A device/runtime error escaping cb.step() would otherwise
+            # kill this thread silently: every pending Future would hang
+            # forever and later submits would enqueue into a dead loop.
+            # Mark closed (new submits raise) and fail everything pending.
+            with self._submit_lock:
+                self._closed.set()
+            err = RuntimeError(f"ServingEngine loop died: {type(e).__name__}: {e}")
+            for fut in list(self._pend.values()):
+                self._fail(fut, err)
+            self._pend.clear()
+            while True:
+                try:
+                    *_rest, fut = self._q.get_nowait()
+                except queue.Empty:
+                    break
+                self._fail(fut, err)

@@ -222,6 +222,52 @@ def test_experiments_and_playground(tmp_path):
     run(go())
 
 
+def test_playground_concurrent_requests_share_engine(tmp_path, monkeypatch):
+    """Service-level continuous batching: concurrent HTTP playground runs
+    against a real (tiny) TPU runtime all decode through ONE shared
+    ServingEngine KV pool, and each reply equals the runtime's solo
+    (engine-off) output for the same prompt."""
+    import jax.numpy as jnp
+
+    from kakveda_tpu.models.generate import LlamaRuntime
+    from kakveda_tpu.models.llama import LlamaConfig
+
+    cfg = LlamaConfig(
+        vocab_size=264, d_model=32, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=48, max_seq_len=256, dtype=jnp.float32,
+    )
+    monkeypatch.setenv("KAKVEDA_SERVE_CONTINUOUS", "0")
+    rt_solo = LlamaRuntime(cfg=cfg, seed=0)
+    prompts = ["first failure", "second timeout story", "third"]
+    solo = {p: rt_solo.generate(p, max_tokens=8).text for p in prompts}
+    monkeypatch.delenv("KAKVEDA_SERVE_CONTINUOUS", raising=False)
+
+    rt = LlamaRuntime(cfg=cfg, seed=0)
+    plat = Platform(data_dir=tmp_path / "data", capacity=256, dim=1024)
+    app = make_dashboard_app(platform=plat, db_path=tmp_path / "dash.db", model=rt)
+
+    async def go():
+        client = await _client(app)
+        try:
+            await _login(client)
+            rs = await asyncio.gather(
+                *(
+                    client.post("/playground/run", data={"prompt": p, "target": "model"})
+                    for p in prompts
+                )
+            )
+            pages = [await r.text() for r in rs]
+            for p, page in zip(prompts, pages):
+                assert solo[p] in page, f"engine output for {p!r} != solo decode"
+        finally:
+            await client.close()
+
+    run(go())
+    assert rt._engine is not None, "playground did not go through the engine"
+    assert rt._engine.stats["completed"] == len(prompts)
+    rt._engine.close()
+
+
 def test_project_api_key_ingest_and_budget(tmp_path):
     async def go():
         client = await _client(_mk_app(tmp_path))

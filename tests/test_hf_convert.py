@@ -500,6 +500,79 @@ def test_phi3_longrope_mixed_regime_batch_matches_solo(tmp_path):
     assert cont == solo
 
 
+def test_phi3_longrope_chunked_prefill_matches_single_shot(tmp_path):
+    """A >original_max_len prompt prefilled in chunks must rotate EVERY
+    chunk's K/V with the long factors — regime selection reads the full
+    prompt length (threaded via ``seq_total``), not the chunk's own max
+    position, or early chunks land in the short regime and diverge from
+    single-shot prefill. Asserted on logits: on a tiny random model the
+    regime mismatch shifts the final logits by ~5e-4 — far above runtime
+    reorder noise (~1e-6) but not enough to flip a greedy argmax, so a
+    token-level comparison would pass even with the bug present."""
+    from kakveda_tpu.models.generate import _pack_prompts, prefill
+    from kakveda_tpu.models.llama import init_cache
+
+    _make_phi3_checkpoint(tmp_path, seed=25, long_context=True)
+    params, cfg = load_hf_checkpoint(str(tmp_path), param_dtype=jnp.float32)
+    rng = np.random.default_rng(7)
+    long_p = [int(x) for x in rng.integers(5, 250, 140)]  # > original_max (128)
+    ml = 256
+
+    def last_logits(chunk, plen):
+        toks, valid, offs, _ = _pack_prompts([long_p], ml, plen=plen)
+        cache = init_cache(cfg, batch=1, max_len=ml)
+        last, _ = prefill(
+            params, cfg, jnp.asarray(toks), cache,
+            jnp.asarray(valid), jnp.asarray(offs), chunk=chunk,
+        )
+        return np.asarray(last)[:, :256]
+
+    single = last_logits(0, 140)
+    for chunk, plen in ((32, 160), (64, 192)):  # early chunks end < 128
+        np.testing.assert_allclose(last_logits(chunk, plen), single, atol=2e-5, rtol=0)
+
+
+def test_multi_model_runtime_hbm_budget_evicts_then_refuses(tmp_path, monkeypatch):
+    """With KAKVEDA_HBM_BUDGET set: a load that would cross the budget
+    LRU-evicts idle models first; when even eviction can't make room it
+    raises HBMBudgetError BEFORE touching the weights (never OOM). The
+    pre-load estimate comes from config.json alone (eval_shape)."""
+    from kakveda_tpu.models.runtime import HBMBudgetError, MultiModelRuntime
+
+    d1, d2 = tmp_path / "m-one", tmp_path / "m-two"
+    for d, seed in ((d1, 30), (d2, 31)):
+        _make_hf_checkpoint(d, vocab=256, seed=seed)
+        _write_tokenizer(d)
+
+    monkeypatch.delenv("KAKVEDA_HBM_BUDGET", raising=False)
+    mm = MultiModelRuntime([str(d1), str(d2)])
+    one_cost = mm._estimate_bytes(str(d1))
+    mm._get("m-one")
+    exact = mm.loaded_bytes()
+    # the estimate is honest: right order of magnitude vs exact accounting
+    assert 0.5 * exact <= one_cost <= 2.0 * exact, (one_cost, exact)
+
+    # budget fits ONE model: requesting the second evicts the first
+    mm2 = MultiModelRuntime([str(d1), str(d2)], hbm_budget_bytes=int(exact * 1.5))
+    rt_one = mm2._get("m-one")
+    assert set(mm2._loaded) == {"m-one"}
+    mm2._get("m-two")
+    assert set(mm2._loaded) == {"m-two"}, "LRU eviction did not run"
+    assert mm2.loaded_bytes() <= int(exact * 1.5)
+    # the survivor still serves
+    assert mm2.generate("hi", model="m-two").text is not None
+    # an in-flight holder of the evicted runtime: retired (never rebuilds
+    # a KV pool behind the budget's back) but still serves via solo decode
+    assert rt_one._retired and rt_one.engine() is None
+    assert rt_one.generate("still works", max_tokens=4).text is not None
+
+    # budget fits NOTHING: clear refusal, not an OOM
+    mm3 = MultiModelRuntime([str(d1)], hbm_budget_bytes=1024)
+    with pytest.raises(HBMBudgetError, match="HBM budget"):
+        mm3._get("m-one")
+    assert mm3._loaded == {}
+
+
 def test_multi_model_runtime_routes_by_label(tmp_path, monkeypatch):
     """KAKVEDA_HF_CKPTS serves several checkpoints behind one runtime:
     labels come from dir basenames, loading is lazy, and generation routes

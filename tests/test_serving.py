@@ -124,6 +124,136 @@ def test_chunked_prefill_matches_single_shot():
         assert run(chunk) == single, chunk
 
 
+def test_serving_engine_concurrent_requests_one_pool():
+    """The online engine: requests submitted concurrently decode in ONE
+    shared slot pool (max_active > 1) and each comes back byte-identical
+    to its solo greedy decode."""
+    from kakveda_tpu.models.serving import ServingEngine
+
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    prompts = [[5, 6, 7], [10, 11, 12, 13, 14], [42], [9, 8], [100, 101, 102, 103]]
+    solo = [generate_tokens(params, CFG, p, max_new_tokens=10, max_len=64) for p in prompts]
+
+    eng = ServingEngine(params, CFG, batch_slots=4, max_len=64, chunk_steps=4)
+    try:
+        futs = [eng.submit(p, max_new_tokens=10) for p in prompts]
+        outs = [f.result(timeout=120) for f in futs]
+        assert outs == solo
+        assert eng.stats["completed"] == len(prompts)
+        assert eng.stats["max_active"] >= 2  # actually shared, not serialized
+        # per-request budgets: a late admit with its own max_tokens
+        late = eng.generate_ids(prompts[0], max_new_tokens=3)
+        assert late == solo[0][:3]
+    finally:
+        eng.close()
+
+
+def test_serving_engine_rejects_oversized_and_recovers():
+    """An admission that can't fit the slot window fails ONLY that future;
+    the loop keeps serving everyone else."""
+    from kakveda_tpu.models.serving import ServingEngine
+
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    eng = ServingEngine(params, CFG, batch_slots=2, max_len=32, chunk_steps=4)
+    try:
+        assert not eng.fits(40, 4)  # prompt alone exceeds the window
+        assert not eng.fits(10, 32)  # bucket(10)=16, 16+32+1 > 32
+        assert eng.fits(10, 8)
+        import pytest
+
+        with pytest.raises(ValueError):
+            eng.generate_ids(list(range(40)), max_new_tokens=4)
+        ok = eng.generate_ids([5, 6, 7], max_new_tokens=8)
+        assert ok == generate_tokens(params, CFG, [5, 6, 7], max_new_tokens=8, max_len=64)
+    finally:
+        eng.close()
+
+
+def test_runtime_generate_routes_through_engine(monkeypatch):
+    """LlamaRuntime.generate/generate_batch default to the shared engine
+    (meta carries continuous=True) with output identical to the solo path;
+    an oversized request transparently falls back to the per-call decode."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from kakveda_tpu.models.generate import LlamaRuntime
+
+    cfg = LlamaConfig(
+        vocab_size=264, d_model=32, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=48, max_seq_len=256, dtype=jax.numpy.float32,
+    )
+    monkeypatch.delenv("KAKVEDA_PREFILL_CHUNK", raising=False)
+    monkeypatch.setenv("KAKVEDA_SERVE_CONTINUOUS", "0")
+    rt_off = LlamaRuntime(cfg=cfg, seed=0)
+    prompts = ["alpha failure", "beta timeout in retrieval", "gamma"]
+    off = [rt_off.generate(p, max_tokens=10) for p in prompts]
+    assert all("continuous" not in r.meta for r in off)
+
+    monkeypatch.delenv("KAKVEDA_SERVE_CONTINUOUS", raising=False)
+    rt = LlamaRuntime(cfg=cfg, seed=0)
+    with ThreadPoolExecutor(3) as ex:
+        on = list(ex.map(lambda p: rt.generate(p, max_tokens=10), prompts))
+    assert [r.text for r in on] == [r.text for r in off]
+    assert all(r.meta.get("continuous") for r in on)
+    assert rt._engine is not None and rt._engine.stats["completed"] == 3
+
+    # batch entry joins the same shared pool
+    batch = rt.generate_batch(prompts, max_tokens=10)
+    assert [r.text for r in batch] == [r.text for r in off]
+    assert all(r.meta.get("continuous") for r in batch)
+    assert rt._engine.stats["completed"] == 6
+
+    # oversized budget → solo fallback, same engine still alive
+    monkeypatch.setenv("KAKVEDA_SERVE_WINDOW", "32")
+    rt2 = LlamaRuntime(cfg=cfg, seed=0)
+    big = rt2.generate("x " * 20, max_tokens=64)
+    assert "continuous" not in big.meta
+    rt._engine.close()
+    if rt2._engine is not None:
+        rt2._engine.close()
+
+
+def test_serving_engine_loop_death_fails_futures_not_hangs():
+    """If the decode loop dies (device error mid-chunk), pending futures
+    must FAIL — callers blocked on result() would otherwise hang forever —
+    and later submits must raise instead of enqueueing into a dead loop.
+    The runtime layer then falls back to the solo decode path."""
+    import pytest
+
+    from kakveda_tpu.models.serving import ServingEngine
+
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    eng = ServingEngine(params, CFG, batch_slots=2, max_len=64, chunk_steps=4)
+
+    def boom():
+        raise RuntimeError("synthetic device error")
+
+    eng.cb.step = boom  # next chunk kills the loop
+    fut = eng.submit([5, 6, 7], max_new_tokens=8)
+    with pytest.raises(RuntimeError, match="loop died"):
+        fut.result(timeout=30)
+    import time as _t
+
+    for _ in range(50):  # loop marks itself closed promptly
+        if eng._closed.is_set():
+            break
+        _t.sleep(0.1)
+    with pytest.raises(RuntimeError):
+        eng.submit([5], max_new_tokens=2)
+
+
+def test_runtime_masks_padded_vocab_for_byte_tokenizer():
+    """The default config pads the vocab table past the ByteTokenizer's
+    259 decodable ids; the runtime must clamp effective_vocab so no decode
+    path can argmax an undecodable id (observed as stochastic playground
+    500s: ByteTokenizer.decode raising 'bytes must be in range')."""
+    from kakveda_tpu.models.generate import LlamaRuntime
+
+    rt = LlamaRuntime(seed=0)
+    assert rt.cfg.vocab_size == 264
+    assert rt.cfg.effective_vocab == rt.tokenizer.vocab_size == 259
+    rt.generate("any prompt at all", max_tokens=8)  # must not raise on decode
+
+
 def test_chunked_prefill_env_serving_path(monkeypatch):
     """KAKVEDA_PREFILL_CHUNK routes LlamaRuntime serving through chunked
     prefill with identical output; a prompt that fits one chunk skips the
@@ -135,6 +265,7 @@ def test_chunked_prefill_env_serving_path(monkeypatch):
         d_ff=48, max_seq_len=256, dtype=jax.numpy.float32,
     )
     rt = LlamaRuntime(cfg=cfg, seed=0)
+    monkeypatch.setenv("KAKVEDA_SERVE_CONTINUOUS", "0")  # exercise the chunked path itself
     monkeypatch.delenv("KAKVEDA_PREFILL_CHUNK", raising=False)
     plain = rt.generate("hello failure world, summarize with citations", max_tokens=12)
     monkeypatch.setenv("KAKVEDA_PREFILL_CHUNK", "8")
