@@ -511,6 +511,16 @@ class LlamaRuntime:
         self.tokenizer = tokenizer if tokenizer is not None else ByteTokenizer()
         if self.cfg.vocab_size < self.tokenizer.vocab_size:
             raise ValueError("model vocab smaller than tokenizer vocab")
+        kvq = os.environ.get("KAKVEDA_KV_QUANT", "")
+        if kvq and kvq != "none":
+            if kvq != "int8":
+                raise ValueError(f"unknown KAKVEDA_KV_QUANT={kvq!r} (int8|none)")
+            import dataclasses as _dc
+
+            # Serving-layer cache quantization: every decode path this
+            # runtime spawns (chunked, engine, speculative) inherits the
+            # flag through self.cfg.
+            self.cfg = _dc.replace(self.cfg, kv_quant="int8")
         if self.cfg.effective_vocab is None and self.tokenizer.vocab_size < self.cfg.vocab_size:
             # The table is padded past the tokenizer (tp-friendly multiple):
             # without effective_vocab the pad-vocab mask is a no-op and a

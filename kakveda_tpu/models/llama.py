@@ -98,6 +98,12 @@ class LlamaConfig:
     rope_dim_factors: tuple = ()  # short factors
     rope_dim_factors_long: tuple = ()
     rope_attn_scaling: float = 1.0
+    # KV-cache quantization ("" | "int8"): int8 rows + per-row f32 scales
+    # halve the cache — the dominant HBM resident past moderate
+    # batch·context — doubling the servable window per chip. Serving-layer
+    # knob (KAKVEDA_KV_QUANT=int8 on the runtime), orthogonal to weight
+    # quant; parity bounds in tests/test_quant.py.
+    kv_quant: str = ""
 
     def layer_window(self, li: int) -> int:
         """Effective sliding window for layer ``li`` (0 = full causal)."""
@@ -680,15 +686,47 @@ def init_cache(cfg: LlamaConfig, batch: int, max_len: Optional[int] = None) -> P
     buffer is dynamic-update-sliced independently, which XLA turns into
     in-place row writes — one stacked [L, ...] array (whether rebuilt with
     jnp.stack or updated with a leading-dim DUS) either rewrites the whole
-    cache per decode step or compiles pathologically at 1B scale."""
+    cache per decode step or compiles pathologically at 1B scale.
+
+    With ``cfg.kv_quant == "int8"`` the K/V buffers are int8 with per-row
+    (per position, per kv-head) f32 scales ``ks``/``vs`` [B, KV, max_len]:
+    the cache — the dominant HBM resident past moderate batch·context —
+    halves, doubling the servable context window per chip. Rows quantize
+    on write and dequantize on read (`_kv_quant_rows`/`_kv_dequant`)."""
     ml = max_len or cfg.max_seq_len
     hd = cfg.head_dim
     shape = (batch, cfg.n_kv_heads, ml, hd)
+    if cfg.kv_quant == "int8":
+        return {
+            "pos": jnp.zeros((), jnp.int32),
+            "k": [jnp.zeros(shape, jnp.int8) for _ in range(cfg.n_layers)],
+            "v": [jnp.zeros(shape, jnp.int8) for _ in range(cfg.n_layers)],
+            "ks": [jnp.zeros(shape[:3], jnp.float32) for _ in range(cfg.n_layers)],
+            "vs": [jnp.zeros(shape[:3], jnp.float32) for _ in range(cfg.n_layers)],
+        }
     return {
         "pos": jnp.zeros((), jnp.int32),
         "k": [jnp.zeros(shape, cfg.dtype) for _ in range(cfg.n_layers)],
         "v": [jnp.zeros(shape, cfg.dtype) for _ in range(cfg.n_layers)],
     }
+
+
+def _kv_quant_rows(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Symmetric per-row int8 quantization of K/V rows [..., hd]:
+    returns (int8 values, f32 scales [...]) with x ≈ q · scale. Per-row
+    absmax keeps the error relative to each position's own magnitude —
+    a shared tensor scale would crush early-layer K norms."""
+    x32 = x.astype(jnp.float32)
+    s = jnp.max(jnp.abs(x32), axis=-1) / 127.0
+    safe = jnp.maximum(s, 1e-8)[..., None]
+    q = jnp.clip(jnp.round(x32 / safe), -127, 127).astype(jnp.int8)
+    return q, s
+
+
+def _kv_dequant(q: jax.Array, s: jax.Array, dtype) -> jax.Array:
+    """Inverse of :func:`_kv_quant_rows`; unwritten slots carry scale 0 and
+    dequantize to exact zeros (masked by kv_valid/causality anyway)."""
+    return q.astype(dtype) * s[..., None].astype(dtype)
 
 
 def decode_step(
@@ -732,8 +770,11 @@ def decode_step(
     hd = cfg.head_dim
 
     x = embed_tokens(params, cfg, tokens)
+    kq = cfg.kv_quant == "int8"
     new_k: list = []
     new_v: list = []
+    new_ks: list = []
+    new_vs: list = []
     for li, layer in enumerate(params["layers"]):
         h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
         dt = h.dtype
@@ -742,12 +783,27 @@ def decode_step(
         k = apply_rope(k, cos, sin)
 
         # Head-major cache writes: [B, S, KV, D] -> [B, KV, S, D] slab.
-        k_all = jax.lax.dynamic_update_slice(
-            cache["k"][li], k.transpose(0, 2, 1, 3).astype(cfg.dtype), (0, 0, pos0, 0)
-        )
-        v_all = jax.lax.dynamic_update_slice(
-            cache["v"][li], v.transpose(0, 2, 1, 3).astype(cfg.dtype), (0, 0, pos0, 0)
-        )
+        k_rows = k.transpose(0, 2, 1, 3)
+        v_rows = v.transpose(0, 2, 1, 3)
+        if kq:
+            k_i8, k_sc = _kv_quant_rows(k_rows)
+            v_i8, v_sc = _kv_quant_rows(v_rows)
+            k_all = jax.lax.dynamic_update_slice(cache["k"][li], k_i8, (0, 0, pos0, 0))
+            v_all = jax.lax.dynamic_update_slice(cache["v"][li], v_i8, (0, 0, pos0, 0))
+            ks_all = jax.lax.dynamic_update_slice(cache["ks"][li], k_sc, (0, 0, pos0))
+            vs_all = jax.lax.dynamic_update_slice(cache["vs"][li], v_sc, (0, 0, pos0))
+            new_ks.append(ks_all)
+            new_vs.append(vs_all)
+            k_read = _kv_dequant(k_all, ks_all, cfg.dtype)
+            v_read = _kv_dequant(v_all, vs_all, cfg.dtype)
+        else:
+            k_all = jax.lax.dynamic_update_slice(
+                cache["k"][li], k_rows.astype(cfg.dtype), (0, 0, pos0, 0)
+            )
+            v_all = jax.lax.dynamic_update_slice(
+                cache["v"][li], v_rows.astype(cfg.dtype), (0, 0, pos0, 0)
+            )
+            k_read, v_read = k_all, v_all
         new_k.append(k_all)
         new_v.append(v_all)
 
@@ -755,7 +811,7 @@ def decode_step(
         # elsewhere — either way K/V are read once, not n_rep times, and
         # the causal mask (q_pos >= slot) also excludes unwritten slots.
         attn = gqa_cache_attention(
-            q, k_all, v_all, pos0, kv_valid,
+            q, k_read, v_read, pos0, kv_valid,
             window=cfg.layer_window(li), softcap=cfg.attn_softcap,
         )
         attn = attn.reshape(b, s, cfg.n_heads * hd) @ wmat(layer["wo"], dt)
@@ -775,4 +831,7 @@ def decode_step(
     logits = (x @ wmat(params["lm_head"], cfg.dtype)).astype(jnp.float32)
     logits = softcap_logits(logits, cfg.final_softcap)
     new_cache = {"pos": pos0 + s, "k": new_k, "v": new_v}
+    if kq:
+        new_cache["ks"] = new_ks
+        new_cache["vs"] = new_vs
     return logits, new_cache

@@ -90,19 +90,18 @@ def _admit_jit(params, cfg: LlamaConfig, cache, last, prompt, slot, kv_valid, po
         pos_offset=pos_offset[slot][None],
         last_only=True,
     )
-    new_k = [
-        jax.lax.dynamic_update_slice(ck, sk, (slot, 0, 0, 0))
-        for ck, sk in zip(cache["k"], scratch["k"])
-    ]
-    new_v = [
-        jax.lax.dynamic_update_slice(cv, sv, (slot, 0, 0, 0))
-        for cv, sv in zip(cache["v"], scratch["v"])
-    ]
+    out = {"pos": cache["pos"]}
+    for key in ("k", "v") + (("ks", "vs") if cfg.kv_quant == "int8" else ()):
+        zeros = (0,) * (cache[key][0].ndim - 1)
+        out[key] = [
+            jax.lax.dynamic_update_slice(ck, sk, (slot, *zeros))
+            for ck, sk in zip(cache[key], scratch[key])
+        ]
     nl = mask_pad_vocab(logits[:, -1, :], cfg)
     last = jax.lax.dynamic_update_slice(last, nl, (slot, 0))
     # cache["pos"] is managed per-slot on host (slot positions differ);
     # the batch cache carries pos=0 and step passes explicit positions.
-    return {"pos": cache["pos"], "k": new_k, "v": new_v}, last
+    return out, last
 
 
 @partial(jax.jit, static_argnames=("cfg", "n_steps"), donate_argnums=(2,))
@@ -119,6 +118,8 @@ def _step_chunk_jit(params, cfg: LlamaConfig, cache, last, slot_pos, kv_valid, p
     """
     from kakveda_tpu.models.attention import gqa_cache_attention
     from kakveda_tpu.models.llama import (
+        _kv_dequant,
+        _kv_quant_rows,
         _rope_freqs,
         apply_rope,
         embed_tokens,
@@ -132,9 +133,10 @@ def _step_chunk_jit(params, cfg: LlamaConfig, cache, last, slot_pos, kv_valid, p
     b = last.shape[0]
     hd = cfg.head_dim
     max_len = cache["k"][0].shape[2]
+    kq = cfg.kv_quant == "int8"
 
     def one_step(carry, _):
-        cache_k, cache_v, last, slot_pos, rng = carry
+        cache_k, cache_v, cache_ks, cache_vs, last, slot_pos, rng = carry
         rng, sub = jax.random.split(rng)
         sampled = jax.random.categorical(
             sub, last / jnp.maximum(temps, 1e-6)[:, None], axis=-1
@@ -144,7 +146,7 @@ def _step_chunk_jit(params, cfg: LlamaConfig, cache, last, slot_pos, kv_valid, p
         positions = (slot_pos - pos_offset)[:, None]  # logical positions
         cos, sin = _rope_freqs(cfg, positions)
         x = embed_tokens(params, cfg, tokens)
-        new_k, new_v = [], []
+        new_k, new_v, new_ks, new_vs = [], [], [], []
         # Validity for reads this step: slots < own write index, plus self.
         # A sliding window (Mistral) folds in here — the query's slot index
         # IS slot_pos[b], so the band is (slot_pos − window, slot_pos].
@@ -165,17 +167,33 @@ def _step_chunk_jit(params, cfg: LlamaConfig, cache, last, slot_pos, kv_valid, p
             # Per-slot scatter: k[b] -> cache_k[li][b, :, slot_pos[b]] —
             # a real scatter (in-place row writes), not a whole-cache
             # rewrite via one-hot blending.
-            kh = k.transpose(0, 2, 1, 3).astype(cfg.dtype)[:, :, 0, :]  # [B, KV, D]
-            vh = v.transpose(0, 2, 1, 3).astype(cfg.dtype)[:, :, 0, :]
+            kh = k.transpose(0, 2, 1, 3)[:, :, 0, :]  # [B, KV, D]
+            vh = v.transpose(0, 2, 1, 3)[:, :, 0, :]
             rows = jnp.arange(b)
-            k_all = cache_k[li].at[rows, :, slot_pos, :].set(kh, mode="drop")
-            v_all = cache_v[li].at[rows, :, slot_pos, :].set(vh, mode="drop")
+            if kq:
+                # Same per-row quantizer as decode_step, so a slot's cache
+                # bytes are identical to its solo decode — int8 parity is
+                # exact, not approximate-squared.
+                k_i8, k_sc = _kv_quant_rows(kh)
+                v_i8, v_sc = _kv_quant_rows(vh)
+                k_all = cache_k[li].at[rows, :, slot_pos, :].set(k_i8, mode="drop")
+                v_all = cache_v[li].at[rows, :, slot_pos, :].set(v_i8, mode="drop")
+                ks_all = cache_ks[li].at[rows, :, slot_pos].set(k_sc, mode="drop")
+                vs_all = cache_vs[li].at[rows, :, slot_pos].set(v_sc, mode="drop")
+                new_ks.append(ks_all)
+                new_vs.append(vs_all)
+                k_read = _kv_dequant(k_all, ks_all, cfg.dtype)
+                v_read = _kv_dequant(v_all, vs_all, cfg.dtype)
+            else:
+                k_all = cache_k[li].at[rows, :, slot_pos, :].set(kh.astype(cfg.dtype), mode="drop")
+                v_all = cache_v[li].at[rows, :, slot_pos, :].set(vh.astype(cfg.dtype), mode="drop")
+                k_read, v_read = k_all, v_all
             new_k.append(k_all)
             new_v.append(v_all)
             # Attention over the slot's valid prefix. pos0=max_len makes the
             # kernel's scalar causal mask a no-op; step_valid does the work.
             attn = gqa_cache_attention(
-                q, k_all, v_all, jnp.asarray(max_len), step_valid, softcap=cfg.attn_softcap
+                q, k_read, v_read, jnp.asarray(max_len), step_valid, softcap=cfg.attn_softcap
             )
             attn = attn.reshape(b, 1, cfg.n_heads * hd) @ wmat(layer["wo"], dt)
             if "post_attn_norm" in layer:
@@ -190,12 +208,20 @@ def _step_chunk_jit(params, cfg: LlamaConfig, cache, last, slot_pos, kv_valid, p
         logits = (x @ wmat(params["lm_head"], cfg.dtype)).astype(jnp.float32)[:, -1, :]
         logits = softcap_logits(logits, cfg.final_softcap)
         logits = mask_pad_vocab(logits, cfg)
-        return (new_k, new_v, logits, slot_pos + 1, rng), nxt
+        return (new_k, new_v, new_ks, new_vs, logits, slot_pos + 1, rng), nxt
 
-    (ck, cv, last, slot_pos, rng), toks = jax.lax.scan(
-        one_step, (cache["k"], cache["v"], last, slot_pos, rng), None, length=n_steps
+    init = (
+        cache["k"], cache["v"],
+        cache.get("ks", []), cache.get("vs", []),
+        last, slot_pos, rng,
     )
-    return {"pos": cache["pos"], "k": ck, "v": cv}, last, slot_pos, rng, toks.T  # [B, n_steps]
+    (ck, cv, cks, cvs, last, slot_pos, rng), toks = jax.lax.scan(
+        one_step, init, None, length=n_steps
+    )
+    out = {"pos": cache["pos"], "k": ck, "v": cv}
+    if kq:
+        out["ks"], out["vs"] = cks, cvs
+    return out, last, slot_pos, rng, toks.T  # [B, n_steps]
 
 
 @dataclass

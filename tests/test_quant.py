@@ -50,6 +50,84 @@ def test_quantized_logits_close_and_generation_runs():
     assert rt.generate("hello world", max_tokens=8).text == r.text
 
 
+def test_int8_kv_cache_parity_bounds():
+    """kv_quant=int8: cached decode logits stay close to the fp-cache
+    logits (per-row symmetric quantization of K/V rows), the cache halves
+    its bytes, and the quantized row roundtrip is within half a step."""
+    import dataclasses
+
+    from kakveda_tpu.models.generate import _decode_jit
+    from kakveda_tpu.models.llama import _kv_dequant, _kv_quant_rows, init_cache
+
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    cfg8 = dataclasses.replace(CFG, kv_quant="int8")
+    toks = jnp.asarray(np.random.default_rng(1).integers(3, 259, size=(2, 24)), jnp.int32)
+
+    # roundtrip bound on raw rows
+    rows = jax.random.normal(jax.random.PRNGKey(2), (2, 2, 8, 16))
+    q, s = _kv_quant_rows(rows)
+    recon = _kv_dequant(q, s, jnp.float32)
+    assert float(jnp.max(jnp.abs(rows - recon))) <= float(jnp.max(s)) * 0.5 + 1e-7
+
+    # prefill + a few cached decode steps under both cache dtypes
+    def run(cfg):
+        cache = init_cache(cfg, batch=2, max_len=64)
+        logits, cache = _decode_jit(params, cfg, toks, cache)
+        outs = [np.asarray(logits[:, -1, :])]
+        nxt = jnp.argmax(logits[:, -1, :], axis=-1)
+        for _ in range(4):
+            logits, cache = _decode_jit(params, cfg, nxt[:, None].astype(jnp.int32), cache)
+            outs.append(np.asarray(logits[:, -1, :]))
+            nxt = jnp.argmax(logits[:, -1, :], axis=-1)
+        return np.stack(outs), cache
+
+    ref, cache_fp = run(CFG)
+    got, cache_q = run(cfg8)
+    a, b = ref.reshape(-1, CFG.vocab_size), got.reshape(-1, CFG.vocab_size)
+    cos = (a * b).sum(-1) / (np.linalg.norm(a, axis=-1) * np.linalg.norm(b, axis=-1))
+    assert cos.min() > 0.999, cos.min()
+
+    # the cache actually halves: int8 values + f32 per-row scales
+    def cache_bytes(c):
+        return sum(x.size * x.dtype.itemsize for k in ("k", "v", "ks", "vs")
+                   for x in c.get(k, []))
+
+    assert cache_q["k"][0].dtype == jnp.int8
+    assert cache_bytes(cache_q) < 0.6 * cache_bytes(cache_fp)
+
+
+def test_int8_kv_cache_continuous_batcher_matches_solo():
+    """int8-cache parity is exact between the batcher's per-slot scatter
+    writes and the solo decode: both quantize the same rows with the same
+    quantizer, so greedy outputs are identical."""
+    import dataclasses
+
+    from kakveda_tpu.models.generate import generate_tokens
+    from kakveda_tpu.models.serving import ContinuousBatcher
+
+    cfg8 = dataclasses.replace(CFG, kv_quant="int8")
+    params = init_params(jax.random.PRNGKey(3), cfg8)
+    prompts = [[5, 6, 7], [10, 11, 12, 13, 14], [42, 43]]
+    solo = [generate_tokens(params, cfg8, p, max_new_tokens=10, max_len=64) for p in prompts]
+    cb = ContinuousBatcher(params, cfg8, batch_slots=2, max_len=64, chunk_steps=4)
+    assert cb.run_all(prompts, max_new_tokens=10) == solo
+
+
+def test_kv_quant_env_routes_runtime(monkeypatch):
+    """KAKVEDA_KV_QUANT=int8 flips the runtime's whole decode surface to
+    the quantized cache; output text still deterministic."""
+    monkeypatch.setenv("KAKVEDA_KV_QUANT", "int8")
+    rt = LlamaRuntime(cfg=CFG, seed=0)
+    assert rt.cfg.kv_quant == "int8"
+    a = rt.generate("hello kv world", max_tokens=8)
+    assert a.text == rt.generate("hello kv world", max_tokens=8).text
+    monkeypatch.setenv("KAKVEDA_KV_QUANT", "bogus")
+    import pytest
+
+    with pytest.raises(ValueError, match="KAKVEDA_KV_QUANT"):
+        LlamaRuntime(cfg=CFG, seed=0)
+
+
 def test_int8_quantizes_moe_expert_stacks():
     """Mixtral-style trees: stacked [E, in, out] expert weights quantize
     per-(expert, out-channel) — on MoE models they are ~95% of weight
