@@ -150,6 +150,39 @@ class TestClustering:
         labels = cluster_embeddings(vecs, threshold=0.5)
         assert len(set(labels.tolist())) == 4
 
+    def test_degree_cap_prevents_boilerplate_chaining(self):
+        """Boilerplate-heavy corpora put MANY cross-template pairs above
+        the threshold; the raw threshold graph transitively chains every
+        template into one blob. The union-top-k semantics (shared by both
+        tiers) keep each row's edges among its own template when the
+        template has > k members — per-template clusters survive."""
+        rng = np.random.default_rng(7)
+        shared = rng.standard_normal(64)
+        shared /= np.linalg.norm(shared)
+        n_templates, per = 4, 100  # 100 > _KNN_K: the cap engages
+        rows = []
+        truth = []
+        for t in range(n_templates):
+            delta = rng.standard_normal(64)
+            c = shared + 0.45 * delta / np.linalg.norm(delta)  # cross-cos ~0.8
+            c /= np.linalg.norm(c)
+            for _ in range(per):
+                w = c + 0.03 * rng.standard_normal(64)  # within-cos ~0.995
+                rows.append(w / np.linalg.norm(w))
+                truth.append(t)
+        vecs = np.stack(rows).astype(np.float32)
+        sims = vecs @ vecs.T
+        cross = sims[:per, per : 2 * per]
+        assert cross.mean() > 0.6, "setup: cross-template sims must clear the threshold"
+        labels = cluster_embeddings(vecs, threshold=0.6)
+        # purity: majority template per label
+        correct = 0
+        for lb in set(labels.tolist()):
+            member_t = [truth[i] for i in np.flatnonzero(labels == lb)]
+            correct += max(member_t.count(t) for t in set(member_t))
+        assert correct / len(rows) > 0.99, correct / len(rows)
+        assert len(set(labels.tolist())) >= n_templates
+
 
 def test_stub_runtime_matches_reference_text():
     res = StubRuntime().generate("anything")
