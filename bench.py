@@ -313,26 +313,35 @@ def _measure_decode(preset: str, bsz: int, steps: int) -> dict:
         "v4": 275e12, "v5 lite": 197e12, "v5e": 197e12,
         "v5p": 459e12, "v6": 918e12, "v6e": 918e12,
     }
+    peak_bw = {
+        # HBM GB/s per chip — the decode roofline. MFU alone makes decode
+        # look bad (it is bandwidth-bound); % of peak HBM says how close
+        # to the real ceiling the run is.
+        "v4": 1228e9, "v5 lite": 819e9, "v5e": 819e9,
+        "v5p": 2765e9, "v6": 1640e9, "v6e": 1640e9,
+    }
     kind = jax.devices()[0].device_kind.lower()
     peak_flops = next((v for k, v in peak.items() if k in kind), 197e12)
+    peak_hbm = next((v for k, v in peak_bw.items() if k in kind), 819e9)
 
     rng = np.random.default_rng(0)
 
-    def timed(prm, b: int, p: int, n_steps: int, reps: int = 3) -> float:
+    def timed(prm, b: int, p: int, n_steps: int, reps: int = 3, cfg_=None) -> float:
         """Best-of-reps wall time of one fused generation (prefill p tokens
         + n_steps decode) at batch b. np.asarray syncs through the wire, so
         every timing carries the same fixed RTT — all derived numbers below
         are *slopes* between two timings, which cancels it."""
-        toks = jnp.asarray(rng.integers(3, cfg.vocab_size, size=(b, p)), jnp.int32)
+        c = cfg_ or cfg
+        toks = jnp.asarray(rng.integers(3, c.vocab_size, size=(b, p)), jnp.int32)
         valid = jnp.ones((b, 512), bool)
         offs = jnp.zeros((b,), jnp.int32)
         key = jax.random.PRNGKey(0)
         temp = jnp.asarray(1e-6, jnp.float32)
 
         def gen():
-            cache = init_cache(cfg, batch=b, max_len=512)
+            cache = init_cache(c, batch=b, max_len=512)
             out = _generate_fused_jit(
-                prm, cfg, toks, cache, valid, offs, key, temp, n_steps, True
+                prm, c, toks, cache, valid, offs, key, temp, n_steps, True
             )
             return np.asarray(out)
 
@@ -346,8 +355,8 @@ def _measure_decode(preset: str, bsz: int, steps: int) -> dict:
 
     s_lo = max(1, steps // 4)
 
-    def decode_rate(prm, b: int) -> float:
-        dt = timed(prm, b, plen, steps) - timed(prm, b, plen, s_lo)
+    def decode_rate(prm, b: int, cfg_=None) -> float:
+        dt = timed(prm, b, plen, steps, cfg_=cfg_) - timed(prm, b, plen, s_lo, cfg_=cfg_)
         return b * (steps - s_lo) / max(dt, 1e-9)
 
     decode_tps = decode_rate(params, bsz)
@@ -368,6 +377,7 @@ def _measure_decode(preset: str, bsz: int, steps: int) -> dict:
     # serving lever (models/quant.py). Skipped when the main run is already
     # int8 (KAKVEDA_BENCH_QUANT) or KAKVEDA_BENCH_INT8=0.
     int8_tps = None
+    int8_curve: dict = {}
     if (
         os.environ.get("KAKVEDA_BENCH_QUANT") != "int8"
         and os.environ.get("KAKVEDA_BENCH_INT8", "1") != "0"
@@ -376,7 +386,27 @@ def _measure_decode(preset: str, bsz: int, steps: int) -> dict:
 
         qparams = quantize_params_int8(params)
         int8_tps = decode_rate(qparams, bsz)
+        # int8 row of the SAME batch curve: halving the weight stream
+        # matters most where weights dominate traffic (small batch) and
+        # fades as the KV cache takes over (large batch) — the crossover
+        # is visible only with both rows measured.
+        int8_curve = {bsz: int8_tps}
+        for b in curve:
+            if b != bsz:
+                int8_curve[b] = decode_rate(qparams, b)
         del qparams
+
+    # int8 KV cache at the largest curve batch: past the crossover the
+    # cache is the binding HBM stream, so this is where cache quant pays.
+    kv8_tps = None
+    if os.environ.get("KAKVEDA_BENCH_KV8", "1") != "0":
+        import dataclasses as _dc
+
+        cfg8 = _dc.replace(cfg, kv_quant="int8")
+        b_big = max(curve)
+        kv8_tps = {b_big: decode_rate(params, b_big, cfg8)}
+        if bsz != b_big:
+            kv8_tps[bsz] = decode_rate(params, bsz, cfg8)
 
     # Prefill slope between two prompt lengths at one decode step.
     p_hi = 384
@@ -385,11 +415,33 @@ def _measure_decode(preset: str, bsz: int, steps: int) -> dict:
 
     mfu = decode_tps * flops_per_tok / peak_flops
     prefill_mfu = prefill_tps * (2 * n_mat) / peak_flops
+
+    # Decode roofline: achieved HBM traffic as a fraction of peak
+    # bandwidth. Per step the chip streams every dense weight once
+    # (2 bytes/param bf16) plus each sequence's K/V prefix
+    # (2·L·KV·hd·mean_ctx·2 bytes); "good" decode = hbm_util near 1,
+    # NOT mfu near 1 (decode is bandwidth-bound by construction).
+    def hbm_util(tps: float, b: int, w_bytes_per_param: float, cache_itemsize: float) -> float:
+        w_bytes = w_bytes_per_param * n_mat
+        kv_bytes = 2 * cfg.n_layers * cfg.n_kv_heads * cfg.head_dim * mean_ctx * cache_itemsize
+        return (tps / b) * (w_bytes + b * kv_bytes) / peak_hbm
+
+    cache_b = 2 if cfg.dtype == jnp.bfloat16 else 4
+    utils = {"bf16": hbm_util(decode_tps, bsz, 2.0, cache_b)}
+    if int8_tps:
+        utils["int8"] = hbm_util(int8_tps, bsz, 1.0, cache_b)
+    if kv8_tps:
+        b_big = max(kv8_tps)
+        # int8 rows + one f32 scale per head_dim elements
+        utils["kv8"] = hbm_util(kv8_tps[b_big], b_big, 2.0, 1.0 + 4.0 / cfg.head_dim)
     return {
         "decode_tps": decode_tps,
         "prefill_tps": prefill_tps,
         "solo_tps": solo_tps,
         "int8_tps": int8_tps,
+        "int8_curve": int8_curve,
+        "kv8_tps": kv8_tps,
+        "hbm_util": utils,
         "mfu": mfu,
         "prefill_mfu": prefill_mfu,
         "curve": curve,
@@ -397,6 +449,7 @@ def _measure_decode(preset: str, bsz: int, steps: int) -> dict:
         "batch": bsz,
         "device_kind": kind,
         "peak_tflops": peak_flops / 1e12,
+        "peak_hbm_gbps": peak_hbm / 1e9,
     }
 
 
@@ -457,6 +510,61 @@ def _measure_spec(preset: str, steps: int, k: int) -> dict:
     }
 
 
+def _measure_spec_judge(k: int) -> dict:
+    """Acceptance on the PRODUCTION workload shape: the failure-judge
+    template over near-duplicate traces. Acceptance depends on weights
+    (a model must actually continue the repeated n-grams), so a tiny
+    model is trained on judge-formatted traces in-bench — minutes, vs
+    days for the 1B preset — and acceptance is measured speculating a
+    held-out judge prompt. tokens/round is the number that transfers
+    across scales (each round = one weight stream regardless of size);
+    the tiny-scale tok/s here are NOT the 1B serving numbers."""
+    import jax.numpy as jnp
+
+    from kakveda_tpu.models.llama import LlamaConfig
+    from kakveda_tpu.models.speculative import generate_tokens_speculative
+    from kakveda_tpu.models.tokenizer import ByteTokenizer
+    from kakveda_tpu.models.train import fit
+    from kakveda_tpu.pipeline.classifier import _JUDGE_PROMPT
+
+    cfg = LlamaConfig(
+        vocab_size=264, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=128, max_seq_len=512, dtype=jnp.float32,
+    )
+    rng = np.random.default_rng(0)
+    apps = ["billing", "search", "support"]
+
+    def trace(i: int) -> str:
+        return _JUDGE_PROMPT.format(
+            prompt=f"Summarize the {apps[i % 3]} report {i} and include citations "
+            "even if not provided",
+            response=f"Here is a summary with references. [1] Smith et al. (2020) "
+            f"A Study on Things. [2] Doe (2021) Another Paper. item {i}",
+        ) + (" YES\n" if i % 3 else " NO\n")
+
+    corpus = "".join(trace(i) for i in range(40))
+    steps_tr = int(os.environ.get("KAKVEDA_BENCH_SPEC_JUDGE_STEPS", 150))
+    params, losses = fit(cfg, corpus, steps=steps_tr, batch=4, seq_len=128, lr=3e-3, log_every=0)
+
+    held_out = _JUDGE_PROMPT.format(
+        prompt="Summarize the billing report 999 and include citations even if not provided",
+        response="Here is a summary with references. [1] Smith et al. (2020) "
+        "A Study on Things. [2] Doe (2021) Another Paper. item 999",
+    )
+    # No truncation: the template header must sit in the lookup buffer or
+    # the first generated copy of it has nothing to match against.
+    ids = ByteTokenizer().encode(held_out)
+    _, st = generate_tokens_speculative(
+        params, cfg, ids, max_new_tokens=96, k=k, return_stats=True
+    )
+    return {
+        "tokens_per_round": st["tokens_per_round"],
+        "rounds": st["rounds"],
+        "train_loss": float(losses[-1]),
+        "train_steps": steps_tr,
+    }
+
+
 def _bench_spec(backend: str) -> dict:
     preset = os.environ.get("KAKVEDA_BENCH_DECODE_PRESET", "1b" if backend == "tpu" else "tiny")
     steps = int(os.environ.get("KAKVEDA_BENCH_SPEC_STEPS", 256))
@@ -466,10 +574,10 @@ def _bench_spec(backend: str) -> dict:
     print(
         f"bench[spec]: speculative {r['spec_tps']:,.0f} tok/s vs plain {r['plain_tps']:,.0f} "
         f"tok/s @batch 1 ({r['tokens_per_round']:.2f} tokens/round, k={k}, random-init "
-        f"= conservative acceptance)",
+        f"= conservative acceptance floor)",
         file=sys.stderr,
     )
-    return {
+    out = {
         "metric": f"speculative_decode_tokens_per_sec_{preset}_b1",
         "value": round(r["spec_tps"], 1),
         "unit": "tokens/sec",
@@ -477,6 +585,26 @@ def _bench_spec(backend: str) -> dict:
         "plain_tps": round(r["plain_tps"], 1),
         "tokens_per_round": round(r["tokens_per_round"], 2),
     }
+    if os.environ.get("KAKVEDA_BENCH_SPEC_JUDGE", "1") != "0":
+        j = _measure_spec_judge(k)
+        # Projection to the main preset: rounds are weight-stream-bound,
+        # so tok/s scales with acceptance at ~the floor run's per-round
+        # overhead. Clearly a projection, not a measurement.
+        overhead = (
+            r["plain_tps"] * r["tokens_per_round"] / r["spec_tps"]
+            if r["spec_tps"] > 0 else 1.0
+        )
+        projected = r["plain_tps"] * j["tokens_per_round"] / max(overhead, 1e-9)
+        print(
+            f"bench[spec]: judge-workload acceptance {j['tokens_per_round']:.2f} "
+            f"tokens/round (tiny model trained {j['train_steps']} steps on the "
+            f"judge template, loss {j['train_loss']:.3f}) — projected "
+            f"{projected:,.0f} tok/s at {preset} scale at that acceptance",
+            file=sys.stderr,
+        )
+        out["judge_tokens_per_round"] = round(j["tokens_per_round"], 2)
+        out["judge_projected_tps"] = round(projected, 1)
+    return out
 
 
 def _measure_mixed(n: int, dim: int) -> dict:
@@ -827,13 +955,22 @@ def _bench_decode(backend: str) -> dict:
     print(f"bench[decode]: backend={backend} preset={preset} batch={bsz} steps={steps}", file=sys.stderr)
     r = _measure_decode(preset, bsz, steps)
     curve_s = " ".join(f"b{b}={v:,.0f}" for b, v in sorted(r["curve"].items()))
-    int8_s = f", int8 {r['int8_tps']:,.0f} tok/s" if r["int8_tps"] else ""
+    int8_s = (
+        " | int8 " + " ".join(f"b{b}={v:,.0f}" for b, v in sorted(r["int8_curve"].items()))
+        if r["int8_curve"] else ""
+    )
+    kv8_s = (
+        " | kv8 " + " ".join(f"b{b}={v:,.0f}" for b, v in sorted(r["kv8_tps"].items()))
+        if r["kv8_tps"] else ""
+    )
+    util_s = " ".join(f"{k}={v*100:.0f}%" for k, v in r["hbm_util"].items())
     print(
         f"bench[decode]: {r['n_params']/1e9:.2f}B params on {r['device_kind']} "
-        f"(peak {r['peak_tflops']:.0f} bf16 TFLOP/s assumed) — decode {r['decode_tps']:,.0f} tok/s "
-        f"@batch {r['batch']} (MFU {r['mfu']*100:.1f}%), prefill {r['prefill_tps']:,.0f} tok/s "
-        f"(MFU {r['prefill_mfu']*100:.1f}%), unbatched {r['solo_tps']:,.0f} tok/s, "
-        f"curve {curve_s}{int8_s}",
+        f"(peak {r['peak_tflops']:.0f} bf16 TFLOP/s, {r['peak_hbm_gbps']:.0f} GB/s HBM assumed) — "
+        f"decode {r['decode_tps']:,.0f} tok/s @batch {r['batch']} (MFU {r['mfu']*100:.1f}%), "
+        f"prefill {r['prefill_tps']:,.0f} tok/s (MFU {r['prefill_mfu']*100:.1f}%), "
+        f"unbatched {r['solo_tps']:,.0f} tok/s, curve {curve_s}{int8_s}{kv8_s} "
+        f"| HBM roofline {util_s}",
         file=sys.stderr,
     )
     out = {
@@ -842,12 +979,16 @@ def _bench_decode(backend: str) -> dict:
         "unit": "tokens/sec",
         "vs_baseline": round(r["decode_tps"] / r["solo_tps"], 1) if r["solo_tps"] > 0 else 0.0,
         "mfu": round(r["mfu"], 4),
+        "hbm_util": {k: round(v, 3) for k, v in r["hbm_util"].items()},
         "prefill_tokens_per_sec": round(r["prefill_tps"], 1),
         "prefill_mfu": round(r["prefill_mfu"], 4),
         "decode_tps_curve": {str(b): round(v, 1) for b, v in sorted(r["curve"].items())},
     }
-    if r["int8_tps"]:
+    if r["int8_curve"]:
         out["int8_decode_tps"] = round(r["int8_tps"], 1)
+        out["int8_decode_tps_curve"] = {str(b): round(v, 1) for b, v in sorted(r["int8_curve"].items())}
+    if r["kv8_tps"]:
+        out["kv8_decode_tps_curve"] = {str(b): round(v, 1) for b, v in sorted(r["kv8_tps"].items())}
     return out
 
 
@@ -911,6 +1052,16 @@ def _bench_mine(backend: str) -> dict:
         f"({r['clusters']} clusters, purity {r['purity']:.3f}; host embed {r['embed_s']:.1f}s)",
         file=sys.stderr,
     )
+    # Self-certifying: a wall time whose clustering is wrong is not a
+    # result. Purity is computed on THIS run's labels (not a calibration
+    # run at another scale); below the floor the metric FAILS rather than
+    # reporting a meaningless speed.
+    min_purity = float(os.environ.get("KAKVEDA_BENCH_MINE_MIN_PURITY", 0.99))
+    if r["purity"] < min_purity:
+        raise AssertionError(
+            f"mine purity {r['purity']:.4f} below the {min_purity} floor at "
+            f"{r['n']:,} rows ({r['clusters']} clusters) — wall time not reportable"
+        )
     return {
         "metric": f"mine_wall_s_at_{n}_gfkb",
         "value": round(r["wall_s"], 2),
@@ -918,6 +1069,7 @@ def _bench_mine(backend: str) -> dict:
         "vs_baseline": round(r["purity"], 4),
         "clusters": r["clusters"],
         "purity": round(r["purity"], 4),
+        "min_purity": min_purity,
     }
 
 
