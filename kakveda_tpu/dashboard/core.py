@@ -42,6 +42,13 @@ class DashboardContext:
             loader=jinja2.FileSystemLoader(str(TEMPLATES_DIR)),
             autoescape=True,
         )
+        # Epoch-seconds → "YYYY-MM-DD HH:MM" UTC; DB rows store raw floats.
+        import datetime as _dt
+
+        self.jinja.filters["ts_utc"] = lambda ts: (
+            _dt.datetime.fromtimestamp(float(ts), tz=_dt.timezone.utc).strftime("%Y-%m-%d %H:%M")
+            if ts else "—"
+        )
 
     def render(self, request: web.Request, template: str, **ctx: Any) -> web.Response:
         user = request.get("user")

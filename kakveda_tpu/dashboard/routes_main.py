@@ -267,35 +267,94 @@ def setup(app: web.Application) -> None:
 
     @require_login
     async def warnings_page(request):
-        """Warning list + 30d analytics: by day, by app, by pattern, cost by
-        app (reference: services/dashboard/app.py:1912-2041)."""
+        """Warning list + interactive analytics (reference:
+        services/dashboard/app.py:1912-2041, templates/warnings.html):
+        stat tiles, a 30-day daily-count chart with every day present,
+        per-app / per-pattern / cost breakdowns — plus the raw 90-day rows
+        shipped as JSON so the 30d/90d window and app filter re-aggregate
+        CLIENT-side with no round trip."""
         now = time.time()
         d30 = now - 30 * 86400
-        events = ctx.db.query(
-            "SELECT * FROM warning_events WHERE ts>? ORDER BY ts DESC LIMIT 500", (now - 90 * 86400,)
-        )
-        by_day: Dict[str, int] = defaultdict(int)
-        by_app: Dict[str, int] = defaultdict(int)
-        by_pattern: Dict[str, int] = defaultdict(int)
-        for e in events:
-            if e["ts"] >= d30:
-                day = datetime.fromtimestamp(e["ts"], tz=timezone.utc).strftime("%Y-%m-%d")
-                by_day[day] += 1
-                by_app[e["app_id"]] += 1
-                if e["pattern_id"]:
-                    by_pattern[e["pattern_id"]] += 1
-        cost_rows = ctx.db.query(
-            "SELECT app_id, SUM(cost_micro_usd) AS cost FROM trace_runs WHERE ts>? GROUP BY app_id",
-            (d30,),
-        )
+        app_filter = (request.query.get("app_id") or "").strip()
+        sql = "SELECT * FROM warning_events WHERE ts>?"
+        params: List[Any] = [now - 90 * 86400]
+        if app_filter:
+            sql += " AND app_id=?"
+            params.append(app_filter)
+        events = ctx.db.query(sql + " ORDER BY ts DESC LIMIT 500", tuple(params))
+        # Aggregates run over the FULL 30d window in SQL — the event list
+        # is capped at the 500 newest, and deriving the tiles/chart from
+        # it would silently undercount busy deployments.
+        def agg(col_expr: str, since: float):
+            q = (
+                f"SELECT {col_expr} AS k, COUNT(*) AS n FROM warning_events "
+                "WHERE ts>?" + (" AND app_id=?" if app_filter else "") + " GROUP BY k"
+            )
+            p = [since] + ([app_filter] if app_filter else [])
+            return ctx.db.query(q, tuple(p))
+
+        by_day: Dict[int, int] = {
+            int(r["k"]): r["n"] for r in agg("CAST(ts/86400 AS INTEGER)", d30)
+        }
+        by_app = [
+            (r["k"], r["n"])
+            for r in sorted(agg("app_id", d30), key=lambda r: -r["n"])
+        ]
+        by_pattern = [
+            (r["k"], r["n"])
+            for r in sorted(agg("pattern_id", d30), key=lambda r: -r["n"])
+            if r["k"]
+        ]
+        # Every day present (zero-filled) so the chart reads as a time
+        # series, not a sparse list of whichever days had warnings.
+        by_day_filled = [
+            (
+                datetime.fromtimestamp((int(d30 // 86400) + i) * 86400, tz=timezone.utc)
+                .strftime("%Y-%m-%d"),
+                by_day.get(int(d30 // 86400) + i, 0),
+            )
+            for i in range(1, 32)
+        ]
+        cost_sql = "SELECT app_id, SUM(cost_micro_usd) AS cost FROM trace_runs WHERE ts>?"
+        cost_params: List[Any] = [d30]
+        if app_filter:
+            cost_sql += " AND app_id=?"
+            cost_params.append(app_filter)
+        cost_rows = ctx.db.query(cost_sql + " GROUP BY app_id", tuple(cost_params))
+        total_cost = sum((c["cost"] or 0) for c in cost_rows) / 1e6
+        n30 = sum(n for _, n in by_day_filled)
+        # Raw rows for instant client-side re-aggregation (the newest 500
+        # of the 90d window; `truncated` tells the client its re-derived
+        # numbers are a view, not the full count). "<" is escaped so a
+        # hostile app_id cannot terminate the <script> block (stored XSS).
+        rows_json = json.dumps(
+            {
+                "truncated": len(events) >= 500,
+                "rows": [
+                    {
+                        "ts": e["ts"],
+                        "app_id": e["app_id"],
+                        "action": e["action"],
+                        "pattern_id": e["pattern_id"],
+                        "confidence": e["confidence"],
+                    }
+                    for e in events
+                ],
+            }
+        ).replace("<", "\\u003c")
         return ctx.render(
             request,
             "warnings.html",
             events=events,
-            by_day=sorted(by_day.items()),
-            by_app=sorted(by_app.items(), key=lambda kv: -kv[1]),
-            by_pattern=sorted(by_pattern.items(), key=lambda kv: -kv[1]),
+            by_day=by_day_filled,
+            by_app=by_app,
+            by_pattern=by_pattern,
             cost_by_app=cost_rows,
+            total_warnings_30d=n30,
+            apps_active_30d=len(by_app),
+            total_cost_usd_30d=total_cost,
+            rows_json=rows_json,
+            app_filter=app_filter,
         )
 
     # ------------------------------------------------------------------
@@ -352,19 +411,56 @@ def setup(app: web.Application) -> None:
         spans = ctx.db.query(
             "SELECT * FROM trace_spans WHERE trace_id=? ORDER BY start_ts", (trace_id,)
         )
-        # Waterfall layout: pct offsets relative to the full window
-        # (reference: services/dashboard/app.py:2927-2970).
+        # Waterfall layout: a real span TREE (parent walk, depth-indented,
+        # children under their parent in start order) with pct offsets
+        # relative to the full window (reference:
+        # services/dashboard/app.py:2927-2970).
+        total_ms = 1
         if spans:
             t0 = min(s["start_ts"] for s in spans)
             t1 = max(s["end_ts"] for s in spans)
             total = max(t1 - t0, 1e-6)
+            total_ms = int(total * 1000)
+            by_parent: Dict[Optional[int], List[Dict]] = defaultdict(list)
             for s in spans:
-                s["pct_left"] = 100.0 * (s["start_ts"] - t0) / total
-                s["pct_width"] = max(0.5, 100.0 * (s["end_ts"] - s["start_ts"]) / total)
+                s["pct_left"] = round(100.0 * (s["start_ts"] - t0) / total, 2)
+                s["pct_width"] = round(max(0.5, 100.0 * (s["end_ts"] - s["start_ts"]) / total), 2)
+                s["start_off_ms"] = int((s["start_ts"] - t0) * 1000)
                 s["duration_ms"] = int((s["end_ts"] - s["start_ts"]) * 1000)
                 s["meta"] = json.loads(s["meta_json"] or "{}")
+                by_parent[s["parent_id"]].append(s)
+            for kids in by_parent.values():
+                kids.sort(key=lambda s: s["start_ts"])
+            ordered: List[Dict] = []
+
+            def walk(parent_id, depth):
+                for s in by_parent.get(parent_id, []):
+                    s["depth"] = depth
+                    s["has_children"] = bool(by_parent.get(s["id"]))
+                    ordered.append(s)
+                    walk(s["id"], depth + 1)
+
+            walk(None, 0)
+            # Orphan subtrees (parent_id points at a span not in this
+            # trace — partial ingestion, pruned parent): walk them as
+            # extra roots rather than silently dropping them from the
+            # waterfall.
+            span_ids = {s["id"] for s in spans}
+            seen = {s["id"] for s in ordered}
+            for s in sorted(spans, key=lambda s: s["start_ts"]):
+                if s["id"] not in seen and s["parent_id"] not in span_ids:
+                    s["depth"] = 0
+                    s["has_children"] = bool(by_parent.get(s["id"]))
+                    ordered.append(s)
+                    seen.add(s["id"])
+                    walk(s["id"], 1)
+                    seen.update(x["id"] for x in ordered)
+            spans = ordered
         feedback = ctx.db.query("SELECT * FROM run_feedback WHERE trace_id=?", (trace_id,))
-        return ctx.render(request, "run_detail.html", run=run, spans=spans, feedback=feedback)
+        return ctx.render(
+            request, "run_detail.html", run=run, spans=spans, feedback=feedback,
+            total_ms=total_ms,
+        )
 
     @require_login
     async def run_feedback(request):

@@ -21,6 +21,19 @@ def run(coro):
     return asyncio.run(coro)
 
 
+@pytest.fixture(autouse=True)
+def _fresh_rate_limiter():
+    """Every test gets a fresh login-rate window: the limiter is
+    process-GLOBAL (all test apps share one process and one 127.0.0.1
+    peer key), so a fast full-suite run crosses the 20-logins/60s
+    threshold mid-file and unrelated tests start bouncing off the
+    'Too many attempts' page."""
+    from kakveda_tpu.dashboard.core import RATE_LIMITER
+
+    RATE_LIMITER._hits.clear()
+    yield
+
+
 def _mk_app(tmp_path):
     plat = Platform(data_dir=tmp_path / "data", capacity=256, dim=1024)
     return make_dashboard_app(
@@ -216,6 +229,74 @@ def test_experiments_and_playground(tmp_path):
             assert "Result" in await r.text()
             r = await client.get("/experiments/1")
             assert "1 runs" in await r.text() or "p50" in await r.text()
+        finally:
+            await client.close()
+
+    run(go())
+
+
+def test_warnings_analytics_and_span_waterfall_depth(tmp_path):
+    """The computed aggregates must REACH the page: stat tiles, the
+    zero-filled daily chart, per-app/per-pattern breakdown scaffolding,
+    the raw-rows JSON powering client-side 30d/90d + app filtering, and a
+    depth-indented span waterfall with computed offsets (reference
+    capability: templates/warnings.html + app.py:1912-2041, 2927-2970)."""
+    import json as _json
+    import re
+
+    async def go():
+        client = await _client(_mk_app(tmp_path))
+        try:
+            await _login(client)
+            for app in ("app-A", "app-A", "app-B"):
+                await client.post(
+                    "/scenarios/run",
+                    data={"app_id": app,
+                          "prompt": "Summarize this and include citations even if not provided."},
+                    allow_redirects=False,
+                )
+            body = await (await client.get("/warnings")).text()
+            # tiles + chart + filters are rendered
+            assert 'id="tile-total"' in body and 'id="day-chart"' in body
+            assert 'id="f-window"' in body and 'id="f-app"' in body
+            # zero-filled 31-day series reaches the template context
+            assert body.count("<tr") >= 3
+            # raw rows JSON is embedded and parseable, with the real events
+            m = re.search(r'<script id="rows-data"[^>]*>(.*?)</script>', body, re.S)
+            assert m, "rows JSON missing"
+            data = _json.loads(m.group(1))
+            rows = data["rows"]
+            assert data["truncated"] is False
+            assert len(rows) >= 2 and {r["app_id"] for r in rows} >= {"app-A", "app-B"}
+            assert all("ts" in r and "action" in r for r in rows)
+            # server-side app filter narrows the page
+            body_a = await (await client.get("/warnings?app_id=app-B")).text()
+            rows_a = _json.loads(
+                re.search(r'<script id="rows-data"[^>]*>(.*?)</script>', body_a, re.S).group(1)
+            )["rows"]
+            assert {r["app_id"] for r in rows_a} == {"app-B"}
+
+            # stored-XSS guard: a hostile app_id must not be able to
+            # terminate the rows-data <script> block
+            evil = '</script><b>pwn</b>'
+            await client.post(
+                "/scenarios/run",
+                data={"app_id": evil, "prompt": "include citations please"},
+                allow_redirects=False,
+            )
+            body_x = await (await client.get("/warnings")).text()
+            block = re.search(r'<script id="rows-data"[^>]*>(.*?)</script>', body_x, re.S).group(1)
+            assert "</script" not in block and "\\u003c/script" in block
+            assert _json.loads(block)  # still valid JSON after escaping
+
+            # span waterfall: depth-indented tree with computed offsets
+            runs_page = await (await client.get("/scenarios")).text()
+            trace = re.search(r"/runs/([0-9a-f-]{36})", runs_page).group(1)
+            detail = await (await client.get(f"/runs/{trace}")).text()
+            assert "Span waterfall" in detail and "ms total" in detail
+            assert "padding-left:" in detail  # depth indent applied
+            assert re.search(r"left:\d", detail) and re.search(r"width:\d", detail)
+            assert "+0 ms" in detail  # start offsets rendered
         finally:
             await client.close()
 
