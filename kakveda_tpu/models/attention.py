@@ -87,16 +87,16 @@ def _gqa_xla(q, k, v, pos0, kv_valid, window: int = 0, softcap: float = 0.0):
 # ---------------------------------------------------------------------------
 
 
-def _flash_kernel(
-    pos0_ref,  # SMEM [1, 1]
-    q_ref,  # VMEM [1, q_blk, D]
-    k_ref,  # VMEM [1, l_blk, D]
-    v_ref,  # VMEM [1, l_blk, D]
-    valid_ref,  # VMEM [1, 1, l_blk] f32
-    o_ref,  # VMEM [1, q_blk, D]
-    m_scr,  # VMEM [q_blk, 128] f32
-    l_scr,  # VMEM [q_blk, 128] f32
-    acc_scr,  # VMEM [q_blk, D] f32
+def _flash_body(
+    pos0_ref,
+    q,  # [q_blk, D]
+    k,  # [l_blk, D] — already dequantized
+    v,  # [l_blk, D]
+    valid_ref,
+    o_ref,
+    m_scr,
+    l_scr,
+    acc_scr,
     *,
     r: int,
     q_blk: int,
@@ -114,8 +114,6 @@ def _flash_kernel(
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    q = q_ref[0]
-    k = k_ref[0]
     # [q_blk, l_blk] scores on the MXU, f32 accumulation.
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -140,8 +138,8 @@ def _flash_kernel(
     corr = jnp.exp(m_prev - m_new)  # [q_blk, 1]
     l_new = l_scr[:, :1] * corr + jnp.sum(p, axis=1, keepdims=True)
     pv = jax.lax.dot_general(
-        p.astype(v_ref.dtype),
-        v_ref[0],
+        p.astype(v.dtype),
+        v,
         (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
@@ -155,14 +153,64 @@ def _flash_kernel(
         o_ref[0] = (acc_scr[:] / denom).astype(o_ref.dtype)
 
 
+def _flash_kernel(
+    pos0_ref,  # SMEM [1, 1]
+    q_ref,  # VMEM [1, q_blk, D]
+    k_ref,  # VMEM [1, l_blk, D]
+    v_ref,  # VMEM [1, l_blk, D]
+    valid_ref,  # VMEM [1, 1, l_blk] f32
+    o_ref,  # VMEM [1, q_blk, D]
+    m_scr,  # VMEM [q_blk, 128] f32
+    l_scr,  # VMEM [q_blk, 128] f32
+    acc_scr,  # VMEM [q_blk, D] f32
+    **kw,
+):
+    _flash_body(
+        pos0_ref, q_ref[0], k_ref[0], v_ref[0], valid_ref, o_ref,
+        m_scr, l_scr, acc_scr, **kw,
+    )
+
+
+def _flash_kernel_kv8(
+    pos0_ref,  # SMEM [1, 1]
+    q_ref,  # VMEM [1, q_blk, D]
+    k_ref,  # VMEM [1, l_blk, D] int8
+    ks_ref,  # VMEM [1, 1, l_blk] f32 per-row scales
+    v_ref,  # VMEM [1, l_blk, D] int8
+    vs_ref,  # VMEM [1, 1, l_blk] f32
+    valid_ref,  # VMEM [1, 1, l_blk] f32
+    o_ref,
+    m_scr,
+    l_scr,
+    acc_scr,
+    **kw,
+):
+    """int8-KV variant: the cache tiles DMA from HBM as int8 (+1 f32
+    scale per head_dim row) — ~½ the bandwidth of bf16 tiles on the
+    stream that binds long-context decode — and dequantize in VMEM.
+    The dequant replicates `_kv_dequant`'s EXACT op order (cast scale to
+    the compute dtype FIRST, multiply in that dtype): under bf16 a
+    multiply-in-f32-then-round differs in the last bit from
+    round-scale-then-multiply, which would make flash and XLA-fallback
+    logits diverge per element."""
+    dt = q_ref.dtype
+    kd = k_ref[0].astype(dt) * ks_ref[0, 0].astype(dt)[:, None]
+    vd = v_ref[0].astype(dt) * vs_ref[0, 0].astype(dt)[:, None]
+    _flash_body(
+        pos0_ref, q_ref[0], kd, vd, valid_ref, o_ref, m_scr, l_scr, acc_scr, **kw,
+    )
+
+
 @functools.partial(jax.jit, static_argnames=("q_blk", "l_blk", "window", "interpret"))
 def flash_gqa_cache(
     q: jax.Array,  # [B, S, H, D]
-    k: jax.Array,  # [B, KV, L, D]
+    k: jax.Array,  # [B, KV, L, D] (cfg.dtype, or int8 with k_scale)
     v: jax.Array,  # [B, KV, L, D]
     pos0: jax.Array,
     kv_valid: jax.Array | None,
     *,
+    k_scale: jax.Array | None = None,  # [B, KV, L] f32 — int8-cache rows
+    v_scale: jax.Array | None = None,
     q_blk: int = 512,
     l_blk: int = 512,
     window: int = 0,
@@ -172,18 +220,29 @@ def flash_gqa_cache(
     _, kv, l, _ = k.shape
     r = h // kv
     sr = s * r
-    q_blk = min(q_blk, sr)
+    # Pad the folded q-row axis to the f32 sublane multiple: decode shapes
+    # (s=1, r<8) otherwise can't tile at all. Padded rows compute
+    # throwaway attention (their q_pos lands past the real rows; denom is
+    # floor-guarded) and are sliced off the output.
+    sr_pad = -(-sr // 8) * 8
+    q_blk = min(q_blk, sr_pad)
     l_blk = min(l_blk, l)
-    if sr % q_blk or l % l_blk:
-        raise ValueError(f"flash layout: SR={sr} q_blk={q_blk} L={l} l_blk={l_blk}")
+    if sr_pad % q_blk or l % l_blk:
+        raise ValueError(f"flash layout: SR={sr_pad} q_blk={q_blk} L={l} l_blk={l_blk}")
+    kv8 = k_scale is not None
 
-    # Fold (seq, group-head) into the q-row axis: [B*KV, S*R, D].
+    # Fold (seq, group-head) into the q-row axis: [B*KV, S*R, D]. With an
+    # int8 cache the q tiles keep their own dtype (casting q to int8 would
+    # destroy it); the kernel dequantizes K/V tiles in VMEM.
     qf = (
         q.reshape(b, s, kv, r, d)
         .transpose(0, 2, 1, 3, 4)
         .reshape(b * kv, sr, d)
-        .astype(k.dtype)
     )
+    if not kv8:
+        qf = qf.astype(k.dtype)
+    if sr_pad != sr:
+        qf = jnp.pad(qf, ((0, 0), (0, sr_pad - sr), (0, 0)))
     kf = k.reshape(b * kv, l, d)
     vf = v.reshape(b * kv, l, d)
     valid = (
@@ -192,31 +251,39 @@ def flash_gqa_cache(
         else kv_valid.astype(jnp.float32).reshape(b, 1, l)
     )
     pos = jnp.asarray(pos0, jnp.int32).reshape(1, 1)
-    n_q = sr // q_blk
+    n_q = sr_pad // q_blk
     n_l = l // l_blk
 
+    smem_spec = pl.BlockSpec((1, 1), lambda bg, qb, lb: (0, 0), memory_space=pltpu.SMEM)
+    q_spec = pl.BlockSpec((1, q_blk, d), lambda bg, qb, lb: (bg, qb, 0), memory_space=pltpu.VMEM)
+    l_spec = pl.BlockSpec((1, l_blk, d), lambda bg, qb, lb: (bg, lb, 0), memory_space=pltpu.VMEM)
+    sc_spec = pl.BlockSpec((1, 1, l_blk), lambda bg, qb, lb: (bg, 0, lb), memory_space=pltpu.VMEM)
+    valid_spec = pl.BlockSpec(
+        (1, 1, l_blk), lambda bg, qb, lb, _kv=kv: (bg // _kv, 0, lb), memory_space=pltpu.VMEM
+    )
+    kw = dict(r=r, q_blk=q_blk, l_blk=l_blk, n_l=n_l, scale=d**-0.5, window=window)
+    if kv8:
+        kernel = functools.partial(_flash_kernel_kv8, **kw)
+        in_specs = [smem_spec, q_spec, l_spec, sc_spec, l_spec, sc_spec, valid_spec]
+        operands = (
+            pos, qf, kf, k_scale.reshape(b * kv, 1, l),
+            vf, v_scale.reshape(b * kv, 1, l), valid,
+        )
+        kv_bytes = 2 * l * (d + 4)  # int8 values + f32 scales
+    else:
+        kernel = functools.partial(_flash_kernel, **kw)
+        in_specs = [smem_spec, q_spec, l_spec, l_spec, valid_spec]
+        operands = (pos, qf, kf, vf, valid)
+        kv_bytes = 2 * l * d * k.dtype.itemsize
+
     out = pl.pallas_call(
-        functools.partial(
-            _flash_kernel,
-            r=r,
-            q_blk=q_blk,
-            l_blk=l_blk,
-            n_l=n_l,
-            scale=d**-0.5,
-            window=window,
-        ),
+        kernel,
         grid=(b * kv, n_q, n_l),
-        in_specs=[
-            pl.BlockSpec((1, 1), lambda bg, qb, lb: (0, 0), memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, q_blk, d), lambda bg, qb, lb: (bg, qb, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, l_blk, d), lambda bg, qb, lb: (bg, lb, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, l_blk, d), lambda bg, qb, lb: (bg, lb, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, l_blk), lambda bg, qb, lb, _kv=kv: (bg // _kv, 0, lb), memory_space=pltpu.VMEM),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec(
             (1, q_blk, d), lambda bg, qb, lb: (bg, qb, 0), memory_space=pltpu.VMEM
         ),
-        out_shape=jax.ShapeDtypeStruct((b * kv, sr, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((b * kv, sr_pad, d), q.dtype),
         scratch_shapes=[
             pltpu.VMEM((q_blk, 128), jnp.float32),
             pltpu.VMEM((q_blk, 128), jnp.float32),
@@ -224,13 +291,15 @@ def flash_gqa_cache(
         ],
         cost_estimate=pl.CostEstimate(
             flops=4 * b * kv * sr * l * d,
-            bytes_accessed=(b * kv * (sr + 2 * l) * d * k.dtype.itemsize),
+            bytes_accessed=b * kv * (sr * d * q.dtype.itemsize + kv_bytes),
             transcendentals=b * kv * sr * l,
         ),
         interpret=interpret,
-    )(pos, qf, kf, vf, valid)
+    )(*operands)
 
-    # [B*KV, S*R, D] -> [B, S, H, D]
+    # [B*KV, S*R(+pad), D] -> [B, S, H, D]
+    if sr_pad != sr:
+        out = out[:, :sr]
     return (
         out.reshape(b, kv, s, r, d).transpose(0, 2, 1, 3, 4).reshape(b, s, h, d)
     ).astype(q.dtype)
@@ -242,17 +311,11 @@ def flash_gqa_cache(
 
 
 def _flash_ok(s: int, h: int, kv: int, l: int, d: int) -> bool:
-    """Layout gate: q rows fold to S·R which must tile by 8 (f32 sublane),
-    the cache length must tile by the l-block, and lanes want d % 128 == 0
-    or d == 64 (Mosaic pads 64-lane tiles acceptably)."""
-    r = h // kv
-    sr = s * r
-    return (
-        h % kv == 0
-        and sr % 8 == 0
-        and l % 128 == 0
-        and (d % 128 == 0 or d == 64)
-    )
+    """Layout gate: the cache length must tile by the l-block and lanes
+    want d % 128 == 0 or d == 64 (Mosaic pads 64-lane tiles acceptably).
+    The folded q-row axis (S·R) pads itself to the sublane multiple
+    inside flash_gqa_cache, so short decode shapes qualify."""
+    return h % kv == 0 and l % 128 == 0 and (d % 128 == 0 or d == 64)
 
 
 def _flash_wins(s: int, h: int, kv: int, l: int) -> bool:
@@ -286,16 +349,31 @@ def gqa_cache_attention(
     *,
     window: int = 0,
     softcap: float = 0.0,
+    k_scale: jax.Array | None = None,  # int8 cache: [B, KV, L] per-row scales
+    v_scale: jax.Array | None = None,
     use_flash: bool | None = None,
 ) -> jax.Array:
     """Cached GQA attention — dispatches to the Pallas flash kernel on TPU
     (inference shapes that fit its tiling), XLA grouped einsum otherwise.
     ``window`` > 0 applies sliding-window attention (Mistral) in both paths;
     ``softcap`` > 0 (Gemma-2 logit capping) always takes the XLA path.
-    ``KAKVEDA_FLASH=0`` forces the XLA path."""
+    With ``k_scale``/``v_scale`` the cache is int8 (cfg.kv_quant): the
+    flash path streams the int8 tiles from HBM and dequantizes in VMEM —
+    the bandwidth win, on top of the capacity win — while the XLA path
+    dequantizes up front (same math, materialized). ``KAKVEDA_FLASH=0``
+    forces the XLA path."""
     b, s, h, d = q.shape
     _, kv, l, _ = k.shape
+
+    def _dequant():
+        from kakveda_tpu.models.llama import _kv_dequant
+
+        return _kv_dequant(k, k_scale, q.dtype), _kv_dequant(v, v_scale, q.dtype)
+
     if softcap:
+        if k_scale is not None:
+            kd, vd = _dequant()
+            return _gqa_xla(q, kd, vd, pos0, kv_valid, window=window, softcap=softcap)
         return _gqa_xla(q, k, v, pos0, kv_valid, window=window, softcap=softcap)
     if use_flash is None:
         env = os.environ.get("KAKVEDA_FLASH", "auto")
@@ -303,15 +381,25 @@ def gqa_cache_attention(
             env != "0"
             and jax.default_backend() == "tpu"
             and _flash_ok(s, h, kv, l, d)
-            and (env == "1" or _flash_wins(s, h, kv, l))
+            # int8 caches prefer the kernel wherever the shape tiles: the
+            # XLA path must materialize a full bf16 dequant copy of the
+            # cache (write + re-read through HBM — MORE traffic than a
+            # plain bf16 cache), while the kernel streams int8 and
+            # expands in VMEM. For bf16 caches the measured profitability
+            # gate applies.
+            and (env == "1" or k_scale is not None or _flash_wins(s, h, kv, l))
         )
     if use_flash:
         r = h // kv
         sr = s * r
         return flash_gqa_cache(
             q, k, v, pos0, kv_valid,
-            q_blk=_pick_block(sr, 512, 8),
+            k_scale=k_scale, v_scale=v_scale,
+            q_blk=_pick_block(-(-sr // 8) * 8, 512, 8),
             l_blk=_pick_block(l, 512, 128),
             window=window,
         )
+    if k_scale is not None:
+        kd, vd = _dequant()
+        return _gqa_xla(q, kd, vd, pos0, kv_valid, window=window)
     return _gqa_xla(q, k, v, pos0, kv_valid, window=window)

@@ -785,6 +785,7 @@ def decode_step(
         # Head-major cache writes: [B, S, KV, D] -> [B, KV, S, D] slab.
         k_rows = k.transpose(0, 2, 1, 3)
         v_rows = v.transpose(0, 2, 1, 3)
+        ks_all = vs_all = None
         if kq:
             k_i8, k_sc = _kv_quant_rows(k_rows)
             v_i8, v_sc = _kv_quant_rows(v_rows)
@@ -794,8 +795,6 @@ def decode_step(
             vs_all = jax.lax.dynamic_update_slice(cache["vs"][li], v_sc, (0, 0, pos0))
             new_ks.append(ks_all)
             new_vs.append(vs_all)
-            k_read = _kv_dequant(k_all, ks_all, cfg.dtype)
-            v_read = _kv_dequant(v_all, vs_all, cfg.dtype)
         else:
             k_all = jax.lax.dynamic_update_slice(
                 cache["k"][li], k_rows.astype(cfg.dtype), (0, 0, pos0, 0)
@@ -803,16 +802,18 @@ def decode_step(
             v_all = jax.lax.dynamic_update_slice(
                 cache["v"][li], v_rows.astype(cfg.dtype), (0, 0, pos0, 0)
             )
-            k_read, v_read = k_all, v_all
         new_k.append(k_all)
         new_v.append(v_all)
 
         # Fused cached attention: Pallas flash on TPU, grouped XLA einsum
         # elsewhere — either way K/V are read once, not n_rep times, and
         # the causal mask (q_pos >= slot) also excludes unwritten slots.
+        # int8 caches pass raw tiles + scales: the flash kernel streams
+        # int8 from HBM and dequantizes in VMEM (the bandwidth win).
         attn = gqa_cache_attention(
-            q, k_read, v_read, pos0, kv_valid,
+            q, k_all, v_all, pos0, kv_valid,
             window=cfg.layer_window(li), softcap=cfg.attn_softcap,
+            k_scale=ks_all, v_scale=vs_all,
         )
         attn = attn.reshape(b, s, cfg.n_heads * hd) @ wmat(layer["wo"], dt)
         if "post_attn_norm" in layer:  # Gemma-2 sandwich norm

@@ -118,7 +118,6 @@ def _step_chunk_jit(params, cfg: LlamaConfig, cache, last, slot_pos, kv_valid, p
     """
     from kakveda_tpu.models.attention import gqa_cache_attention
     from kakveda_tpu.models.llama import (
-        _kv_dequant,
         _kv_quant_rows,
         _rope_freqs,
         apply_rope,
@@ -170,6 +169,7 @@ def _step_chunk_jit(params, cfg: LlamaConfig, cache, last, slot_pos, kv_valid, p
             kh = k.transpose(0, 2, 1, 3)[:, :, 0, :]  # [B, KV, D]
             vh = v.transpose(0, 2, 1, 3)[:, :, 0, :]
             rows = jnp.arange(b)
+            ks_all = vs_all = None
             if kq:
                 # Same per-row quantizer as decode_step, so a slot's cache
                 # bytes are identical to its solo decode — int8 parity is
@@ -182,18 +182,16 @@ def _step_chunk_jit(params, cfg: LlamaConfig, cache, last, slot_pos, kv_valid, p
                 vs_all = cache_vs[li].at[rows, :, slot_pos].set(v_sc, mode="drop")
                 new_ks.append(ks_all)
                 new_vs.append(vs_all)
-                k_read = _kv_dequant(k_all, ks_all, cfg.dtype)
-                v_read = _kv_dequant(v_all, vs_all, cfg.dtype)
             else:
                 k_all = cache_k[li].at[rows, :, slot_pos, :].set(kh.astype(cfg.dtype), mode="drop")
                 v_all = cache_v[li].at[rows, :, slot_pos, :].set(vh.astype(cfg.dtype), mode="drop")
-                k_read, v_read = k_all, v_all
             new_k.append(k_all)
             new_v.append(v_all)
             # Attention over the slot's valid prefix. pos0=max_len makes the
             # kernel's scalar causal mask a no-op; step_valid does the work.
             attn = gqa_cache_attention(
-                q, k_read, v_read, jnp.asarray(max_len), step_valid, softcap=cfg.attn_softcap
+                q, k_all, v_all, jnp.asarray(max_len), step_valid,
+                softcap=cfg.attn_softcap, k_scale=ks_all, v_scale=vs_all,
             )
             attn = attn.reshape(b, 1, cfg.n_heads * hd) @ wmat(layer["wo"], dt)
             if "post_attn_norm" in layer:

@@ -94,6 +94,121 @@ def test_flash_kernel_matches_oracle(b, s, h, kv, l, d, pos0, with_valid):
     np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)
 
 
+@pytest.mark.parametrize("b,s,h,kv,l,d,pos0,with_valid", CASES)
+def test_flash_kernel_int8_cache_matches_dequant_path(b, s, h, kv, l, d, pos0, with_valid):
+    """int8-KV flash: streaming int8 tiles + per-row scales and
+    dequantizing IN the kernel must equal dequantize-then-attend (the XLA
+    fallback's math) exactly — the kernel casts back to the q dtype, so
+    the two paths see identical K/V values."""
+    from kakveda_tpu.models.llama import _kv_dequant, _kv_quant_rows
+
+    q, k, v = _mk(b, s, h, kv, l, d, seed=b * 11 + s)
+    k_i8, k_sc = _kv_quant_rows(k)
+    v_i8, v_sc = _kv_quant_rows(v)
+    valid = None
+    if with_valid:
+        rng = np.random.default_rng(7)
+        off = rng.integers(0, 4, size=(b,))
+        valid = jnp.asarray(np.arange(l)[None, :] >= off[:, None])
+    want = np.asarray(
+        _gqa_xla(
+            q, _kv_dequant(k_i8, k_sc, q.dtype), _kv_dequant(v_i8, v_sc, q.dtype),
+            jnp.asarray(pos0), valid,
+        )
+    )
+    got = np.asarray(
+        flash_gqa_cache(
+            q, k_i8, v_i8, jnp.asarray(pos0), valid,
+            k_scale=k_sc, v_scale=v_sc, q_blk=8, l_blk=16, interpret=True,
+        )
+    )
+    if valid is not None:
+        q_pos = pos0 + np.arange(s)
+        visible = (q_pos[None, :, None] >= np.arange(l)[None, None, :]) & np.asarray(
+            valid
+        )[:, None, :]
+        live = visible.any(-1)
+        got, want = got[live], want[live]
+    np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)
+
+
+def test_flash_int8_bf16_bitwise_matches_dequant_path():
+    """Under bf16 compute the kernel must replicate _kv_dequant's exact
+    op order (round the scale to bf16 FIRST, multiply in bf16):
+    multiply-in-f32-then-round differs in the last bit and would make
+    flash vs XLA-fallback logits diverge per element."""
+    from kakveda_tpu.models.llama import _kv_dequant, _kv_quant_rows
+
+    rng = np.random.default_rng(3)
+    b, s, h, kv, l, d = 1, 8, 4, 2, 32, 16
+    q = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.bfloat16)
+    k = jnp.asarray(rng.standard_normal((b, kv, l, d)), jnp.bfloat16)
+    v = jnp.asarray(rng.standard_normal((b, kv, l, d)), jnp.bfloat16)
+    k_i8, k_sc = _kv_quant_rows(k)
+    v_i8, v_sc = _kv_quant_rows(v)
+    want = _gqa_xla(
+        q, _kv_dequant(k_i8, k_sc, jnp.bfloat16), _kv_dequant(v_i8, v_sc, jnp.bfloat16),
+        jnp.asarray(0), None,
+    )
+    got = flash_gqa_cache(
+        q, k_i8, v_i8, jnp.asarray(0), None,
+        k_scale=k_sc, v_scale=v_sc, q_blk=8, l_blk=16, interpret=True,
+    )
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want, np.float32), atol=1e-2, rtol=1e-2
+    )
+    # the dequantized K/V the two paths see must be IDENTICAL bits —
+    # that's the invariant the kernel's op ordering exists for
+    kd_kernel = k_i8.astype(jnp.bfloat16) * k_sc.astype(jnp.bfloat16)[..., None]
+    assert jnp.array_equal(kd_kernel, _kv_dequant(k_i8, k_sc, jnp.bfloat16))
+
+
+def test_flash_decode_shape_pads_q_rows():
+    """Single-token decode with a small GQA ratio folds to s*r < 8 query
+    rows; the kernel pads them to the sublane multiple and slices the
+    output — parity with the XLA path on the same int8 cache."""
+    from kakveda_tpu.models.llama import _kv_dequant, _kv_quant_rows
+
+    rng = np.random.default_rng(4)
+    b, s, h, kv, l, d = 3, 1, 8, 2, 128, 64  # sr = 4 -> pads to 8
+    q = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, kv, l, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, kv, l, d)), jnp.float32)
+    k_i8, k_sc = _kv_quant_rows(k)
+    v_i8, v_sc = _kv_quant_rows(v)
+    pos0 = 40
+    want = np.asarray(
+        _gqa_xla(
+            q, _kv_dequant(k_i8, k_sc, q.dtype), _kv_dequant(v_i8, v_sc, q.dtype),
+            jnp.asarray(pos0), None,
+        )
+    )
+    got = np.asarray(
+        flash_gqa_cache(
+            q, k_i8, v_i8, jnp.asarray(pos0), None,
+            k_scale=k_sc, v_scale=v_sc, q_blk=8, l_blk=128, interpret=True,
+        )
+    )
+    np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)
+
+
+def test_dispatch_int8_cache_xla_fallback_matches_oracle():
+    """gqa_cache_attention with k_scale/v_scale on CPU (XLA path) equals
+    the oracle over the dequantized cache."""
+    from kakveda_tpu.models.llama import _kv_dequant, _kv_quant_rows
+
+    q, k, v = _mk(2, 4, 4, 2, 32, 16, seed=5)
+    k_i8, k_sc = _kv_quant_rows(k)
+    v_i8, v_sc = _kv_quant_rows(v)
+    want = np.asarray(
+        _oracle(q, _kv_dequant(k_i8, k_sc, q.dtype), _kv_dequant(v_i8, v_sc, q.dtype), 3, None)
+    )
+    got = np.asarray(
+        gqa_cache_attention(q, k_i8, v_i8, jnp.asarray(3), None, k_scale=k_sc, v_scale=v_sc)
+    )
+    np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)
+
+
 def test_flash_kernel_multiblock_streaming():
     """Cache longer than one l-block: online-softmax accumulation across
     tiles must agree with the oracle, including a fully-masked leading tile
