@@ -235,6 +235,19 @@ def setup(app: web.Application) -> None:
         return ctx.render(request, "admin_agents.html", agents=agents, test_result=None)
 
     @require_roles("admin")
+    async def admin_serving_page(request):
+        """Serving observability: which runtime backs generation, the
+        shared engine's pool state (submitted/completed/max_active,
+        slots/window), the serving-lever flags (weight + KV quant), and —
+        under a multi-model router — the HBM budget accounting (resident
+        models, bytes, headroom). No reference counterpart (its model
+        tier is a stateless per-request Ollama hop)."""
+        stats = ctx.model.serving_stats() if hasattr(ctx.model, "serving_stats") else {
+            "runtime": getattr(ctx.model, "name", "unknown"), "engine": None,
+        }
+        return ctx.render(request, "admin_serving.html", stats=stats)
+
+    @require_roles("admin")
     async def admin_agent_delete(request):
         form = await request.post()
         name = str(form.get("name") or "")
@@ -519,6 +532,7 @@ def setup(app: web.Application) -> None:
             web.get("/admin/purge-demo", admin_purge_demo_page),
             web.post("/admin/purge-demo", admin_purge_demo),
             web.get("/admin/agents", admin_agents_page),
+            web.get("/admin/serving", admin_serving_page),
             web.post("/admin/agents/delete", admin_agent_delete),
             web.get("/admin/agents/{name}/test", admin_agent_test),
             web.get("/agents", agents_page),

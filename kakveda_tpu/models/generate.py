@@ -663,6 +663,29 @@ class LlamaRuntime:
                         return None
         return self._engine
 
+    def serving_stats(self) -> dict:
+        """Ops snapshot for the admin serving panel — engine pool state
+        (without constructing one: observability must not allocate a KV
+        pool on a chip it is checking) plus the serving-lever flags."""
+        eng = self._engine  # peek, never build
+        stats = None
+        if eng is not None:
+            stats = {
+                **eng.stats,
+                "active": eng.cb.active,
+                "slots": eng.cb.B,
+                "window": eng.cb.max_len,
+                "closed": eng._closed.is_set(),
+            }
+        return {
+            "runtime": "tpu",
+            "model": self.model_label,
+            "quant": self.quant or "none",
+            "kv_quant": self.cfg.kv_quant or "none",
+            "retired": self._retired,
+            "engine": stats,
+        }
+
     def retire(self) -> None:
         """Tear down the serving engine and bar rebuilding — called by the
         HBM-budget evictor. In-flight generates finish on the solo path;

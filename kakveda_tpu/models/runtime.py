@@ -116,6 +116,10 @@ def list_models(runtime: "ModelRuntime") -> list:
     return [getattr(runtime, "name", "model")]
 
 
+def _serving_stats_unavailable(name: str) -> Dict[str, Any]:
+    return {"runtime": name, "engine": None}
+
+
 class StubRuntime:
     """Deterministic canned-response backend — the hermetic test model."""
 
@@ -126,6 +130,9 @@ class StubRuntime:
 
     def list_models(self) -> list:
         return [self.model_label]
+
+    def serving_stats(self) -> Dict[str, Any]:
+        return _serving_stats_unavailable("stub")
 
     def generate(self, prompt: str, *, model: Optional[str] = None, max_tokens: int = 256) -> GenerateResult:
         started = time.perf_counter()
@@ -164,6 +171,9 @@ class OllamaRuntime:
             return names or [self.model]
         except Exception:  # noqa: BLE001
             return [self.model]
+
+    def serving_stats(self) -> Dict[str, Any]:
+        return _serving_stats_unavailable("ollama")
 
     def generate(self, prompt: str, *, model: Optional[str] = None, max_tokens: int = 256) -> GenerateResult:
         import httpx
@@ -303,6 +313,28 @@ class MultiModelRuntime:
 
     def loaded_bytes(self) -> int:
         return sum(self._bytes.values())
+
+    def serving_stats(self) -> Dict[str, Any]:
+        """Ops snapshot for the admin serving panel: budget accounting
+        plus each resident model's engine stats."""
+        with self._lru_lock:
+            # Snapshot under the order lock: the hot-path LRU touch pops
+            # and reinserts entries, and an unguarded items() can see the
+            # dict change size mid-iteration.
+            resident = list(self._loaded.items())
+        return {
+            "runtime": "tpu-multi",
+            "budget_bytes": self._budget,
+            "loaded_bytes": self.loaded_bytes(),
+            "models": {
+                label: {
+                    "bytes": self._bytes.get(label, 0),
+                    **rt.serving_stats(),
+                }
+                for label, rt in resident
+            },
+            "available": sorted(self._paths),
+        }
 
     def _get(self, model: Optional[str]):
         label = model or self._default

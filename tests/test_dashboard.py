@@ -349,6 +349,55 @@ def test_playground_concurrent_requests_share_engine(tmp_path, monkeypatch):
     rt._engine.close()
 
 
+def test_admin_serving_page_reports_engine_and_levers(tmp_path, monkeypatch):
+    """The serving admin panel must surface the live pool state: after a
+    playground request through a real TPU runtime it shows the engine's
+    slots/window and completed count plus the quant levers; under the
+    stub runtime it says there is no pool."""
+    import re
+
+    import jax.numpy as jnp
+
+    from kakveda_tpu.models.generate import LlamaRuntime
+    from kakveda_tpu.models.llama import LlamaConfig
+
+    async def stub_case():
+        client = await _client(_mk_app(tmp_path / "stub"))
+        try:
+            await _login(client)
+            body = await (await client.get("/admin/serving")).text()
+            assert "no serving pool" in body
+        finally:
+            await client.close()
+
+    run(stub_case())
+
+    monkeypatch.setenv("KAKVEDA_KV_QUANT", "int8")
+    cfg = LlamaConfig(vocab_size=264, d_model=32, n_layers=2, n_heads=4, n_kv_heads=2,
+                      d_ff=48, max_seq_len=256, dtype=jnp.float32)
+    rt = LlamaRuntime(cfg=cfg, seed=0)
+    plat = Platform(data_dir=tmp_path / "data", capacity=256, dim=1024)
+    app = make_dashboard_app(platform=plat, db_path=tmp_path / "dash.db", model=rt)
+
+    async def tpu_case():
+        client = await _client(app)
+        try:
+            await _login(client)
+            # before any request: lazily-built engine absent, levers shown
+            body = await (await client.get("/admin/serving")).text()
+            assert "No engine yet" in body and "kv int8" in body
+            await client.post("/playground/run", data={"prompt": "hi", "target": "model"})
+            body = await (await client.get("/admin/serving")).text()
+            assert re.search(r"\d+ slots × \d+-token window", body)
+            assert "submitted / completed" in body
+        finally:
+            await client.close()
+
+    run(tpu_case())
+    if rt._engine is not None:
+        rt._engine.close()
+
+
 def test_project_api_key_ingest_and_budget(tmp_path):
     async def go():
         client = await _client(_mk_app(tmp_path))
