@@ -1255,8 +1255,29 @@ def main() -> int:
     # Each completed metric is also flushed to KAKVEDA_BENCH_PARTIAL
     # (default .bench_partial.json) so a later metric wedging — or the
     # driver timing the run out — cannot erase numbers already measured.
+    # KAKVEDA_BENCH_RESUME=1 preloads that file and skips metrics it
+    # already holds: re-running after a mid-sweep wedge re-measures only
+    # what's missing instead of burning another hour on a flaky lease.
     partial_path = os.environ.get("KAKVEDA_BENCH_PARTIAL", ".bench_partial.json")
-    results = []
+    done: dict = {}
+    if partial_path and os.environ.get("KAKVEDA_BENCH_RESUME") == "1":
+        try:
+            with open(partial_path) as f:
+                prior = json.load(f)
+            if prior.get("backend") == backend:
+                done = dict(prior.get("done", {}))
+                print(
+                    f"bench: resuming — {sorted(done)} already measured",
+                    file=sys.stderr,
+                )
+            else:
+                print(
+                    f"bench: partial file is from backend {prior.get('backend')!r}, "
+                    f"not {backend!r}; ignoring it",
+                    file=sys.stderr,
+                )
+        except (OSError, ValueError) as e:
+            print(f"bench: resume load failed ({e}); fresh run", file=sys.stderr)
 
     def _flush_partial():
         if not partial_path:
@@ -1264,12 +1285,12 @@ def main() -> int:
         try:
             tmp = partial_path + ".tmp"
             with open(tmp, "w") as f:
-                json.dump({"backend": backend, "results": results}, f)
+                json.dump({"backend": backend, "done": done}, f)
             os.replace(tmp, partial_path)
         except OSError as e:
             print(f"bench: partial flush failed: {e}", file=sys.stderr)
 
-    for fn in (
+    order = (
         _bench_warn,
         _bench_ingest,
         _bench_decode,
@@ -1278,10 +1299,13 @@ def main() -> int:
         _bench_mixed,
         _bench_mixed_decode,
         _bench_mine,
-    ):
+    )
+    for fn in order:
+        if fn.__name__ in done:
+            continue
         t_metric = time.perf_counter()
         try:
-            results.append(fn(backend))
+            done[fn.__name__] = fn(backend)
             print(
                 f"bench: {fn.__name__} done in {time.perf_counter() - t_metric:.1f}s",
                 file=sys.stderr,
@@ -1289,6 +1313,7 @@ def main() -> int:
         except Exception as e:  # noqa: BLE001 — one failed metric must not hide the others
             print(f"bench: {fn.__name__} failed: {type(e).__name__}: {e}", file=sys.stderr)
         _flush_partial()
+    results = [done[fn.__name__] for fn in order if fn.__name__ in done]
     if not results:
         return 1
     headline = results[0]

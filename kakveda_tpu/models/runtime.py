@@ -312,7 +312,10 @@ class MultiModelRuntime:
         return True
 
     def loaded_bytes(self) -> int:
-        return sum(self._bytes.values())
+        # dict() is an atomic C-level copy: the serving panel calls this
+        # from a handler thread while loads/evictions mutate _bytes, and
+        # iterating the live dict would raise mid-mutation.
+        return sum(dict(self._bytes).values())
 
     def serving_stats(self) -> Dict[str, Any]:
         """Ops snapshot for the admin serving panel: budget accounting
