@@ -306,14 +306,17 @@ def setup(app: web.Application) -> None:
             if r["k"]
         ]
         # Every day present (zero-filled) so the chart reads as a time
-        # series, not a sparse list of whichever days had warnings.
+        # series, not a sparse list of whichever days had warnings. Keys
+        # run from the cutoff's UTC day through TODAY inclusive — the
+        # cutoff day holds real events (SQL keeps ts > d30 within it),
+        # and anything past today would be a phantom empty bucket.
+        day0, day_last = int(d30 // 86400), int(now // 86400)
         by_day_filled = [
             (
-                datetime.fromtimestamp((int(d30 // 86400) + i) * 86400, tz=timezone.utc)
-                .strftime("%Y-%m-%d"),
-                by_day.get(int(d30 // 86400) + i, 0),
+                datetime.fromtimestamp(d * 86400, tz=timezone.utc).strftime("%Y-%m-%d"),
+                by_day.get(d, 0),
             )
-            for i in range(1, 32)
+            for d in range(day0, day_last + 1)
         ]
         cost_sql = "SELECT app_id, SUM(cost_micro_usd) AS cost FROM trace_runs WHERE ts>?"
         cost_params: List[Any] = [d30]

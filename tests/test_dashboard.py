@@ -259,6 +259,15 @@ def test_warnings_analytics_and_span_waterfall_depth(tmp_path):
             # tiles + chart + filters are rendered
             assert 'id="tile-total"' in body and 'id="day-chart"' in body
             assert 'id="f-window"' in body and 'id="f-app"' in body
+            # the day series must INCLUDE today (events land in today's
+            # bucket; a range ending yesterday or at a phantom tomorrow
+            # drops the newest warnings from the tile/chart)
+            import datetime as _dt
+
+            today = _dt.datetime.now(_dt.timezone.utc).strftime("%Y-%m-%d")
+            m_tile = re.search(r'id="tile-total">(\d+)<', body)
+            assert m_tile and int(m_tile.group(1)) >= 3, body[:500]
+            assert today in body
             # zero-filled 31-day series reaches the template context
             assert body.count("<tr") >= 3
             # raw rows JSON is embedded and parseable, with the real events
