@@ -376,10 +376,12 @@ def gqa_cache_attention(
             return _gqa_xla(q, kd, vd, pos0, kv_valid, window=window, softcap=softcap)
         return _gqa_xla(q, k, v, pos0, kv_valid, window=window, softcap=softcap)
     if use_flash is None:
+        from kakveda_tpu.ops.device import is_tpu_backend
+
         env = os.environ.get("KAKVEDA_FLASH", "auto")
         use_flash = (
             env != "0"
-            and jax.default_backend() == "tpu"
+            and is_tpu_backend()
             and _flash_ok(s, h, kv, l, d)
             # int8 caches prefer the kernel wherever the shape tiles: the
             # XLA path must materialize a full bf16 dequant copy of the

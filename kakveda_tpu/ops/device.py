@@ -1,0 +1,24 @@
+"""Backend identity helpers.
+
+The tunneled TPU registers as platform name ``"axon"`` (canonical
+platform ``"tpu"`` — its MLIR lowerings and Pallas rules alias to tpu),
+so ``jax.default_backend()`` may report either name depending on the
+client. Every "are we on TPU hardware?" gate must accept both — a bare
+``== "tpu"`` comparison silently disables the Pallas kernels and bf16
+stores on the real chip.
+"""
+
+from __future__ import annotations
+
+import jax
+
+_TPU_NAMES = ("tpu", "axon")
+
+
+def is_tpu_backend() -> bool:
+    """True when the default backend is real TPU hardware (incl. the
+    tunneled 'axon' platform)."""
+    try:
+        return jax.default_backend() in _TPU_NAMES
+    except Exception:  # noqa: BLE001 — backend init failure means "no"
+        return False

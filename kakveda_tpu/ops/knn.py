@@ -84,7 +84,9 @@ class ShardedKnn:
         self._pallas_interpret = env == "interpret"
         if use_pallas is None:
             if env == "auto":
-                use_pallas = jax.default_backend() == "tpu"
+                from kakveda_tpu.ops.device import is_tpu_backend
+
+                use_pallas = is_tpu_backend()
             else:
                 use_pallas = env not in ("0", "false", "off")
         rows = capacity // self.n_shards
@@ -105,7 +107,9 @@ class ShardedKnn:
         self.capacity = capacity
         self.rows_per_shard = rows
         if store_dtype is None:
-            store_dtype = jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32
+            from kakveda_tpu.ops.device import is_tpu_backend
+
+            store_dtype = jnp.bfloat16 if is_tpu_backend() else jnp.float32
         self.store_dtype = store_dtype
 
         # Single-device meshes take a plain-jit path: identical math, no
