@@ -566,7 +566,7 @@ def _measure_spec_judge(k: int) -> dict:
 
 
 def _bench_spec(backend: str) -> dict:
-    preset = os.environ.get("KAKVEDA_BENCH_DECODE_PRESET", "1b" if backend == "tpu" else "tiny")
+    preset = os.environ.get("KAKVEDA_BENCH_DECODE_PRESET", "1b" if _on_tpu(backend) else "tiny")
     steps = int(os.environ.get("KAKVEDA_BENCH_SPEC_STEPS", 256))
     k = int(os.environ.get("KAKVEDA_BENCH_SPEC_K", 8))
     print(f"bench[spec]: backend={backend} preset={preset} steps={steps} k={k}", file=sys.stderr)
@@ -907,8 +907,162 @@ def _measure_reference(dim_corpus: int, n_queries: int, target_n: int) -> float:
     return p50_small * (target_n / dim_corpus)
 
 
+def _on_tpu(backend: str) -> bool:
+    """Real TPU hardware — the tunneled chip may report 'tpu' or 'axon'."""
+    return backend in ("tpu", "axon")
+
+
+def _bench_pallas(backend: str) -> dict:
+    """Pallas-vs-XLA A/B on the SAME inputs: compiles (not interpret mode,
+    on TPU) the fused kNN kernel (ops/pallas_knn.py) and the int8-streaming
+    flash attention (models/attention.py:flash_gqa_cache), times each
+    against its XLA fallback with the slope method (two run lengths, so the
+    tunneled chip's fixed dispatch RTT cancels), and checks result parity.
+
+    This is the hardware proof VERDICT r4 asked for: interpret-mode CPU
+    tests verify kernel semantics, but only this run proves Mosaic
+    compilation, VMEM fit at production tiles, and the actual speedup.
+    ``compiled: true`` in the output means the kernels ran through Mosaic.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from kakveda_tpu.models.attention import _gqa_xla, _pick_block, flash_gqa_cache
+    from kakveda_tpu.models.llama import _kv_dequant, _kv_quant_rows
+    from kakveda_tpu.ops.knn import ShardedKnn
+    from kakveda_tpu.parallel.mesh import create_mesh
+
+    on_tpu = _on_tpu(backend)
+    interpret = not on_tpu  # CPU smoke exercises kernel logic via interpreter
+
+    def slope_ms(f, args, iters=(4, 12) if on_tpu else (1, 2)):
+        """Steady-state ms/call: (t[iters1] - t[iters0]) / (i1 - i0)."""
+        out = f(*args)
+        jax.block_until_ready(out)  # compile + warm
+        times = []
+        for it in iters:
+            t0 = time.perf_counter()
+            for _ in range(it):
+                out = f(*args)
+            jax.block_until_ready(out)
+            times.append(time.perf_counter() - t0)
+        return (times[1] - times[0]) / (iters[1] - iters[0]) * 1000.0
+
+    # --- fused top-k kNN vs matmul + lax.top_k --------------------------
+    n = int(os.environ.get("KAKVEDA_BENCH_PALLAS_N", 1_000_000 if on_tpu else 16_384))
+    dim = int(os.environ.get("KAKVEDA_BENCH_PALLAS_DIM", 2048 if on_tpu else 256))
+    B = int(os.environ.get("KAKVEDA_BENCH_BATCH", 64))
+    mesh = create_mesh("data:-1")
+    knn = ShardedKnn(mesh, capacity=n, dim=dim, k=5, use_pallas=True)
+    knn._pallas_interpret = interpret
+    emb, valid = knn.alloc()
+    chunk = min(1 << 16, knn.capacity)
+
+    @jax.jit
+    def _fill(emb_buf, valid_buf, key, start):
+        v = jax.random.normal(key, (chunk, dim), jnp.float32)
+        v = v / jnp.linalg.norm(v, axis=1, keepdims=True)
+        emb_buf = jax.lax.dynamic_update_slice(emb_buf, v.astype(emb_buf.dtype), (start, 0))
+        valid_buf = jax.lax.dynamic_update_slice(valid_buf, jnp.ones((chunk,), jnp.bool_), (start,))
+        return emb_buf, valid_buf
+
+    key = jax.random.PRNGKey(0)
+    for start in range(0, knn.capacity - chunk + 1, chunk):
+        key, sub = jax.random.split(key)
+        emb, valid = _fill(emb, valid, sub, start)
+    q = np.random.default_rng(0).standard_normal((B, dim), np.float32)
+    q /= np.linalg.norm(q, axis=1, keepdims=True)
+    qd = jnp.asarray(q)
+
+    impl = knn._topk_single_impl if knn.single_device else knn._topk_impl
+    knn.use_pallas = True
+    f_pallas = jax.jit(impl)
+    r_pallas = np.asarray(f_pallas(emb, valid, qd))
+    knn.use_pallas = False
+    f_xla = jax.jit(impl)
+    r_xla = np.asarray(f_xla(emb, valid, qd))
+    knn.use_pallas = True
+    k = knn.k
+    knn_parity = bool(
+        np.array_equal(r_pallas[:, k:], r_xla[:, k:])  # same row ids
+        and np.allclose(r_pallas[:, :k], r_xla[:, :k], atol=2e-2)
+    )
+    knn_pallas_ms = slope_ms(f_pallas, (emb, valid, qd))
+    knn_xla_ms = slope_ms(f_xla, (emb, valid, qd))
+    del emb, valid
+    print(
+        f"bench[pallas]: knn {knn.capacity}x{dim} B={B} — pallas {knn_pallas_ms:.2f} ms "
+        f"vs XLA {knn_xla_ms:.2f} ms (parity={knn_parity}, compiled={not interpret})",
+        file=sys.stderr,
+    )
+
+    # --- int8-KV flash attention vs XLA dequant-up-front ----------------
+    if on_tpu:
+        fb, fs, fh, fkv, fd, fl = 16, 1, 32, 4, 64, 2048
+    else:
+        fb, fs, fh, fkv, fd, fl = 2, 1, 8, 2, 64, 128
+    key = jax.random.PRNGKey(1)
+    kq, kk, kv_ = jax.random.split(key, 3)
+    qa = jax.random.normal(kq, (fb, fs, fh, fd), jnp.bfloat16)
+    k_f = jax.random.normal(kk, (fb, fkv, fl, fd), jnp.float32)
+    v_f = jax.random.normal(kv_, (fb, fkv, fl, fd), jnp.float32)
+    k_i8, k_sc = _kv_quant_rows(k_f)
+    v_i8, v_sc = _kv_quant_rows(v_f)
+    pos0 = jnp.asarray(fl - fs, jnp.int32)
+    kv_valid = jnp.ones((fb, fl), jnp.bool_)
+    sr = -(-(fs * (fh // fkv)) // 8) * 8
+    q_blk = _pick_block(sr, 512, 8)
+    l_blk = _pick_block(fl, 512, 128)
+
+    @jax.jit
+    def f_flash(qa, k_i8, k_sc, v_i8, v_sc):
+        return flash_gqa_cache(
+            qa, k_i8, v_i8, pos0, kv_valid,
+            k_scale=k_sc, v_scale=v_sc, q_blk=q_blk, l_blk=l_blk,
+            interpret=interpret,
+        )
+
+    @jax.jit
+    def f_xla_attn(qa, k_i8, k_sc, v_i8, v_sc):
+        kd = _kv_dequant(k_i8, k_sc, qa.dtype)
+        vd = _kv_dequant(v_i8, v_sc, qa.dtype)
+        return _gqa_xla(qa, kd, vd, pos0, kv_valid)
+
+    args = (qa, k_i8, k_sc, v_i8, v_sc)
+    o_flash = np.asarray(f_flash(*args), np.float32)
+    o_xla = np.asarray(f_xla_attn(*args), np.float32)
+    flash_diff = float(np.max(np.abs(o_flash - o_xla)))
+    flash_ms = slope_ms(f_flash, args)
+    xla_attn_ms = slope_ms(f_xla_attn, args)
+    print(
+        f"bench[pallas]: int8 flash [{fb},{fkv},{fl},{fd}] — flash {flash_ms:.3f} ms "
+        f"vs XLA {xla_attn_ms:.3f} ms (max|Δ|={flash_diff:.1e})",
+        file=sys.stderr,
+    )
+
+    knn_speedup = knn_xla_ms / knn_pallas_ms if knn_pallas_ms > 0 else 0.0
+    return {
+        "metric": "pallas_knn_speedup_vs_xla",
+        "value": round(knn_speedup, 2),
+        "unit": "x",
+        "vs_baseline": round(knn_speedup, 2),
+        "compiled": not interpret,
+        "knn": {
+            "rows": knn.capacity, "dim": dim, "batch": B,
+            "pallas_ms": round(knn_pallas_ms, 3), "xla_ms": round(knn_xla_ms, 3),
+            "parity": knn_parity,
+        },
+        "flash_attn_int8": {
+            "shape_bkld": [fb, fkv, fl, fd],
+            "flash_ms": round(flash_ms, 4), "xla_ms": round(xla_attn_ms, 4),
+            "speedup": round(xla_attn_ms / flash_ms, 2) if flash_ms > 0 else 0.0,
+            "max_abs_diff": flash_diff,
+        },
+    }
+
+
 def _bench_warn(backend: str) -> dict:
-    default_n = 1_000_000 if backend == "tpu" else 100_000
+    default_n = 1_000_000 if _on_tpu(backend) else 100_000
     n = int(os.environ.get("KAKVEDA_BENCH_N", default_n))
     dim = int(os.environ.get("KAKVEDA_BENCH_DIM", 2048))
     n_queries = int(os.environ.get("KAKVEDA_BENCH_QUERIES", 64))
@@ -949,7 +1103,7 @@ def _bench_ingest(backend: str) -> dict:
 
 
 def _bench_decode(backend: str) -> dict:
-    preset = os.environ.get("KAKVEDA_BENCH_DECODE_PRESET", "1b" if backend == "tpu" else "tiny")
+    preset = os.environ.get("KAKVEDA_BENCH_DECODE_PRESET", "1b" if _on_tpu(backend) else "tiny")
     bsz = int(os.environ.get("KAKVEDA_BENCH_DECODE_BATCH", 16))
     steps = int(os.environ.get("KAKVEDA_BENCH_DECODE_STEPS", 128))
     print(f"bench[decode]: backend={backend} preset={preset} batch={bsz} steps={steps}", file=sys.stderr)
@@ -1014,9 +1168,9 @@ def _bench_mixed(backend: str) -> dict:
 
 
 def _bench_mixed_decode(backend: str) -> dict:
-    n = int(os.environ.get("KAKVEDA_BENCH_MIXED_N", 1 << 20 if backend == "tpu" else 1 << 14))
+    n = int(os.environ.get("KAKVEDA_BENCH_MIXED_N", 1 << 20 if _on_tpu(backend) else 1 << 14))
     dim = int(os.environ.get("KAKVEDA_BENCH_DIM", 2048))
-    preset = os.environ.get("KAKVEDA_BENCH_DECODE_PRESET", "1b" if backend == "tpu" else "tiny")
+    preset = os.environ.get("KAKVEDA_BENCH_DECODE_PRESET", "1b" if _on_tpu(backend) else "tiny")
     chunk_steps = int(os.environ.get("KAKVEDA_BENCH_CHUNK_STEPS", 8))
     print(
         f"bench[mixed-decode]: backend={backend} n={n} dim={dim} preset={preset} chunk={chunk_steps}",
@@ -1042,7 +1196,7 @@ def _bench_mixed_decode(backend: str) -> dict:
 
 
 def _bench_mine(backend: str) -> dict:
-    n = int(os.environ.get("KAKVEDA_BENCH_MINE_N", 500_000 if backend == "tpu" else 20_000))
+    n = int(os.environ.get("KAKVEDA_BENCH_MINE_N", 500_000 if _on_tpu(backend) else 20_000))
     dim = int(os.environ.get("KAKVEDA_BENCH_DIM", 2048))
     n_templates = int(os.environ.get("KAKVEDA_BENCH_MINE_TEMPLATES", 120))
     print(f"bench[mine]: backend={backend} n={n} dim={dim} templates={n_templates}", file=sys.stderr)
@@ -1085,7 +1239,7 @@ def _bench_continuous(backend: str) -> dict:
     from kakveda_tpu.models.llama import LlamaConfig, init_params
     from kakveda_tpu.models.serving import ContinuousBatcher
 
-    preset = os.environ.get("KAKVEDA_BENCH_DECODE_PRESET", "1b" if backend == "tpu" else "tiny")
+    preset = os.environ.get("KAKVEDA_BENCH_DECODE_PRESET", "1b" if _on_tpu(backend) else "tiny")
     cfg = _preset_cfg(preset)
     params = jax.tree.map(
         lambda x: x.astype(jnp.bfloat16), init_params(jax.random.PRNGKey(0), cfg)
@@ -1322,6 +1476,7 @@ def main() -> int:
         "mine": _bench_mine,
         "continuous": _bench_continuous,
         "spec": _bench_spec,
+        "pallas": _bench_pallas,
     }
     if which in fns:
         print(json.dumps(fns[which](backend)))
@@ -1351,6 +1506,7 @@ def main() -> int:
 
     order = (
         _bench_warn,
+        _bench_pallas,
         _bench_ingest,
         _bench_decode,
         _bench_spec,
