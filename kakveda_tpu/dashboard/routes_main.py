@@ -345,13 +345,24 @@ def setup(app: web.Application) -> None:
                 ],
             }
         ).replace("<", "\\u003c")
+        # Full-window SQL aggregates for the INITIAL render: the client
+        # script only re-aggregates from the truncated rows_json once a
+        # filter changes (with a visible "view" badge) — deriving the
+        # first paint from 500 rows would silently undercount busy
+        # deployments (the very thing the SQL aggregation exists for).
+        server_agg_json = json.dumps(
+            {
+                "by_day": by_day_filled,
+                "by_app": by_app[:12],
+                "by_pattern": by_pattern[:12],
+                "n_events": len(events),
+            }
+        ).replace("<", "\\u003c")
         return ctx.render(
             request,
             "warnings.html",
             events=events,
-            by_day=by_day_filled,
-            by_app=by_app,
-            by_pattern=by_pattern,
+            server_agg_json=server_agg_json,
             cost_by_app=cost_rows,
             total_warnings_30d=n30,
             apps_active_30d=len(by_app),
@@ -435,9 +446,13 @@ def setup(app: web.Application) -> None:
             for kids in by_parent.values():
                 kids.sort(key=lambda s: s["start_ts"])
             ordered: List[Dict] = []
+            seen: set = set()
 
             def walk(parent_id, depth):
                 for s in by_parent.get(parent_id, []):
+                    if s["id"] in seen:  # parent cycle from corrupted ingestion
+                        continue
+                    seen.add(s["id"])
                     s["depth"] = depth
                     s["has_children"] = bool(by_parent.get(s["id"]))
                     ordered.append(s)
@@ -449,15 +464,24 @@ def setup(app: web.Application) -> None:
             # extra roots rather than silently dropping them from the
             # waterfall.
             span_ids = {s["id"] for s in spans}
-            seen = {s["id"] for s in ordered}
             for s in sorted(spans, key=lambda s: s["start_ts"]):
                 if s["id"] not in seen and s["parent_id"] not in span_ids:
+                    seen.add(s["id"])
                     s["depth"] = 0
                     s["has_children"] = bool(by_parent.get(s["id"]))
                     ordered.append(s)
-                    seen.add(s["id"])
                     walk(s["id"], 1)
-                    seen.update(x["id"] for x in ordered)
+            # Last resort: spans whose parent chain never reaches a root —
+            # a parent cycle or self-parenting row. Surface them as extra
+            # depth-0 rows (the seen-guard in walk() breaks the cycle)
+            # instead of vanishing them from the waterfall.
+            for s in sorted(spans, key=lambda s: s["start_ts"]):
+                if s["id"] not in seen:
+                    seen.add(s["id"])
+                    s["depth"] = 0
+                    s["has_children"] = bool(by_parent.get(s["id"]))
+                    ordered.append(s)
+                    walk(s["id"], 1)
             spans = ordered
         feedback = ctx.db.query("SELECT * FROM run_feedback WHERE trace_id=?", (trace_id,))
         return ctx.render(

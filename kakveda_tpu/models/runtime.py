@@ -291,8 +291,15 @@ class MultiModelRuntime:
             int(os.environ.get("KAKVEDA_SERVE_WINDOW", min(512, cfg.max_seq_len))),
             cfg.max_seq_len,
         )
-        itemsize = np.dtype(cfg.dtype).itemsize
-        return slots * window * cfg.n_layers * 2 * cfg.n_kv_heads * cfg.head_dim * itemsize
+        if os.environ.get("KAKVEDA_KV_QUANT", "").lower() == "int8":
+            # int8 pool: 1 byte/element + one f32 per-row scale per head_dim
+            # elements (models/llama.py:_kv_quant_rows). Charging the dense
+            # dtype here over-charges ~2× — safe, but it skews the admin
+            # panel's resident-bytes figure and triggers eviction early.
+            itemsize = 1.0 + 4.0 / cfg.head_dim
+        else:
+            itemsize = float(np.dtype(cfg.dtype).itemsize)
+        return int(slots * window * cfg.n_layers * 2 * cfg.n_kv_heads * cfg.head_dim * itemsize)
 
     def _evict_lru(self, keep: str) -> bool:
         """Drop the least-recently-used loaded model (never ``keep``);

@@ -882,3 +882,79 @@ def test_admin_agents_page(tmp_path):
             await client.close()
 
     run(go())
+
+
+def test_span_waterfall_survives_parent_cycles(tmp_path):
+    """Spans whose parent chain never reaches a root — a parent CYCLE or a
+    self-parenting row from corrupted ingestion — must still appear in the
+    waterfall as depth-0 rows instead of silently vanishing (ADVICE r4:
+    the orphan pass only rescued spans whose parent_id was absent)."""
+    import time as _time
+
+    from kakveda_tpu.dashboard.core import CTX_KEY
+
+    async def go():
+        app = _mk_app(tmp_path)
+        db = app[CTX_KEY].db
+        now = _time.time()
+        tid = "11111111-2222-3333-4444-555555555555"
+        db.execute(
+            "INSERT INTO trace_runs (trace_id, ts, app_id, status) VALUES (?,?,?,?)",
+            (tid, now, "app-C", "ok"),
+        )
+        root = db.add_span(tid, "root", now, now + 1.0)
+        db.add_span(tid, "child", now + 0.1, now + 0.5, parent_id=root)
+        # Parent cycle: A's parent is B, B's parent is A (ids exist, but
+        # neither is reachable from a root).
+        a = db.add_span(tid, "cyc-a", now + 0.2, now + 0.3, parent_id=10**6)
+        b = db.add_span(tid, "cyc-b", now + 0.25, now + 0.35, parent_id=a)
+        db.execute("UPDATE trace_spans SET parent_id=? WHERE id=?", (b, a))
+        # Self-parenting span.
+        s = db.add_span(tid, "self-loop", now + 0.4, now + 0.45, parent_id=10**6)
+        db.execute("UPDATE trace_spans SET parent_id=? WHERE id=?", (s, s))
+
+        client = await _client(app)
+        try:
+            await _login(client)
+            detail = await (await client.get(f"/runs/{tid}")).text()
+            for name in ("root", "child", "cyc-a", "cyc-b", "self-loop"):
+                assert name in detail, f"span {name!r} missing from waterfall"
+        finally:
+            await client.close()
+
+    run(go())
+
+
+def test_warnings_initial_render_uses_server_aggregates(tmp_path):
+    """The first paint must come from the full-window SQL aggregates, not a
+    client re-aggregation of the truncated newest-500 rows (ADVICE r4
+    medium): the server-agg JSON is embedded and the script renders from it
+    without an unconditional refresh()."""
+    import json as _json
+    import re
+
+    async def go():
+        client = await _client(_mk_app(tmp_path))
+        try:
+            await _login(client)
+            await client.post(
+                "/scenarios/run",
+                data={"app_id": "app-S",
+                      "prompt": "Summarize this and include citations even if not provided."},
+                allow_redirects=False,
+            )
+            body = await (await client.get("/warnings")).text()
+            m = re.search(r'<script id="server-agg"[^>]*>(.*?)</script>', body, re.S)
+            assert m, "server aggregates JSON missing"
+            agg = _json.loads(m.group(1))
+            assert sum(n for _, n in agg["by_day"]) >= 1
+            assert any(a == "app-S" for a, _ in agg["by_app"])
+            # Initial render comes from SERVER data; client refresh() only
+            # runs on filter events, so the page must not call it on load.
+            script = body[body.index("server-agg"):]
+            assert "renderChart(new Map(SERVER.by_day" in script
+            assert re.search(r"^\s*refresh\(\);", script, re.M) is None
+        finally:
+            await client.close()
+
+    run(go())

@@ -653,3 +653,22 @@ def test_runtime_from_hf_sharded_serving(tmp_path):
     got = rt.generate("the quick brown", max_tokens=6)
     assert got.text == expected
     assert got.meta["provider"] == "tpu"
+
+
+def test_engine_pool_bytes_reflects_kv_quant(monkeypatch):
+    """Budget accounting charges ~1.06 B/element for int8 KV pools (int8
+    values + one f32 per-row scale per head_dim), not the dense dtype's 2 B
+    (ADVICE r4: over-charging skews the admin panel and evicts early)."""
+    from kakveda_tpu.models.llama import LlamaConfig
+    from kakveda_tpu.models.runtime import MultiModelRuntime
+
+    cfg = LlamaConfig()
+    monkeypatch.delenv("KAKVEDA_KV_QUANT", raising=False)
+    dense = MultiModelRuntime._engine_pool_bytes(cfg)
+    monkeypatch.setenv("KAKVEDA_KV_QUANT", "int8")
+    int8 = MultiModelRuntime._engine_pool_bytes(cfg)
+    import numpy as np
+
+    itemsize = np.dtype(cfg.dtype).itemsize
+    expected_ratio = (1.0 + 4.0 / cfg.head_dim) / itemsize
+    assert abs(int8 / dense - expected_ratio) < 1e-6, (int8, dense)
