@@ -40,20 +40,27 @@ def estimate_cost_micro_usd(tokens_in: int, tokens_out: int) -> int:
 
 def parse_advanced_query(q: str) -> Dict[str, Any]:
     """Runs-explorer mini query language: free text plus ``provider:x``,
-    ``model:x``, ``tag:x``, ``label:x``, ``thumb:up``, ``latency_ms>N``,
-    ``has:error`` (reference: services/dashboard/app.py:173-221)."""
+    ``model:x``, ``project:x``, ``tag:x`` / ``label:x`` (repeatable —
+    a run matches ANY of the listed values), ``thumb:up``,
+    ``latency_ms>N`` / ``latency_ms<N``, ``has:error``
+    (reference: services/dashboard/app.py:173-221)."""
     out: Dict[str, Any] = {"text": [], "filters": {}}
+    f = out["filters"]
     for tok in (q or "").split():
-        if tok.startswith(("provider:", "model:", "tag:", "label:", "thumb:")):
+        if tok.startswith(("provider:", "model:", "thumb:", "project:")):
             k, _, v = tok.partition(":")
-            out["filters"][k] = v
-        elif tok.startswith("latency_ms>"):
+            f[k] = v
+        elif tok.startswith(("tag:", "label:")):
+            k, _, v = tok.partition(":")
+            f.setdefault(k + "s", []).append(v)
+        elif tok.startswith("latency_ms") and (">" in tok or "<" in tok):
+            op = ">" if ">" in tok else "<"
             try:
-                out["filters"]["latency_gt"] = int(tok.split(">", 1)[1])
+                f["latency_gt" if op == ">" else "latency_lt"] = int(tok.split(op, 1)[1])
             except ValueError:
                 pass
         elif tok == "has:error":
-            out["filters"]["has_error"] = True
+            f["has_error"] = True
         else:
             out["text"].append(tok)
     out["text"] = " ".join(out["text"])
@@ -392,23 +399,39 @@ def setup(app: web.Application) -> None:
         if f.get("latency_gt") is not None:
             clauses.append("latency_ms>?")
             params.append(f["latency_gt"])
+        if f.get("latency_lt") is not None:
+            clauses.append("latency_ms<?")
+            params.append(f["latency_lt"])
         if f.get("has_error"):
             clauses.append("(status='error' OR error IS NOT NULL)")
-        if f.get("tag"):
-            clauses.append("tags_json LIKE ?")
-            params.append(f"%{f['tag']}%")
+        if f.get("project"):
+            # project:<name> (or a raw numeric id) scopes to one project.
+            proj = ctx.db.one("SELECT id FROM projects WHERE name=?", (f["project"],))
+            if proj is not None:
+                clauses.append("project_id=?")
+                params.append(proj["id"])
+            elif f["project"].isdigit():
+                clauses.append("project_id=?")
+                params.append(int(f["project"]))
+            else:
+                clauses.append("1=0")  # unknown project: empty result, not all runs
+        if f.get("tags"):
+            # Repeatable tag: — a run matches ANY of the listed tags
+            # (reference IN-subquery semantics, app.py:2831-2837).
+            clauses.append("(" + " OR ".join(["tags_json LIKE ?"] * len(f["tags"])) + ")")
+            params.extend(f"%{t}%" for t in f["tags"])
         if parsed["text"]:
             clauses.append("(prompt LIKE ? OR response LIKE ? OR app_id LIKE ?)")
             like = f"%{parsed['text']}%"
             params.extend([like, like, like])
-        if f.get("thumb") or f.get("label"):
+        if f.get("thumb") or f.get("labels"):
             sub = "SELECT trace_id FROM run_feedback WHERE 1=1"
             if f.get("thumb"):
                 sub += " AND thumb=?"
                 params.append(f["thumb"])
-            if f.get("label"):
-                sub += " AND label=?"
-                params.append(f["label"])
+            if f.get("labels"):
+                sub += " AND label IN (" + ",".join("?" * len(f["labels"])) + ")"
+                params.extend(f["labels"])
             clauses.append(f"trace_id IN ({sub})")
         if clauses:
             sql += " WHERE " + " AND ".join(clauses)

@@ -958,3 +958,63 @@ def test_warnings_initial_render_uses_server_aggregates(tmp_path):
             await client.close()
 
     run(go())
+
+
+def test_runs_query_language_operators(tmp_path):
+    """Full reference operator set (services/dashboard/app.py:173-221):
+    latency_ms> and latency_ms<, project:<name>, and REPEATABLE tag:/label:
+    (a run matches any of the listed values)."""
+    import time as _time
+    import uuid as _uuid
+
+    from kakveda_tpu.dashboard.core import CTX_KEY
+
+    async def go():
+        app = _mk_app(tmp_path)
+        db = app[CTX_KEY].db
+        now = _time.time()
+        pid = db.execute(
+            "INSERT INTO projects (name, created_at) VALUES (?,?)", ("proj-x", now)
+        )
+        rows = [
+            # (app_id, latency, project_id, tags, label)
+            ("app-fast", 100, None, ["prod"], "good"),
+            ("app-slow", 5000, pid, ["canary"], "bad"),
+            ("app-mid", 1500, None, ["staging"], None),
+        ]
+        tids = {}
+        for app_id, lat, proj, tags, label in rows:
+            tid = str(_uuid.uuid4())
+            tids[app_id] = tid
+            db.execute(
+                "INSERT INTO trace_runs (trace_id, ts, app_id, latency_ms, project_id,"
+                " tags_json, status) VALUES (?,?,?,?,?,?,?)",
+                (tid, now, app_id, lat, proj, __import__("json").dumps(tags), "ok"),
+            )
+            if label:
+                db.execute(
+                    "INSERT INTO run_feedback (trace_id, user_email, thumb, label, ts)"
+                    " VALUES (?,?,?,?,?)", (tid, "t@local", "up", label, now),
+                )
+
+        client = await _client(app)
+        try:
+            await _login(client)
+
+            async def hits(q):
+                body = await (await client.get("/runs", params={"q": q})).text()
+                return {a for a in ("app-fast", "app-slow", "app-mid") if a in body}
+
+            assert await hits("latency_ms>2000") == {"app-slow"}
+            assert await hits("latency_ms<500") == {"app-fast"}
+            assert await hits("latency_ms>500 latency_ms<2000") == {"app-mid"}
+            assert await hits("project:proj-x") == {"app-slow"}
+            assert await hits("project:no-such") == set()
+            # repeatable tag: matches ANY listed value
+            assert await hits("tag:prod tag:canary") == {"app-fast", "app-slow"}
+            assert await hits("label:good label:bad") == {"app-fast", "app-slow"}
+            assert await hits("tag:prod label:bad") == set()  # AND across operators
+        finally:
+            await client.close()
+
+    run(go())
