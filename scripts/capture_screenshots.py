@@ -1,0 +1,146 @@
+#!/usr/bin/env python3
+"""Capture dashboard screenshots into docs/screenshots/.
+
+Parity target: the reference ships docs/screenshots/{login,dashboard,
+warnings,scenarios,run,playground,prompts,experiments,datasets,
+admin_rbac,...}.png. This script drives a LIVE kakveda-tpu dashboard
+(started via ``python -m kakveda_tpu.cli up``) through headless Chrome's
+DevTools protocol and saves the same page set.
+
+Usage:
+    python -m kakveda_tpu.cli up --detach --dir /tmp/shots --dashboard-port 8110
+    python scripts/demo_client.py --base http://127.0.0.1:8100   # seed data
+    python scripts/capture_screenshots.py --base http://127.0.0.1:8110
+
+Requires a Chrome/Chromium binary (``--chrome`` or $CHROME). The CI image
+this repo is developed in has no browser — run this wherever Chrome
+exists; the capture itself is fully automated (login + cookie handling
+included).
+"""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+from pathlib import Path
+
+PAGES = [
+    ("login", "/login", False),
+    ("dashboard", "/", True),
+    ("warnings", "/warnings", True),
+    ("scenarios", "/scenarios", True),
+    ("runs", "/runs", True),
+    ("playground", "/playground", True),
+    ("prompts", "/prompts", True),
+    ("experiments", "/experiments", True),
+    ("datasets", "/datasets", True),
+    ("health", "/health", True),
+    ("admin_rbac", "/admin/users", True),
+    ("admin_serving", "/admin/serving", True),
+]
+
+
+def find_chrome(explicit: str | None) -> str:
+    cands = [explicit, os.environ.get("CHROME")] + [
+        shutil.which(n)
+        for n in ("chromium", "chromium-browser", "google-chrome", "chrome")
+    ]
+    for c in cands:
+        if c and Path(c).exists():
+            return c
+    sys.exit(
+        "no Chrome/Chromium binary found — pass --chrome or set $CHROME "
+        "(this image has no browser; run where one exists)"
+    )
+
+
+def cdp(port: int, ws, method: str, params: dict, _id=[0]):
+    _id[0] += 1
+    ws.send(json.dumps({"id": _id[0], "method": method, "params": params}))
+    while True:
+        msg = json.loads(ws.recv())
+        if msg.get("id") == _id[0]:
+            if "error" in msg:
+                raise RuntimeError(f"{method}: {msg['error']}")
+            return msg.get("result", {})
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--base", default="http://127.0.0.1:8110")
+    ap.add_argument("--out", default=str(Path(__file__).resolve().parent.parent / "docs" / "screenshots"))
+    ap.add_argument("--chrome", default=None)
+    ap.add_argument("--email", default="admin@local")
+    ap.add_argument("--password", default="admin123")
+    args = ap.parse_args()
+
+    try:
+        from websocket import create_connection  # websocket-client
+    except ImportError:
+        sys.exit("pip install websocket-client (CDP transport)")
+
+    chrome = find_chrome(args.chrome)
+    out = Path(args.out)
+    out.mkdir(parents=True, exist_ok=True)
+    port = 9222
+    prof = tempfile.mkdtemp(prefix="kakveda-shots-")
+    proc = subprocess.Popen(
+        [
+            chrome, "--headless=new", f"--remote-debugging-port={port}",
+            f"--user-data-dir={prof}", "--no-sandbox", "--window-size=1280,860",
+            "about:blank",
+        ],
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+    )
+    try:
+        for _ in range(50):
+            try:
+                tabs = json.load(urllib.request.urlopen(f"http://127.0.0.1:{port}/json"))
+                break
+            except Exception:
+                time.sleep(0.2)
+        ws = create_connection(tabs[0]["webSocketDebuggerUrl"])
+        cdp(port, ws, "Page.enable", {})
+        cdp(port, ws, "Runtime.enable", {})
+
+        def goto(path):
+            cdp(port, ws, "Page.navigate", {"url": args.base + path})
+            time.sleep(1.2)  # charts render client-side
+
+        def shot(name):
+            r = cdp(port, ws, "Page.captureScreenshot", {"format": "png"})
+            (out / f"{name}.png").write_bytes(base64.b64decode(r["data"]))
+            print(f"captured {name}.png")
+
+        # login via the real form (sets the session cookie in-browser)
+        goto("/login")
+        shot("login")
+        cdp(port, ws, "Runtime.evaluate", {
+            "expression": (
+                f"document.querySelector('[name=email]').value={args.email!r};"
+                f"document.querySelector('[name=password]').value={args.password!r};"
+                "document.querySelector('form').submit();"
+            )
+        })
+        time.sleep(1.5)
+        for name, path, needs_login in PAGES:
+            if name == "login":
+                continue
+            goto(path)
+            shot(name)
+    finally:
+        proc.terminate()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
