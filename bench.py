@@ -1195,6 +1195,143 @@ def _bench_mixed_decode(backend: str) -> dict:
     }
 
 
+def _bench_serve(backend: str) -> dict:
+    """Concurrent-HTTP serving SLOs: N separate logged-in clients drive
+    playground generation through a REAL aiohttp dashboard server (all
+    decodes share one ServingEngine, continuous batching) while a warn
+    stream hits the service API — the mixed workload a deployment actually
+    sees. Reports request p50/p95, aggregate decode tok/s, and warn p95
+    under load. The reference can't exercise this: its playground and eval
+    loops are strictly sequential HTTP calls to Ollama
+    (services/dashboard/app.py:3127-3299, 2315-2393).
+
+    vs_baseline = concurrency speedup: sum of request latencies (what a
+    sequential server would take) / measured wall."""
+    import asyncio
+    import tempfile
+    from pathlib import Path
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from kakveda_tpu.dashboard.app import make_dashboard_app
+    from kakveda_tpu.models.generate import LlamaRuntime
+    from kakveda_tpu.platform import Platform
+    from kakveda_tpu.service.app import make_app as make_service_app
+
+    preset = os.environ.get("KAKVEDA_BENCH_DECODE_PRESET", "1b" if _on_tpu(backend) else "tiny")
+    n_clients = int(os.environ.get("KAKVEDA_BENCH_SERVE_CLIENTS", 16))
+    reqs_per = int(os.environ.get("KAKVEDA_BENCH_SERVE_REQS", 2))
+    cfg = _preset_cfg(preset)
+    rt = LlamaRuntime(cfg=cfg, seed=0)
+    tmp = Path(tempfile.mkdtemp(prefix="kakveda-bench-serve-"))
+    plat = Platform(data_dir=tmp / "data", capacity=1 << 14, dim=2048)
+    dash = make_dashboard_app(platform=plat, db_path=tmp / "dash.db", model=rt)
+    svc = make_service_app(platform=plat)
+
+    rng = np.random.default_rng(0)
+    prompts = [
+        "Review failure report %d: %s" % (i, " ".join(
+            str(w) for w in rng.integers(0, 999, size=12)
+        ))
+        for i in range(n_clients)
+    ]
+    lat_play: list = []
+    lat_warn: list = []
+    stop = asyncio.Event()
+
+    async def go():
+        server = TestServer(dash)
+        await server.start_server()
+        svc_server = TestServer(svc)
+        await svc_server.start_server()
+        clients = [TestClient(server) for _ in range(n_clients)]
+        svc_client = TestClient(svc_server)
+        t_wall = 0.0
+        try:
+            for c in clients:
+                await c.start_server()
+                r = await c.post(
+                    "/login",
+                    data={"email": "admin@local", "password": "admin123", "next": "/"},
+                    allow_redirects=False,
+                )
+                assert r.status == 302
+            await svc_client.start_server()
+            # Warm both compiled paths off-clock (engine decode + warn match).
+            await clients[0].post(
+                "/playground/run", data={"prompt": "warm up", "target": "model"}
+            )
+            await svc_client.post("/warn", json={"app_id": "warm", "prompt": "warm"})
+
+            async def play_worker(client, prompt):
+                for _ in range(reqs_per):
+                    t0 = time.perf_counter()
+                    r = await client.post(
+                        "/playground/run", data={"prompt": prompt, "target": "model"}
+                    )
+                    await r.text()
+                    lat_play.append(time.perf_counter() - t0)
+                    assert r.status == 200
+
+            async def warn_worker():
+                i = 0
+                while not stop.is_set():
+                    t0 = time.perf_counter()
+                    r = await svc_client.post(
+                        "/warn",
+                        json={"app_id": "bench", "prompt": f"Cite sources for claim {i}."},
+                    )
+                    await r.json()
+                    lat_warn.append(time.perf_counter() - t0)
+                    assert r.status == 200
+                    i += 1
+                    await asyncio.sleep(0.02)
+
+            wt = asyncio.create_task(warn_worker())
+            t0 = time.perf_counter()
+            await asyncio.gather(*(play_worker(c, p) for c, p in zip(clients, prompts)))
+            t_wall = time.perf_counter() - t0
+            stop.set()
+            await wt
+        finally:
+            for c in clients:
+                await c.close()
+            await svc_client.close()
+        return t_wall
+
+    wall = asyncio.run(go())
+    if rt._engine is not None:
+        completed = rt._engine.stats["completed"]
+        rt._engine.close()
+    else:
+        completed = 0
+    p50, p95 = (float(x) for x in np.percentile(lat_play, [50, 95]))
+    p95w = float(np.percentile(lat_warn, 95)) if lat_warn else 0.0
+    n_reqs = len(lat_play)
+    tok_s = n_reqs * 64 / wall if wall > 0 else 0.0  # generate() default max_tokens
+    seq_est = float(np.sum(lat_play))
+    print(
+        f"bench[serve]: {n_clients} clients × {reqs_per} reqs ({preset}) — "
+        f"p50 {p50*1000:.0f} ms, p95 {p95*1000:.0f} ms, {tok_s:,.0f} tok/s agg, "
+        f"warn p95 under load {p95w*1000:.1f} ms ({len(lat_warn)} warns), "
+        f"concurrency speedup {seq_est/wall:.1f}x",
+        file=sys.stderr,
+    )
+    return {
+        "metric": "serve_http_p95_ms_concurrent",
+        "value": round(p95 * 1000, 1),
+        "unit": "ms",
+        "vs_baseline": round(seq_est / wall, 2) if wall > 0 else 0.0,
+        "clients": n_clients,
+        "requests": n_reqs,
+        "p50_ms": round(p50 * 1000, 1),
+        "agg_tokens_per_sec": round(tok_s, 1),
+        "warn_p95_ms_under_load": round(p95w * 1000, 2),
+        "engine_completed": completed,
+        "preset": preset,
+    }
+
+
 def _bench_mine(backend: str) -> dict:
     n = int(os.environ.get("KAKVEDA_BENCH_MINE_N", 500_000 if _on_tpu(backend) else 20_000))
     dim = int(os.environ.get("KAKVEDA_BENCH_DIM", 2048))
@@ -1477,6 +1614,7 @@ def main() -> int:
         "continuous": _bench_continuous,
         "spec": _bench_spec,
         "pallas": _bench_pallas,
+        "serve": _bench_serve,
     }
     if which in fns:
         print(json.dumps(fns[which](backend)))
@@ -1511,6 +1649,7 @@ def main() -> int:
         _bench_decode,
         _bench_spec,
         _bench_continuous,
+        _bench_serve,
         _bench_mixed,
         _bench_mixed_decode,
         _bench_mine,

@@ -214,6 +214,7 @@ def _cmd_up(args: argparse.Namespace) -> int:
             "--dir", str(root), "--host", args.host, "--port", str(args.port),
             "--dashboard-port", str(args.dashboard_port),
         ]
+        root.mkdir(parents=True, exist_ok=True)  # fresh --dir: log lives inside
         logf = open(_log_path(root), "ab")
         proc = subprocess.Popen(
             cmd, stdout=logf, stderr=subprocess.STDOUT, start_new_session=True
