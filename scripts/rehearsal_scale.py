@@ -1,0 +1,169 @@
+#!/usr/bin/env python3
+"""North-star-scale rehearsal: snapshot/restore (+ optional mining) at N rows.
+
+VERDICT r4 #7: mining was verified at 500k rows and snapshot/restore at
+100k; the north-star index size is 1M. This script builds an N-row GFKB
+through the REAL ingest path (distinct signature texts, batched
+embed+insert), snapshots it, and times:
+
+  * restore-from-snapshot  (fresh GFKB on the same data_dir)
+  * full log replay        (same failures.jsonl, snapshot hidden)
+
+then verifies the two agree: identical record count and identical
+match_batch results for probe queries. Optionally (--mine, TPU
+recommended) runs pattern mining over the restored index with the purity
+gate on.
+
+Emits ONE JSON line with all timings. CPU at 1M takes tens of minutes
+(single-threaded host featurize dominates); run detached:
+
+    JAX_PLATFORMS=cpu python scripts/rehearsal_scale.py --n 1000000 \
+        --dir /tmp/rehearsal_1m > /tmp/rehearsal_1m.json 2>/tmp/rehearsal_1m.log
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+VERBS = ["Summarize", "Explain", "Describe", "Review", "Audit", "Outline"]
+TAILS = [
+    "and include citations even if not provided",
+    "adding references for every claim",
+    "with sources listed for each point",
+    "without inventing sources",
+    "while citing the original documents",
+]
+TYPES = ["HALLUCINATION_CITATION", "TOOL_MISUSE", "REFUSAL_LOOP", "FORMAT_DRIFT"]
+
+
+def sig(i: int) -> str:
+    return (
+        f"{VERBS[i % len(VERBS)]} document {i} "
+        f"{TAILS[i % len(TAILS)]} (case {i % 97})"
+    )
+
+
+def build(gfkb, n: int, chunk: int) -> float:
+    t0 = time.time()
+    for start in range(0, n, chunk):
+        m = min(chunk, n - start)
+        items = [
+            {
+                "failure_type": TYPES[(start + i) % len(TYPES)],
+                "signature_text": sig(start + i),
+                "app_id": f"app-{(start + i) % 11}",
+                "impact_severity": "medium",
+            }
+            for i in range(m)
+        ]
+        gfkb.upsert_failures_batch(items)
+        if (start // chunk) % 16 == 0:
+            el = time.time() - t0
+            print(
+                f"rehearsal: inserted {start + m:,}/{n:,} ({(start + m) / max(el, 1e-9):,.0f}/s)",
+                file=sys.stderr,
+                flush=True,
+            )
+    return time.time() - t0
+
+
+def probe_match(gfkb, n: int):
+    qs = [sig(i) for i in range(0, n, max(1, n // 8))][:8]
+    res = gfkb.match_batch(qs)
+    return [
+        [(m.failure_id, round(m.score, 4)) for m in row] for row in res
+    ]
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=1_000_000)
+    ap.add_argument("--dim", type=int, default=2048)
+    ap.add_argument("--chunk", type=int, default=8192)
+    ap.add_argument("--dir", default="/tmp/kakveda-rehearsal")
+    ap.add_argument("--mine", action="store_true", help="also run pattern mining (slow off-TPU)")
+    args = ap.parse_args()
+
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+
+    from kakveda_tpu.index.gfkb import GFKB
+
+    root = Path(args.dir)
+    root.mkdir(parents=True, exist_ok=True)
+    data = root / "data"
+    out: dict = {"n": args.n, "dim": args.dim, "backend": jax.default_backend()}
+
+    # --- build + snapshot ------------------------------------------------
+    g = GFKB(data_dir=data, capacity=args.n + args.chunk, dim=args.dim)
+    if g.count < args.n:
+        out["ingest_s"] = round(build(g, args.n, args.chunk), 1)
+        print(f"rehearsal: built {g.count:,} rows in {out['ingest_s']}s", file=sys.stderr)
+    t0 = time.time()
+    g.snapshot()
+    out["snapshot_s"] = round(time.time() - t0, 1)
+    baseline = probe_match(g, args.n)
+    n_built = g.count
+    g.close()
+    del g
+
+    # --- restore from snapshot ------------------------------------------
+    t0 = time.time()
+    g_restored = GFKB(data_dir=data, capacity=args.n + args.chunk, dim=args.dim)
+    out["restore_s"] = round(time.time() - t0, 1)
+    assert g_restored.count == n_built, (g_restored.count, n_built)
+    restored = probe_match(g_restored, args.n)
+
+    # --- full replay (snapshot hidden: same log, no vectors) -------------
+    snap = data / "snapshot"
+    hidden = data / ".snapshot-hidden"
+    if snap.exists():
+        snap.rename(hidden)
+    try:
+        t0 = time.time()
+        g_replayed = GFKB(data_dir=data, capacity=args.n + args.chunk, dim=args.dim)
+        out["replay_s"] = round(time.time() - t0, 1)
+        assert g_replayed.count == n_built
+        replayed = probe_match(g_replayed, args.n)
+    finally:
+        if hidden.exists():
+            hidden.rename(snap)
+
+    # --- parity: restore == replay == pre-snapshot ------------------------
+    ids = lambda res: [[fid for fid, _ in row] for row in res]  # noqa: E731
+    out["parity_ids"] = ids(restored) == ids(replayed) == ids(baseline)
+    # Scores: restored vectors round-trip through f32 disk + device store;
+    # replayed re-embed from text. Same featurizer ⇒ tight agreement.
+    score_gap = max(
+        (abs(a - b) for ra, rb in zip(restored, replayed) for (_, a), (_, b) in zip(ra, rb)),
+        default=0.0,
+    )
+    out["max_score_gap"] = round(score_gap, 6)
+    out["restore_vs_replay_speedup"] = (
+        round(out["replay_s"] / out["restore_s"], 2) if out["restore_s"] else 0.0
+    )
+
+    if args.mine:
+        from kakveda_tpu.pipeline.patterns import PatternDetector
+
+        t0 = time.time()
+        pats = PatternDetector(g_restored).mine_patterns()
+        out["mine_s"] = round(time.time() - t0, 1)
+        out["mine_patterns"] = len(pats)
+
+    g_restored.close()
+    print(json.dumps(out))
+    return 0 if out["parity_ids"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
