@@ -52,6 +52,7 @@ vector threads through the chunk body; greedy slots stay exact).
 
 from __future__ import annotations
 
+import os
 import queue
 import threading
 from concurrent.futures import Future
@@ -323,11 +324,22 @@ class ContinuousBatcher:
         self.slots[slot] = _Slot(req_id=rid, prompt_len=bucket, max_new=max_new_tokens)
         return rid
 
-    def step(self) -> List[int]:
-        """One decode chunk for every active slot; returns req_ids finished
-        in this chunk (their token lists land in ``results``)."""
+    def step_async(self):
+        """Dispatch one decode chunk WITHOUT fetching its tokens; returns a
+        handle for :meth:`process_chunk` (or None when no slot is active).
+
+        This is the pipelining half of ``step()``: on remote-attached
+        chips the per-chunk token fetch pays a fixed wire RTT that can
+        exceed the chunk's compute, so an engine that dispatches chunk
+        i+1 before processing chunk i's tokens overlaps that RTT with
+        device work. Retirement (EOS / max_new) is then detected one
+        chunk late; the overshoot chunk wastes compute but cannot corrupt
+        state — cache writes clamp at the window (``mode="drop"``), each
+        slot attends only within its own cache row, and the overshoot
+        tokens are discarded host-side — so outputs are token-identical
+        to the unpipelined path."""
         if not self.slots:
-            return []
+            return None
         # Grow validity on the host mirror (vectorized over slots): each
         # active slot may read its next chunk of rows as it writes them
         # (enforced per-step by step_valid inside the chunk program). The
@@ -346,12 +358,28 @@ class ContinuousBatcher:
             jnp.asarray(self._temp_np.copy()), self.rng, self.chunk_steps,
         )
         self._pos_np += self.chunk_steps  # every slot advances in lockstep
+        try:
+            toks.copy_to_host_async()
+        except Exception:  # noqa: BLE001 — backends without async copy
+            pass
+        # Slot refs (shared, not copied): a slot retired by an EARLIER
+        # handle's processing shows st.done here and its overshoot tokens
+        # are skipped. Slots are only freed/re-admitted in process_chunk,
+        # so a handle's snapshot can never alias a newer request.
+        return toks, dict(self.slots)
+
+    def process_chunk(self, handle) -> List[int]:
+        """Fetch a dispatched chunk's tokens and retire finished slots;
+        returns req_ids completed by that chunk."""
+        if handle is None:
+            return []
+        toks, snapshot = handle
         toks_h = np.asarray(toks)
         finished = []
-        for slot, st in list(self.slots.items()):
+        for slot, st in snapshot.items():
+            if st.done:
+                continue  # retired by an earlier chunk; these are overshoot tokens
             for t in toks_h[slot]:
-                if st.done:
-                    break
                 t = int(t)
                 if self.eos_id is not None and t == self.eos_id:
                     st.done = True
@@ -359,6 +387,7 @@ class ContinuousBatcher:
                 st.out.append(t)
                 if len(st.out) >= st.max_new or st.prompt_len + len(st.out) + 1 >= self.max_len:
                     st.done = True
+                    break
             if st.done:
                 self.results[st.req_id] = st.out
                 finished.append(st.req_id)
@@ -366,6 +395,11 @@ class ContinuousBatcher:
                 self.free.append(slot)
                 self._kv_np[slot] = False
         return finished
+
+    def step(self) -> List[int]:
+        """One decode chunk for every active slot; returns req_ids finished
+        in this chunk (their token lists land in ``results``)."""
+        return self.process_chunk(self.step_async())
 
     def run_all(self, prompts: List[List[int]], max_new_tokens: int = 64) -> List[List[int]]:
         """Drain a whole request list through the slot pool (admitting as
@@ -499,9 +533,19 @@ class ServingEngine:
         self._pend[rid] = fut
 
     def _loop(self) -> None:
+        # Chunk pipelining (KAKVEDA_SERVE_PIPELINE=0 opts out): dispatch
+        # chunk i+1 BEFORE fetching chunk i's tokens, so the fixed
+        # device→host RTT of each token fetch (~70-90 ms on tunneled TPUs,
+        # often > the chunk's compute) overlaps the next chunk's device
+        # work — per-chunk cost drops from compute+RTT to max(compute,
+        # RTT). Outputs are token-identical (see step_async); the cost is
+        # retirement lag: a finished slot frees one chunk later, and one
+        # overshoot chunk runs at the end of each busy period.
+        pipelined = os.environ.get("KAKVEDA_SERVE_PIPELINE", "1") != "0"
+        pending_handle = None
         try:
             while not self._closed.is_set():
-                if not self.cb.slots:
+                if not self.cb.slots and pending_handle is None:
                     # Idle: block for the next request (bounded so close()
                     # is prompt) instead of spinning on an empty pool.
                     try:
@@ -515,11 +559,17 @@ class ServingEngine:
                         self._admit_one(self._q.get_nowait())
                     except queue.Empty:
                         break
-                if not self.cb.slots:
-                    continue
-                self.stats["max_active"] = max(self.stats["max_active"], self.cb.active)
-                finished = self.cb.step()
-                self.stats["chunks"] += 1
+                if self.cb.slots:
+                    self.stats["max_active"] = max(self.stats["max_active"], self.cb.active)
+                    handle = self.cb.step_async()
+                    self.stats["chunks"] += 1
+                else:
+                    handle = None
+                if not pipelined:
+                    finished = self.cb.process_chunk(handle)
+                else:
+                    finished = self.cb.process_chunk(pending_handle)
+                    pending_handle = handle
                 for rid in finished:
                     self.stats["completed"] += 1
                     fut = self._pend.pop(rid, None)

@@ -227,7 +227,7 @@ def test_serving_engine_loop_death_fails_futures_not_hangs():
     def boom():
         raise RuntimeError("synthetic device error")
 
-    eng.cb.step = boom  # next chunk kills the loop
+    eng.cb.step_async = boom  # next chunk dispatch kills the loop
     fut = eng.submit([5, 6, 7], max_new_tokens=8)
     with pytest.raises(RuntimeError, match="loop died"):
         fut.result(timeout=30)
@@ -276,3 +276,61 @@ def test_chunked_prefill_env_serving_path(monkeypatch):
     assert _prefill_width(10, 512) == 10
     assert _prefill_width(513, 512) == 1024
     assert _prefill_width(27, 8) == 32
+
+
+def test_engine_chunk_pipelining_parity(monkeypatch):
+    """Pipelined chunk dispatch (dispatch i+1 before fetching i's tokens —
+    the remote-RTT overlap lever) must be token-identical to the
+    unpipelined engine AND to solo decodes, across retirement lag, slot
+    reuse, varied lengths, and EOS mid-chunk."""
+    from kakveda_tpu.models.serving import ServingEngine
+
+    params = init_params(jax.random.PRNGKey(2), CFG)
+    prompts = [[5, 6, 7], [10, 11, 12, 13, 14], [42], [9, 8], [100, 101], [7, 7, 7]]
+    budgets = [3, 10, 7, 1, 12, 5]  # mixed lengths force staggered retirement
+    solo = [
+        generate_tokens(params, CFG, p, max_new_tokens=m, max_len=64)
+        for p, m in zip(prompts, budgets)
+    ]
+
+    def run(pipeline: str):
+        monkeypatch.setenv("KAKVEDA_SERVE_PIPELINE", pipeline)
+        # 2 slots for 6 requests: constant churn, so retirement lag and
+        # admission delay are both exercised.
+        eng = ServingEngine(params, CFG, batch_slots=2, max_len=64, chunk_steps=4)
+        try:
+            futs = [
+                eng.submit(p, max_new_tokens=m) for p, m in zip(prompts, budgets)
+            ]
+            return [f.result(timeout=120) for f in futs]
+        finally:
+            eng.close()
+
+    assert run("0") == solo
+    assert run("1") == solo
+
+
+def test_engine_pipelining_with_eos(monkeypatch):
+    """EOS stopping under pipelining: the overshoot chunk's post-EOS tokens
+    must be discarded, matching the unpipelined engine exactly."""
+    from kakveda_tpu.models.serving import ServingEngine
+
+    params = init_params(jax.random.PRNGKey(3), CFG)
+    prompts = [[5, 6, 7, 8], [50, 51], [42, 43, 44]]
+    # Pick each prompt's own 3rd greedy token as its EOS so stopping
+    # happens mid-stream at different steps per slot.
+    solo_full = [generate_tokens(params, CFG, p, max_new_tokens=12, max_len=64) for p in prompts]
+
+    def run(pipeline: str, eos_id):
+        monkeypatch.setenv("KAKVEDA_SERVE_PIPELINE", pipeline)
+        eng = ServingEngine(
+            params, CFG, batch_slots=3, max_len=64, chunk_steps=4, eos_id=eos_id
+        )
+        try:
+            futs = [eng.submit(p, max_new_tokens=12) for p in prompts]
+            return [f.result(timeout=120) for f in futs]
+        finally:
+            eng.close()
+
+    eos = solo_full[0][2]  # slot 0 stops at step 3; others wherever it appears
+    assert run("1", eos) == run("0", eos)
