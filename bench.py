@@ -1221,8 +1221,18 @@ def _bench_serve(backend: str) -> dict:
     preset = os.environ.get("KAKVEDA_BENCH_DECODE_PRESET", "1b" if _on_tpu(backend) else "tiny")
     n_clients = int(os.environ.get("KAKVEDA_BENCH_SERVE_CLIENTS", 16))
     reqs_per = int(os.environ.get("KAKVEDA_BENCH_SERVE_REQS", 2))
+    import jax
+    import jax.numpy as jnp
+
+    from kakveda_tpu.models.llama import init_params
+
     cfg = _preset_cfg(preset)
-    rt = LlamaRuntime(cfg=cfg, seed=0)
+    # bf16 weights, like the decode bench: serving streams weights every
+    # step, and f32 random-init params would double that stream.
+    params = jax.tree.map(
+        lambda x: x.astype(jnp.bfloat16), init_params(jax.random.PRNGKey(0), cfg)
+    )
+    rt = LlamaRuntime(cfg=cfg, params=params, seed=0)
     tmp = Path(tempfile.mkdtemp(prefix="kakveda-bench-serve-"))
     plat = Platform(data_dir=tmp / "data", capacity=1 << 14, dim=2048)
     dash = make_dashboard_app(platform=plat, db_path=tmp / "dash.db", model=rt)
