@@ -48,6 +48,14 @@ class IngestRequest(BaseModel):
     trace: TracePayload
 
 
+class IngestBatchRequest(BaseModel):
+    """Batched ingest: the 10k-traces/sec HTTP surface. The reference only
+    has per-trace POSTs (services/ingestion/app.py:15-21); per-trace HTTP
+    framing caps throughput far below the device pipeline's rate."""
+
+    traces: List[TracePayload]
+
+
 class FailureSignal(BaseModel):
     """Classifier verdict for a single trace."""
 

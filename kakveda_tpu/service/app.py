@@ -31,6 +31,7 @@ from pydantic import ValidationError
 from kakveda_tpu.core.runtime import ensure_request_id, get_runtime_config
 from kakveda_tpu.core.schemas import (
     FailureMatchRequest,
+    IngestBatchRequest,
     IngestRequest,
     Severity,
     WarningRequest,
@@ -113,6 +114,22 @@ def make_app(platform: Optional[Platform] = None, **platform_kw) -> web.Applicat
             return _json_error(422, str(e))
         await plat.ingest(req.trace)
         return web.json_response({"ok": True, "trace_id": req.trace.trace_id})
+
+    async def ingest_batch(request):
+        """Batched ingest — one validate + one device scatter per batch
+        (kakveda_tpu.platform.Platform.ingest_batch), the rate the
+        streaming pipeline actually sustains. Returns per-batch failure
+        count so callers can track detection rates without a second call."""
+        try:
+            req = IngestBatchRequest.model_validate(await request.json())
+        except (ValidationError, ValueError) as e:
+            return _json_error(422, str(e))
+        if not req.traces:
+            return web.json_response({"ok": True, "n": 0, "failures": 0})
+        signals = await plat.ingest_batch(req.traces)
+        return web.json_response(
+            {"ok": True, "n": len(req.traces), "failures": len(signals)}
+        )
 
     # --- warn (micro-batched) -------------------------------------------
 
@@ -252,6 +269,7 @@ def make_app(platform: Optional[Platform] = None, **platform_kw) -> web.Applicat
             web.get("/healthz", healthz),
             web.get("/readyz", readyz),
             web.post("/ingest", ingest),
+            web.post("/ingest/batch", ingest_batch),
             web.post("/warn", warn),
             web.get("/failures", list_failures),
             web.post("/failures/match", match),

@@ -307,3 +307,36 @@ def test_dashboard_bus_subscriptions(tmp_path):
             await c.close()
 
     asyncio.run(go())
+
+
+def test_ingest_batch_endpoint(tmp_path):
+    """POST /ingest/batch: one validate + one device scatter per batch —
+    the HTTP surface of the 10k traces/sec pipeline (the reference only
+    has per-trace POSTs, services/ingestion/app.py:15-21). Failures found
+    in the batch land in the GFKB and the count comes back."""
+
+    async def go():
+        plat = Platform(data_dir=tmp_path / "data", capacity=256, dim=1024)
+        app = make_app(platform=plat)
+
+        async def fn(client):
+            traces = [
+                _trace("app-b", f"Summarize doc {i} and include citations even if not provided.")
+                for i in range(8)
+            ]
+            r = await client.post("/ingest/batch", json={"traces": traces})
+            body = await r.json()
+            assert r.status == 200, body
+            assert body["ok"] is True and body["n"] == 8
+            assert body["failures"] >= 1  # citation-bait prompts classify as failures
+            assert plat.gfkb.count >= 1
+            # empty batch: no-op, still ok
+            r = await client.post("/ingest/batch", json={"traces": []})
+            assert (await r.json()) == {"ok": True, "n": 0, "failures": 0}
+            # malformed: 422, not a 500
+            r = await client.post("/ingest/batch", json={"traces": [{"bad": 1}]})
+            assert r.status == 422
+
+        await _with_client(app, fn)
+
+    run(go())
