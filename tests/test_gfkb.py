@@ -408,3 +408,43 @@ def test_reopen_after_log_outgrows_capacity(tmp_path):
     m = kb2.match(_sig("grown record 17 topic 187"), failure_type="T2", type_filter="pre")
     assert m and m[0].score > 0.9 and m[0].failure_type == "T2"
     kb2.close()
+
+
+def test_snapshot_v2_sparse_files_and_corruption_fallback(tmp_path):
+    """v2 snapshots persist sparse (idx, val) pairs — no dense matrix on
+    disk — and ANY corruption of them (truncated array, wrong dtype,
+    missing file) falls back to full replay with identical results."""
+    import numpy as np
+
+    gfkb = GFKB(data_dir=tmp_path, capacity=256, dim=1024)
+    _seed(gfkb, 40)
+    sd = gfkb.snapshot()
+    pre = gfkb.match("intent:citations_required | doc 7 references")
+    gfkb.close()
+
+    assert (sd / "sparse_idx.npy").exists() and (sd / "sparse_val.npy").exists()
+    assert not (sd / "vectors.npy").exists()
+    idx = np.load(sd / "sparse_idx.npy")
+    assert idx.dtype == np.int32 and idx.shape[0] == 40
+
+    def reopen():
+        g = GFKB(data_dir=tmp_path, capacity=256, dim=1024)
+        try:
+            assert g.count == 40
+            assert g.match("intent:citations_required | doc 7 references")[0].failure_id \
+                == pre[0].failure_id
+        finally:
+            g.close()
+
+    # healthy restore
+    reopen()
+    # truncated rows -> shape mismatch -> full replay
+    np.save(sd / "sparse_idx.npy", idx[:10])
+    reopen()
+    np.save(sd / "sparse_idx.npy", idx)
+    # wrong dtype -> full replay
+    np.save(sd / "sparse_val.npy", np.zeros((40, idx.shape[1]), np.float64))
+    reopen()
+    # missing file -> full replay
+    (sd / "sparse_val.npy").unlink()
+    reopen()
