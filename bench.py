@@ -1278,11 +1278,6 @@ def _bench_serve(backend: str) -> dict:
     params = jax.tree.map(
         lambda x: x.astype(jnp.bfloat16), init_params(jax.random.PRNGKey(0), cfg)
     )
-    rt = LlamaRuntime(cfg=cfg, params=params, seed=0)
-    tmp = Path(tempfile.mkdtemp(prefix="kakveda-bench-serve-"))
-    plat = Platform(data_dir=tmp / "data", capacity=1 << 14, dim=2048)
-    dash = make_dashboard_app(platform=plat, db_path=tmp / "dash.db", model=rt)
-    svc = make_service_app(platform=plat)
 
     rng = np.random.default_rng(0)
     prompts = [
@@ -1291,100 +1286,135 @@ def _bench_serve(backend: str) -> dict:
         ))
         for i in range(n_clients)
     ]
-    lat_play: list = []
-    lat_warn: list = []
-    stop = asyncio.Event()
 
-    async def go():
-        server = TestServer(dash)
-        await server.start_server()
-        svc_server = TestServer(svc)
-        await svc_server.start_server()
-        clients = [TestClient(server) for _ in range(n_clients)]
-        svc_client = TestClient(svc_server)
-        t_wall = 0.0
-        try:
-            for c in clients:
-                await c.start_server()
-                r = await c.post(
-                    "/login",
-                    data={"email": "admin@local", "password": "admin123", "next": "/"},
-                    allow_redirects=False,
+    def run_workload(pipeline: str) -> dict:
+        """One full concurrent-HTTP round at the given pipelining setting
+        (fresh runtime + apps, so the engine thread reads the env)."""
+        os.environ["KAKVEDA_SERVE_PIPELINE"] = pipeline
+        rt = LlamaRuntime(cfg=cfg, params=params, seed=0)
+        tmp = Path(tempfile.mkdtemp(prefix="kakveda-bench-serve-"))
+        plat = Platform(data_dir=tmp / "data", capacity=1 << 14, dim=2048)
+        dash = make_dashboard_app(platform=plat, db_path=tmp / "dash.db", model=rt)
+        svc = make_service_app(platform=plat)
+        lat_play: list = []
+        lat_warn: list = []
+        stop = asyncio.Event()
+
+        async def go():
+            server = TestServer(dash)
+            await server.start_server()
+            svc_server = TestServer(svc)
+            await svc_server.start_server()
+            clients = [TestClient(server) for _ in range(n_clients)]
+            svc_client = TestClient(svc_server)
+            t_wall = 0.0
+            try:
+                for c in clients:
+                    await c.start_server()
+                    r = await c.post(
+                        "/login",
+                        data={"email": "admin@local", "password": "admin123", "next": "/"},
+                        allow_redirects=False,
+                    )
+                    assert r.status == 302
+                await svc_client.start_server()
+                # Warm both compiled paths off-clock (engine decode + warn match).
+                await clients[0].post(
+                    "/playground/run", data={"prompt": "warm up", "target": "model"}
                 )
-                assert r.status == 302
-            await svc_client.start_server()
-            # Warm both compiled paths off-clock (engine decode + warn match).
-            await clients[0].post(
-                "/playground/run", data={"prompt": "warm up", "target": "model"}
-            )
-            await svc_client.post("/warn", json={"app_id": "warm", "prompt": "warm"})
+                await svc_client.post("/warn", json={"app_id": "warm", "prompt": "warm"})
 
-            async def play_worker(client, prompt):
-                for _ in range(reqs_per):
-                    t0 = time.perf_counter()
-                    r = await client.post(
-                        "/playground/run", data={"prompt": prompt, "target": "model"}
-                    )
-                    await r.text()
-                    lat_play.append(time.perf_counter() - t0)
-                    assert r.status == 200
+                async def play_worker(client, prompt):
+                    for _ in range(reqs_per):
+                        t0 = time.perf_counter()
+                        r = await client.post(
+                            "/playground/run", data={"prompt": prompt, "target": "model"}
+                        )
+                        await r.text()
+                        lat_play.append(time.perf_counter() - t0)
+                        assert r.status == 200
 
-            async def warn_worker():
-                i = 0
-                while not stop.is_set():
-                    t0 = time.perf_counter()
-                    r = await svc_client.post(
-                        "/warn",
-                        json={"app_id": "bench", "prompt": f"Cite sources for claim {i}."},
-                    )
-                    await r.json()
-                    lat_warn.append(time.perf_counter() - t0)
-                    assert r.status == 200
-                    i += 1
-                    await asyncio.sleep(0.02)
+                async def warn_worker():
+                    i = 0
+                    while not stop.is_set():
+                        t0 = time.perf_counter()
+                        r = await svc_client.post(
+                            "/warn",
+                            json={"app_id": "bench", "prompt": f"Cite sources for claim {i}."},
+                        )
+                        await r.json()
+                        lat_warn.append(time.perf_counter() - t0)
+                        assert r.status == 200
+                        i += 1
+                        await asyncio.sleep(0.02)
 
-            wt = asyncio.create_task(warn_worker())
-            t0 = time.perf_counter()
-            await asyncio.gather(*(play_worker(c, p) for c, p in zip(clients, prompts)))
-            t_wall = time.perf_counter() - t0
-            stop.set()
-            await wt
-        finally:
-            for c in clients:
-                await c.close()
-            await svc_client.close()
-        return t_wall
+                wt = asyncio.create_task(warn_worker())
+                t0 = time.perf_counter()
+                await asyncio.gather(*(play_worker(c, p) for c, p in zip(clients, prompts)))
+                t_wall = time.perf_counter() - t0
+                stop.set()
+                await wt
+            finally:
+                for c in clients:
+                    await c.close()
+                await svc_client.close()
+            return t_wall
 
-    wall = asyncio.run(go())
-    if rt._engine is not None:
-        completed = rt._engine.stats["completed"]
-        rt._engine.close()
-    else:
+        wall = asyncio.run(go())
         completed = 0
-    p50, p95 = (float(x) for x in np.percentile(lat_play, [50, 95]))
-    p95w = float(np.percentile(lat_warn, 95)) if lat_warn else 0.0
-    n_reqs = len(lat_play)
-    tok_s = n_reqs * 64 / wall if wall > 0 else 0.0  # generate() default max_tokens
-    seq_est = float(np.sum(lat_play))
+        if rt._engine is not None:
+            completed = rt._engine.stats["completed"]
+            rt._engine.close()
+        p50, p95 = (float(x) for x in np.percentile(lat_play, [50, 95]))
+        return {
+            "wall": wall,
+            "p50": p50,
+            "p95": p95,
+            "p95_warn": float(np.percentile(lat_warn, 95)) if lat_warn else 0.0,
+            "n_warns": len(lat_warn),
+            "n_reqs": len(lat_play),
+            "seq_est": float(np.sum(lat_play)),
+            "completed": completed,
+        }
+
+    prev_env = os.environ.get("KAKVEDA_SERVE_PIPELINE")
+    try:
+        # A/B the chunk-pipelining lever (dispatch chunk i+1 before fetching
+        # chunk i — hides the per-chunk fetch RTT, the dominant per-chunk
+        # cost on remote-attached chips). Unpipelined first so the
+        # pipelined run (the headline) runs on the warmer process.
+        base = run_workload("0")
+        piped = run_workload("1")
+    finally:
+        if prev_env is None:
+            os.environ.pop("KAKVEDA_SERVE_PIPELINE", None)
+        else:
+            os.environ["KAKVEDA_SERVE_PIPELINE"] = prev_env
+
+    r = piped
+    tok_s = r["n_reqs"] * 64 / r["wall"] if r["wall"] > 0 else 0.0  # generate() default max_tokens
     print(
         f"bench[serve]: {n_clients} clients × {reqs_per} reqs ({preset}) — "
-        f"p50 {p50*1000:.0f} ms, p95 {p95*1000:.0f} ms, {tok_s:,.0f} tok/s agg, "
-        f"warn p95 under load {p95w*1000:.1f} ms ({len(lat_warn)} warns), "
-        f"concurrency speedup {seq_est/wall:.1f}x",
+        f"p50 {r['p50']*1000:.0f} ms, p95 {r['p95']*1000:.0f} ms, {tok_s:,.0f} tok/s agg, "
+        f"warn p95 under load {r['p95_warn']*1000:.1f} ms ({r['n_warns']} warns), "
+        f"concurrency speedup {r['seq_est']/r['wall']:.1f}x | unpipelined p95 "
+        f"{base['p95']*1000:.0f} ms (pipeline gain {base['p95']/max(r['p95'],1e-9):.2f}x)",
         file=sys.stderr,
     )
     return {
         "metric": "serve_http_p95_ms_concurrent",
-        "value": round(p95 * 1000, 1),
+        "value": round(r["p95"] * 1000, 1),
         "unit": "ms",
-        "vs_baseline": round(seq_est / wall, 2) if wall > 0 else 0.0,
+        "vs_baseline": round(r["seq_est"] / r["wall"], 2) if r["wall"] > 0 else 0.0,
         "clients": n_clients,
-        "requests": n_reqs,
-        "p50_ms": round(p50 * 1000, 1),
+        "requests": r["n_reqs"],
+        "p50_ms": round(r["p50"] * 1000, 1),
         "agg_tokens_per_sec": round(tok_s, 1),
-        "warn_p95_ms_under_load": round(p95w * 1000, 2),
-        "engine_completed": completed,
+        "warn_p95_ms_under_load": round(r["p95_warn"] * 1000, 2),
+        "engine_completed": r["completed"],
         "preset": preset,
+        "unpipelined_p95_ms": round(base["p95"] * 1000, 1),
+        "pipeline_p95_gain": round(base["p95"] / max(r["p95"], 1e-9), 2),
     }
 
 
