@@ -1,0 +1,49 @@
+"""Fast TPU availability probe for the chip-up playbook.
+
+Tries jax.default_backend() in a daemon thread with a short timeout.
+Exit 0 iff a real accelerator backend ("tpu"/"axon") came up within the
+window; exit 1 on raise (UNAVAILABLE outage) or block (wedged lease —
+the claim thread is left running and dies with the process; we never
+signal it, per the lease-wedging gotcha in CLAUDE.md).
+
+Usage: python .probe/check_tpu.py [timeout_seconds]
+"""
+
+import sys
+import threading
+
+TIMEOUT = float(sys.argv[1]) if len(sys.argv) > 1 else 120.0
+
+box: dict = {}
+
+
+def _init() -> None:
+    try:
+        import jax
+
+        box["backend"] = jax.default_backend()
+        # A claim that returns a CPU backend means the accelerator plugin
+        # is absent, not that the chip is up.
+        if box["backend"] in ("tpu", "axon"):
+            import jax.numpy as jnp
+
+            # One tiny dispatch proves the runtime executes, not just inits.
+            box["ok"] = float(jnp.ones((4,)).sum())
+    except Exception as e:  # noqa: BLE001 — any failure = chip down
+        box["error"] = e
+
+
+t = threading.Thread(target=_init, daemon=True)
+t.start()
+t.join(TIMEOUT)
+
+if "ok" in box:
+    print(f"UP backend={box['backend']}")
+    sys.exit(0)
+if "error" in box:
+    print(f"DOWN error={type(box['error']).__name__}: {box['error']}"[:300])
+elif "backend" in box:
+    print(f"DOWN backend={box['backend']} (no accelerator)")
+else:
+    print(f"DOWN blocked>{TIMEOUT:.0f}s (claim loop still waiting)")
+sys.exit(1)

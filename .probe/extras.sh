@@ -1,0 +1,36 @@
+#!/usr/bin/env bash
+# Chip-up extras: the hardware measurements beyond the driver sweep that
+# docs/performance.md cites. Each mirrors ONE JSON line into tracked
+# artifacts/ and commits. Run only after a full bench sweep succeeded
+# (monitor.sh calls this; safe to re-run by hand).
+set -u
+cd "$(dirname "$0")/.." || exit 1
+LOG=.probe/monitor.log
+log() { echo "[$(date -u +%FT%TZ)] extras: $*" >>"$LOG"; }
+
+run_metric() {
+    local name="$1" out="$2"; shift 2
+    log "running $name → $out"
+    if env "$@" python bench.py >"$out.tmp" 2>>.probe/extras_$name.log \
+        && ! grep -q chip_unavailable "$out.tmp"; then
+        mv "$out.tmp" "$out"
+        for _ in 1 2 3 4 5; do
+            git add "$out" 2>>"$LOG" && git commit -m "Hardware measurement: $name" -- "$out" >>"$LOG" 2>&1 && break
+            sleep 15
+        done
+        log "$name done: $(head -c 200 "$out")"
+    else
+        log "$name FAILED (see .probe/extras_$name.log)"
+        rm -f "$out.tmp"
+    fi
+}
+
+run_metric mine_1m artifacts/mine_1m.json \
+    KAKVEDA_BENCH_METRIC=mine KAKVEDA_BENCH_MINE_N=1000000
+run_metric warn_realemb artifacts/warn_realemb.json \
+    KAKVEDA_BENCH_METRIC=warn KAKVEDA_BENCH_REAL_EMB=1
+run_metric decode_curve artifacts/decode_curve.json \
+    KAKVEDA_BENCH_METRIC=decode
+run_metric serve artifacts/serve_http.json \
+    KAKVEDA_BENCH_METRIC=serve
+log "extras pass complete"

@@ -1,0 +1,14 @@
+#!/usr/bin/env bash
+# Entry point: start the detached chip-up monitor (idempotent — refuses to
+# double-start). Status: cat .probe/status ; log: tail .probe/monitor.log
+set -u
+cd "$(dirname "$0")/.." || exit 1
+PIDFILE=.probe/monitor.pid
+if [ -f "$PIDFILE" ] && kill -0 "$(cat "$PIDFILE")" 2>/dev/null; then
+    echo "monitor already running (pid $(cat "$PIDFILE")): $(cat .probe/status 2>/dev/null)"
+    exit 0
+fi
+nohup bash .probe/monitor.sh >/dev/null 2>&1 &
+echo $! >"$PIDFILE"
+disown
+echo "monitor started (pid $(cat "$PIDFILE")); status → .probe/status, log → .probe/monitor.log"
