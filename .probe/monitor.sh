@@ -41,6 +41,14 @@ set_status "probing"
 
 attempt=0
 while true; do
+    # Kill-switch: `touch .probe/stop` disarms the loop without signaling
+    # any process (the driver's own end-of-round bench must never find the
+    # chip held by a monitor attempt).
+    if [ -f "$PROBE_DIR/stop" ]; then
+        log "stop file present — monitor exiting"
+        set_status "STOPPED by .probe/stop at $(date -u +%FT%TZ)"
+        exit 0
+    fi
     attempt=$((attempt + 1))
     if python "$PROBE_DIR/check_tpu.py" "$PROBE_TIMEOUT" >>"$LOG" 2>&1; then
         log "probe #$attempt: chip UP — starting full bench sweep"
@@ -58,9 +66,9 @@ while true; do
             commit_paths "Hardware bench sweep captured by chip-up monitor" artifacts/bench_tpu_sweep.json
             set_status "extras-running since $(date -u +%FT%TZ)"
             bash "$PROBE_DIR/extras.sh" >>"$LOG" 2>&1
-            set_status "DONE sweep+extras at $(date -u +%FT%TZ) (monitor idle-probing)"
-            log "sweep + extras complete; dropping to slow idle probe"
-            SLEEP_DOWN=1800
+            set_status "DONE sweep+extras at $(date -u +%FT%TZ) (monitor exited)"
+            log "sweep + extras complete; monitor exiting (chip free for the driver)"
+            exit 0
         else
             set_status "probing (last attempt: bench wedged/outage at $(date -u +%FT%TZ))"
             log "bench did not complete (outage mid-run?); partial preserved, will retry"
