@@ -1549,6 +1549,13 @@ def load_resumable_partial(partial_path: str, backend: str) -> dict:
     except (OSError, ValueError) as e:
         print(f"bench: resume load failed ({e}); fresh run", file=sys.stderr)
         return {}
+    if prior.get("complete"):
+        print(
+            "bench: partial file records a finished sweep; re-measuring fresh "
+            "(complete partials are outage evidence, not resume state)",
+            file=sys.stderr,
+        )
+        return {}
     age = time.time() - float(prior.get("ts", 0))
     if prior.get("backend") != backend:
         print(
@@ -1757,10 +1764,18 @@ def main() -> int:
     if not results:
         return 1
     if partial_path and all(fn.__name__ in done for fn in order):
-        # Fully successful sweep: retire the partial so a later resume run
-        # cannot replay these numbers as if freshly measured.
+        # Fully successful sweep: mark the partial complete instead of
+        # deleting it. A complete partial is never resumed from (so a later
+        # run with a live chip re-measures everything fresh), but if that
+        # later run hits an outage, its chip_unavailable line still carries
+        # these numbers as evidence of the last full sweep.
         try:
-            os.remove(partial_path)
+            tmp = partial_path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(
+                    {"backend": backend, "ts": time.time(), "done": done, "complete": True}, f
+                )
+            os.replace(tmp, partial_path)
         except OSError:
             pass
     headline = results[0]

@@ -93,3 +93,31 @@ def test_resume_partial_policy(tmp_path, monkeypatch):
     # Missing file: empty, no error.
     monkeypatch.delenv("KAKVEDA_BENCH_RESUME")
     assert bench.load_resumable_partial(str(tmp_path / "nope.json"), "cpu") == {}
+    # Complete partial (a finished sweep): never resumed from — a live-chip
+    # run must re-measure fresh — but it stays on disk as outage evidence.
+    done_sweep = dict(fresh, complete=True)
+    p.write_text(json.dumps(done_sweep))
+    assert bench.load_resumable_partial(str(p), "cpu") == {}
+
+
+def test_outage_carries_complete_sweep_evidence(tmp_path):
+    """A chip-down run that follows a fully successful sweep must surface the
+    finished sweep's numbers in its chip_unavailable line (the round-4
+    failure mode: success → partial deleted → later outage had nothing)."""
+    partial = tmp_path / "partial.json"
+    partial.write_text(
+        json.dumps(
+            {
+                "backend": "axon",
+                "ts": time.time(),
+                "done": {"_bench_warn": {"metric": "warn_p50_ms", "value": 0.21}},
+                "complete": True,
+            }
+        )
+    )
+    proc = _run_bench(tmp_path, {"JAX_PLATFORMS": "nonexistent"})
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["chip_unavailable"] is True
+    assert out["partial"]["complete"] is True
+    assert out["partial"]["done"]["_bench_warn"]["value"] == 0.21
