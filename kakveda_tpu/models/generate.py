@@ -663,6 +663,21 @@ class LlamaRuntime:
                         return None
         return self._engine
 
+    def register_prefix(self, prefix: str) -> bool:
+        """Precompute a shared prompt prefix (system preamble, judge
+        template) on the serving engine so every later request that starts
+        with it prefills only its suffix. No-op (False) when the engine is
+        disabled or the prefix is unsuitable (see
+        ContinuousBatcher.register_prefix)."""
+        eng = self.engine()
+        if eng is None:
+            return False
+        ids = self.tokenizer.encode(prefix)
+        try:
+            return eng.register_prefix(ids)
+        except Exception:  # noqa: BLE001 — a failed registration must not break serving
+            return False
+
     def serving_stats(self) -> dict:
         """Ops snapshot for the admin serving panel — engine pool state
         (without constructing one: observability must not allocate a KV
@@ -676,6 +691,7 @@ class LlamaRuntime:
                 "slots": eng.cb.B,
                 "window": eng.cb.max_len,
                 "closed": eng._closed.is_set(),
+                "prefix": dict(eng.cb.prefix_stats),
             }
         return {
             "runtime": "tpu",

@@ -223,6 +223,62 @@ def _step_chunk_jit(params, cfg: LlamaConfig, cache, last, slot_pos, kv_valid, p
     return out, last, slot_pos, rng, toks.T  # [B, n_steps]
 
 
+@partial(jax.jit, static_argnames=("cfg",), donate_argnums=(2,))
+def _admit_prefix_jit(
+    params, cfg: LlamaConfig, cache, last, pfx, suffix, slot, kv_valid, pos_offset, write_pos
+):
+    """Prefill only ``suffix`` [1, S'] into batch slot ``slot``, reusing the
+    precomputed K/V rows of a shared prompt prefix (``pfx``: per-layer
+    [1, KV, plen, D] slabs from :meth:`ContinuousBatcher.register_prefix`).
+
+    Prefix K/V rows are position-INDEPENDENT of the slot layout: RoPE
+    rotates by logical position (cache index − pos_offset), and a prefix
+    token's logical position is its own index regardless of how much left
+    pad the admission bucket adds — so one registered slab serves every
+    bucket. The slab lands at [off, off+plen); the suffix chunk recomputes
+    rows from ``write_pos`` (= off + split point), overwriting the slab's
+    tail where the power-of-two suffix chunk overlaps it with identical
+    values. Attention over not-yet-written rows is causally masked exactly
+    as in chunked prefill.
+    """
+    b = last.shape[0]
+    max_len = cache["k"][0].shape[2]
+    off = pos_offset[slot]
+    scratch = init_cache(cfg, batch=1, max_len=max_len)
+    scratch["pos"] = write_pos
+    for key in ("k", "v") + (("ks", "vs") if cfg.kv_quant == "int8" else ()):
+        starts = (0, 0, off, 0) if pfx[key][0].ndim == 4 else (0, 0, off)
+        scratch[key] = [
+            jax.lax.dynamic_update_slice(sk, pk, starts)
+            for sk, pk in zip(scratch[key], pfx[key])
+        ]
+    logits, scratch = decode_step(
+        params, cfg, suffix, scratch,
+        kv_valid=kv_valid[slot][None],
+        pos_offset=pos_offset[slot][None],
+        last_only=True,
+    )
+    out = {"pos": cache["pos"]}
+    for key in ("k", "v") + (("ks", "vs") if cfg.kv_quant == "int8" else ()):
+        zeros = (0,) * (cache[key][0].ndim - 1)
+        out[key] = [
+            jax.lax.dynamic_update_slice(ck, sk, (slot, *zeros))
+            for ck, sk in zip(cache[key], scratch[key])
+        ]
+    nl = mask_pad_vocab(logits[:, -1, :], cfg)
+    last = jax.lax.dynamic_update_slice(last, nl, (slot, 0))
+    return out, last
+
+
+@dataclass
+class _Prefix:
+    """One registered shared prompt prefix: token ids + per-layer K/V slabs
+    ([1, KV, plen, D], int8 + scales when the cache is quantized)."""
+
+    ids: Tuple[int, ...]
+    kv: Dict[str, List[jax.Array]]
+
+
 @dataclass
 class _Slot:
     req_id: int
@@ -267,6 +323,8 @@ class ContinuousBatcher:
         self.free = list(range(batch_slots))
         self.results: Dict[int, List[int]] = {}
         self._next_id = 0
+        self._prefixes: Dict[Tuple[int, ...], _Prefix] = {}
+        self.prefix_stats = {"registered": 0, "hits": 0, "hit_tokens_saved": 0}
 
     @staticmethod
     def bucket_for(prompt_len: int, max_len: int) -> int:
@@ -278,6 +336,66 @@ class ContinuousBatcher:
         while bucket < prompt_len:
             bucket <<= 1
         return min(bucket, max_len - 1)
+
+    def register_prefix(self, prefix_ids: List[int]) -> bool:
+        """Precompute and retain the K/V rows of a shared prompt prefix so
+        later admissions prefill only their suffix (``_admit_prefix_jit``).
+
+        The natural users are the fixed instruction templates in front of
+        every LLM-judge call and the playground/eval system preamble — the
+        reference pays the full prompt on every Ollama hop
+        (services/dashboard/app.py:1182-1258); here the shared head of the
+        prompt costs its FLOPs once per process instead of once per request.
+
+        Returns False (no-op) when the prefix is too short to matter, too
+        long for the slot window, or the model's RoPE regime depends on the
+        final sequence length (Phi-3 longrope: a prefix computed at length
+        plen would rotate in a different regime than the full prompt —
+        reuse would be silently wrong, so it is refused).
+        """
+        ids = tuple(int(t) for t in prefix_ids)
+        if len(ids) < 8 or len(ids) + 9 >= self.max_len:
+            return False
+        if getattr(self.cfg, "rope_dim_factors_long", None):
+            return False
+        if ids in self._prefixes:
+            return True
+        scratch = init_cache(self.cfg, batch=1, max_len=len(ids))
+        _, scratch = decode_step(
+            self.params, self.cfg, jnp.asarray([list(ids)], jnp.int32), scratch,
+            last_only=True,
+        )
+        keys = ("k", "v") + (("ks", "vs") if self.cfg.kv_quant == "int8" else ())
+        self._prefixes[ids] = _Prefix(ids=ids, kv={k: scratch[k] for k in keys})
+        self.prefix_stats["registered"] += 1
+        return True
+
+    def _match_prefix(self, prompt_ids: List[int]):
+        """Longest registered prefix of ``prompt_ids`` plus the suffix-chunk
+        split: returns (entry, split, suffix_width) or None. The suffix
+        chunk is the power-of-two-wide tail the admission recomputes —
+        ``split = len(prompt) − suffix_width`` tokens come from the slab,
+        and the chunk re-derives the overlap [split, plen) with identical
+        values (keeping compile count logarithmic instead of per-length)."""
+        if not self._prefixes:
+            return None
+        best = None
+        for pe in self._prefixes.values():
+            pl_ = len(pe.ids)
+            if best is not None and pl_ <= len(best.ids):
+                continue
+            if len(prompt_ids) >= pl_ and tuple(prompt_ids[:pl_]) == pe.ids:
+                best = pe
+        if best is None:
+            return None
+        p = len(prompt_ids)
+        sw = 8
+        while sw < p - len(best.ids):
+            sw <<= 1
+        split = p - sw
+        if split <= 0:
+            return None  # suffix chunk covers the whole prompt: no reuse win
+        return best, split, sw
 
     @property
     def has_capacity(self) -> bool:
@@ -312,15 +430,32 @@ class ContinuousBatcher:
         self._off_np[slot] = off
         self._pos_np[slot] = bucket
         self._temp_np[slot] = temperature
-        padded = [0] * off + list(prompt_ids)
         # .copy(): on the CPU backend jnp.asarray can alias the numpy
         # buffer ZERO-COPY, and these mirrors keep mutating while the
         # async program reads them — observed as flaky garbage logits.
-        self.cache, self.last = _admit_jit(
-            self.params, self.cfg, self.cache, self.last,
-            jnp.asarray([padded], jnp.int32), jnp.asarray(slot),
-            jnp.asarray(self._kv_np.copy()), jnp.asarray(self._off_np.copy()),
+        m = (
+            self._match_prefix(list(prompt_ids))
+            if os.environ.get("KAKVEDA_SERVE_PREFIX", "1") != "0"
+            else None
         )
+        if m is not None:
+            pe, split, sw = m
+            self.prefix_stats["hits"] += 1
+            self.prefix_stats["hit_tokens_saved"] += split
+            self.cache, self.last = _admit_prefix_jit(
+                self.params, self.cfg, self.cache, self.last,
+                pe.kv, jnp.asarray([list(prompt_ids[split:])], jnp.int32),
+                jnp.asarray(slot),
+                jnp.asarray(self._kv_np.copy()), jnp.asarray(self._off_np.copy()),
+                jnp.asarray(off + split, jnp.int32),
+            )
+        else:
+            padded = [0] * off + list(prompt_ids)
+            self.cache, self.last = _admit_jit(
+                self.params, self.cfg, self.cache, self.last,
+                jnp.asarray([padded], jnp.int32), jnp.asarray(slot),
+                jnp.asarray(self._kv_np.copy()), jnp.asarray(self._off_np.copy()),
+            )
         self.slots[slot] = _Slot(req_id=rid, prompt_len=bucket, max_new=max_new_tokens)
         return rid
 
@@ -493,6 +628,19 @@ class ServingEngine:
         thread while the loop thread decodes for everyone at once."""
         return self.submit(prompt_ids, max_new_tokens, temperature).result()
 
+    def register_prefix(self, prefix_ids: List[int], timeout: float = 120.0) -> bool:
+        """Precompute a shared prompt prefix's K/V once; later submits whose
+        prompts start with it prefill only their suffix. Runs on the loop
+        thread (the batcher is loop-owned; a registration prefill must not
+        race a decode chunk's donated cache). Blocking; returns whether the
+        prefix was accepted (see ContinuousBatcher.register_prefix)."""
+        with self._submit_lock:
+            if self._closed.is_set():
+                raise RuntimeError("ServingEngine is closed")
+            fut: Future = Future()
+            self._q.put(("prefix", list(prefix_ids), fut))
+        return bool(fut.result(timeout=timeout))
+
     @staticmethod
     def _fail(fut: Future, err: BaseException) -> None:
         """set_exception tolerant of losing the race against the loop's
@@ -522,6 +670,15 @@ class ServingEngine:
         self._pend.clear()
 
     def _admit_one(self, item) -> None:
+        if item[0] == "prefix":
+            _, ids, fut = item
+            if not fut.set_running_or_notify_cancel():
+                return
+            try:
+                fut.set_result(self.cb.register_prefix(ids))
+            except Exception as e:  # noqa: BLE001 — registration errors belong to the caller
+                self._fail(fut, e)
+            return
         ids, max_new, temp, fut = item
         if not fut.set_running_or_notify_cancel():
             return

@@ -106,6 +106,22 @@ class TieredClassifier:
 
     runtime: "object"  # ModelRuntime protocol (generate())
     max_judge_chars: int = 2000
+    _prefix_registered: bool = False
+
+    def _register_judge_prefix(self) -> None:
+        """Register the fixed head of the judge template as a serving
+        prefix (once): every judge call shares it, so the serving engine
+        prefills only the per-trace remainder. Best-effort — runtimes
+        without prefix support (stub, Ollama) just skip."""
+        if self._prefix_registered:
+            return
+        reg = getattr(self.runtime, "register_prefix", None)
+        if callable(reg):
+            try:
+                reg(_JUDGE_PROMPT.split("{prompt}")[0])
+            except Exception:  # noqa: BLE001 — registration is an optimization only
+                pass
+        self._prefix_registered = True
 
     def classify_batch(self, traces: Sequence[TracePayload]) -> List[Optional[FailureSignal]]:
         out = RuleClassifier().classify_batch(traces)
@@ -123,6 +139,7 @@ class TieredClassifier:
             )
             for i in ambiguous
         ]
+        self._register_judge_prefix()
         # One decode stream for the whole ambiguous set when the runtime
         # supports batching (the TPU Llama does); per-prompt otherwise.
         batch_fn = getattr(self.runtime, "generate_batch", None)

@@ -1,0 +1,132 @@
+"""Prefix caching: requests sharing a registered prompt prefix prefill
+only their suffix, with greedy outputs token-identical to the uncached
+path (the engine's parity invariant extends to prefix admissions).
+
+Capability context: the reference resends the full prompt to Ollama on
+every request (services/dashboard/app.py:1182-1258) — the shared head of
+a judge template or system preamble is recomputed per call. Here its K/V
+is computed once per process and scattered into each admitted slot.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kakveda_tpu.models.generate import generate_tokens
+from kakveda_tpu.models.llama import LlamaConfig, init_params
+from kakveda_tpu.models.serving import ContinuousBatcher, ServingEngine
+
+CFG = LlamaConfig(
+    vocab_size=264, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+    d_ff=128, max_seq_len=128, dtype=jnp.float32,
+)
+
+PREFIX = list(range(40, 56))  # 16 shared tokens
+
+
+def _prompts():
+    return [
+        PREFIX + [5, 6, 7],
+        PREFIX + list(range(100, 121)),  # long suffix → wider suffix chunk
+        PREFIX + [9],
+        list(PREFIX),  # prompt == prefix exactly (tail recompute path)
+        [7, 8, 9],  # no shared prefix → normal admission
+    ]
+
+
+def test_prefix_admission_parity():
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    prompts = _prompts()
+    solo = [
+        generate_tokens(params, CFG, p, max_new_tokens=10, max_len=128) for p in prompts
+    ]
+
+    cb = ContinuousBatcher(params, CFG, batch_slots=2, max_len=128, chunk_steps=4)
+    assert cb.register_prefix(PREFIX)
+    outs = cb.run_all(prompts, max_new_tokens=10)
+    assert outs == solo
+    # 4 of 5 prompts start with the prefix; all matched admissions save
+    # at least one slab token.
+    assert cb.prefix_stats["registered"] == 1
+    assert cb.prefix_stats["hits"] == 4
+    assert cb.prefix_stats["hit_tokens_saved"] > 0
+
+
+def test_prefix_admission_parity_int8_kv():
+    cfg = LlamaConfig(
+        vocab_size=264, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=128, max_seq_len=128, dtype=jnp.float32, kv_quant="int8",
+    )
+    params = init_params(jax.random.PRNGKey(1), cfg)
+    prompts = _prompts()[:3]
+    solo = [
+        generate_tokens(params, cfg, p, max_new_tokens=8, max_len=128) for p in prompts
+    ]
+    cb = ContinuousBatcher(params, cfg, batch_slots=2, max_len=128, chunk_steps=4)
+    assert cb.register_prefix(PREFIX)
+    assert cb.run_all(prompts, max_new_tokens=8) == solo
+
+
+def test_prefix_matching_rules():
+    params = init_params(jax.random.PRNGKey(2), CFG)
+    cb = ContinuousBatcher(params, CFG, batch_slots=2, max_len=64, chunk_steps=4)
+    # Too short to matter / too long for the slot window: refused.
+    assert not cb.register_prefix([1, 2, 3])
+    assert not cb.register_prefix(list(range(60)))
+    # Registered twice: idempotent.
+    assert cb.register_prefix(PREFIX)
+    assert cb.register_prefix(PREFIX)
+    assert cb.prefix_stats["registered"] == 1
+    # Non-matching prompt: no hit.
+    assert cb._match_prefix([1, 2, 3, 4]) is None
+    # Longest registered prefix wins.
+    longer = PREFIX + [77, 78, 79, 80]
+    assert cb.register_prefix(longer)
+    m = cb._match_prefix(longer + [5])
+    assert m is not None and list(m[0].ids) == longer
+
+
+def test_prefix_refused_for_longrope():
+    """Phi-3 longrope selects the RoPE regime from the FULL sequence
+    length — a prefix computed at its own length could rotate in the
+    wrong regime, so registration refuses (correctness over reuse)."""
+    cfg = LlamaConfig(
+        vocab_size=264, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=128, max_seq_len=128, dtype=jnp.float32,
+        rope_dim_factors=tuple([1.0] * 8), rope_dim_factors_long=tuple([2.0] * 8),
+        rope_original_max_len=32,
+    )
+    params = init_params(jax.random.PRNGKey(3), cfg)
+    cb = ContinuousBatcher(params, cfg, batch_slots=2, max_len=64, chunk_steps=4)
+    assert not cb.register_prefix(PREFIX)
+
+
+def test_engine_register_prefix_concurrent():
+    """Engine-level registration runs on the loop thread and concurrent
+    submits keep exact solo parity with the prefix cache active."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    prompts = _prompts()
+    solo = [
+        generate_tokens(params, CFG, p, max_new_tokens=10, max_len=128) for p in prompts
+    ]
+    eng = ServingEngine(params, CFG, batch_slots=2, max_len=128, chunk_steps=4)
+    try:
+        assert eng.register_prefix(PREFIX)
+        with ThreadPoolExecutor(max_workers=len(prompts)) as ex:
+            outs = list(ex.map(lambda p: eng.generate_ids(p, 10), prompts))
+        assert outs == solo
+        assert eng.cb.prefix_stats["hits"] == 4
+    finally:
+        eng.close()
+
+
+def test_prefix_disabled_by_env(monkeypatch):
+    monkeypatch.setenv("KAKVEDA_SERVE_PREFIX", "0")
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    cb = ContinuousBatcher(params, CFG, batch_slots=2, max_len=128, chunk_steps=4)
+    assert cb.register_prefix(PREFIX)
+    cb.run_all([PREFIX + [5, 6, 7]], max_new_tokens=4)
+    assert cb.prefix_stats["hits"] == 0
