@@ -546,9 +546,12 @@ class ContinuousBatcher:
                 idx, p = pending.pop(0)
                 order[self.admit(p, max_new_tokens)] = idx
             self.step()
+        # Consume only THIS call's request ids: results from an earlier
+        # run_all/admit on the same batcher must neither leak in nor crash
+        # the index lookup (run_all is reusable for warmup+measure passes).
         outs: List[List[int]] = [[] for _ in prompts]
-        for rid, toks in self.results.items():
-            outs[order[rid]] = toks
+        for rid, idx in order.items():
+            outs[idx] = self.results.pop(rid, [])
         return outs
 
 
