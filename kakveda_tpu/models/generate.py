@@ -764,6 +764,18 @@ class LlamaRuntime:
             # Online path: the whole list joins the SHARED slot pool, so a
             # judge batch and a concurrent playground chat decode together.
             try:
+                if len(ids) >= 2:
+                    # Eval datasets and judge batches share a prompt head
+                    # (instruction template). Register the batch's common
+                    # token prefix once so all-but-the-first admissions
+                    # reuse its K/V slab (register_prefix dedupes repeats
+                    # and refuses unhelpful/unsafe prefixes itself).
+                    common = os.path.commonprefix(ids)
+                    if len(common) >= 16:
+                        try:
+                            eng.register_prefix(list(common))
+                        except RuntimeError:
+                            pass  # engine closed mid-flight: solo path below
                 with profiling.annotate("llama.generate_batch_online"):
                     futs = [eng.submit(i, max_new_tokens=max_tokens) for i in ids]
                     new_ids = [f.result() for f in futs]

@@ -366,6 +366,13 @@ class ContinuousBatcher:
             last_only=True,
         )
         keys = ("k", "v") + (("ks", "vs") if self.cfg.kv_quant == "int8" else ())
+        # Bounded store: auto-registration (generate_batch common heads)
+        # must not accumulate slabs without limit — each is
+        # plen·KV·D·layers·2 resident HBM bytes. Dict order is recency
+        # (moved-to-end on hit); evict the least recently used.
+        maxp = int(os.environ.get("KAKVEDA_SERVE_PREFIX_MAX", "4"))
+        while len(self._prefixes) >= max(1, maxp):
+            self._prefixes.pop(next(iter(self._prefixes)))
         self._prefixes[ids] = _Prefix(ids=ids, kv={k: scratch[k] for k in keys})
         self.prefix_stats["registered"] += 1
         return True
@@ -388,6 +395,8 @@ class ContinuousBatcher:
                 best = pe
         if best is None:
             return None
+        # Recency for the LRU bound: a hit keeps its prefix resident.
+        self._prefixes[best.ids] = self._prefixes.pop(best.ids)
         p = len(prompt_ids)
         sw = 8
         while sw < p - len(best.ids):

@@ -123,6 +123,51 @@ def test_engine_register_prefix_concurrent():
         eng.close()
 
 
+def test_prefix_lru_bound(monkeypatch):
+    """The slab store is bounded: registrations past the cap evict the
+    least recently USED prefix (hits refresh recency), so auto-registered
+    eval heads can't grow HBM residency without limit."""
+    monkeypatch.setenv("KAKVEDA_SERVE_PREFIX_MAX", "2")
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    cb = ContinuousBatcher(params, CFG, batch_slots=2, max_len=128, chunk_steps=4)
+    p1, p2, p3 = (
+        [10] * 12,
+        [20] * 12,
+        [30] * 12,
+    )
+    assert cb.register_prefix(p1)
+    assert cb.register_prefix(p2)
+    # Touch p1 so p2 becomes the LRU victim.
+    assert cb._match_prefix(p1 + [1, 2]) is not None
+    assert cb.register_prefix(p3)
+    assert tuple(p1) in cb._prefixes and tuple(p3) in cb._prefixes
+    assert tuple(p2) not in cb._prefixes
+
+
+def test_generate_batch_auto_registers_common_head(monkeypatch):
+    """LlamaRuntime.generate_batch registers the batch's common token
+    prefix so eval/judge batches reuse their instruction template's K/V
+    without any explicit call."""
+    from kakveda_tpu.models.generate import LlamaRuntime
+
+    monkeypatch.setenv("KAKVEDA_SERVE_CONTINUOUS", "1")
+    rt = LlamaRuntime(cfg=CFG, seed=0)
+    try:
+        # Short prompts: the runtime keeps only the last max_seq_len//2
+        # tokens, and truncation would misalign the shared head.
+        head = "Shared judge instruction template: "
+        prompts = [head + t for t in ("a", "b", "c")]
+        solo = [rt_out.text for rt_out in (rt.generate(p, max_tokens=6) for p in prompts)]
+        outs = rt.generate_batch(prompts, max_tokens=6)
+        assert [o.text for o in outs] == solo
+        eng = rt._engine
+        assert eng is not None
+        assert eng.cb.prefix_stats["registered"] >= 1
+        assert eng.cb.prefix_stats["hits"] >= 2
+    finally:
+        rt.retire()
+
+
 def test_prefix_disabled_by_env(monkeypatch):
     monkeypatch.setenv("KAKVEDA_SERVE_PREFIX", "0")
     params = init_params(jax.random.PRNGKey(0), CFG)
