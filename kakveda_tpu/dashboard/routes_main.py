@@ -565,6 +565,95 @@ def setup(app: web.Application) -> None:
         )
 
     @require_roles("admin", "operator")
+    async def playground_stream(request):
+        """Server-sent-events streaming generation: text deltas reach the
+        client per decode chunk instead of after the full response — the
+        reference's playground blocks on one whole Ollama reply
+        (services/dashboard/app.py:3127-3299). Runtimes without streaming
+        (stub, Ollama client) fall back to a single delta event. The run
+        is recorded to trace_runs exactly like /playground/run."""
+        form = await request.post()
+        prompt = str(form.get("prompt") or "")
+        if not prompt:
+            raise web.HTTPBadRequest(text="prompt required")
+        chosen_target = str(form.get("target") or "model")
+        chosen = (
+            chosen_target.split(":", 1)[1] if chosen_target.startswith("model:") else None
+        )
+        resp = web.StreamResponse(
+            headers={
+                "Content-Type": "text/event-stream",
+                "Cache-Control": "no-cache",
+                "X-Accel-Buffering": "no",
+            }
+        )
+        await resp.prepare(request)
+        loop = asyncio.get_running_loop()
+        ch: asyncio.Queue = asyncio.Queue()
+        t0 = time.time()
+
+        def pump():
+            # Blocking generator runs in the executor; deltas hop to the
+            # event loop thread-safely. The sentinel carries the outcome.
+            try:
+                stream_fn = getattr(ctx.model, "generate_stream", None)
+                parts: list = []
+                if callable(stream_fn):
+                    for d in stream_fn(prompt, model=chosen):
+                        parts.append(d)
+                        loop.call_soon_threadsafe(ch.put_nowait, ("delta", d))
+                else:
+                    gen = ctx.model.generate(prompt, model=chosen)
+                    parts.append(gen.text)
+                    loop.call_soon_threadsafe(ch.put_nowait, ("delta", gen.text))
+                loop.call_soon_threadsafe(ch.put_nowait, ("done", "".join(parts)))
+            except Exception as e:  # noqa: BLE001 — surface in-stream, not a 500 mid-SSE
+                loop.call_soon_threadsafe(ch.put_nowait, ("error", f"{type(e).__name__}: {e}"))
+
+        task = loop.run_in_executor(None, pump)
+        text = ""
+        try:
+            while True:
+                kind, payload = await ch.get()
+                if kind == "delta":
+                    await resp.write(
+                        b"data: " + json.dumps({"delta": payload}).encode() + b"\n\n"
+                    )
+                elif kind == "error":
+                    await resp.write(
+                        b"data: " + json.dumps({"error": payload}).encode() + b"\n\n"
+                    )
+                    break
+                else:
+                    text = payload
+                    latency_ms = int((time.time() - t0) * 1000)
+                    await resp.write(
+                        b"data: "
+                        + json.dumps({"done": True, "latency_ms": latency_ms}).encode()
+                        + b"\n\n"
+                    )
+                    break
+        finally:
+            await task
+        if text:
+            trace_id = new_trace_id()
+            t1 = time.time()
+            tokens_in, tokens_out = estimate_tokens(prompt), estimate_tokens(text)
+            ctx.db.execute(
+                "INSERT OR IGNORE INTO trace_runs (trace_id, ts, app_id, agent_id, prompt,"
+                " response, provider, model, latency_ms, tokens_in, tokens_out,"
+                " cost_micro_usd, status) VALUES (?,?,?,?,?,?,?,?,?,?,?,?,'ok')",
+                (
+                    trace_id, t0, "playground", "tpu", prompt, text, "tpu",
+                    chosen, int((t1 - t0) * 1000), tokens_in, tokens_out,
+                    estimate_cost_micro_usd(tokens_in, tokens_out),
+                ),
+            )
+            ctx.db.add_span(trace_id, "playground.stream", t0, t1, meta={"streamed": True})
+        await resp.write_eof()
+        return resp
+
+    @require_roles("admin", "operator")
     async def playground_run(request):
         """Direct model or external-agent invocation with span + cost capture
         (reference: services/dashboard/app.py:3127-3299)."""
@@ -666,5 +755,6 @@ def setup(app: web.Application) -> None:
             web.post("/runs/{trace_id}/feedback", run_feedback),
             web.get("/playground", playground_page),
             web.post("/playground/run", playground_run),
+            web.post("/playground/stream", playground_stream),
         ]
     )

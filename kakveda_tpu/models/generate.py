@@ -804,6 +804,95 @@ class LlamaRuntime:
             for out in new_ids
         ]
 
+    def generate_stream(
+        self, prompt: str, *, model: Optional[str] = None, max_tokens: int = 64
+    ):
+        """Streaming generation: yields text deltas as decode chunks land.
+
+        Engine path: the request joins the shared continuous-batching pool
+        and each chunk's accepted tokens surface through the engine's
+        ``on_tokens`` callback (token-identical to the blocking path).
+        Fallback (engine disabled / request doesn't fit): chunked solo
+        decode yielding per device chunk. Deltas join to exactly the text
+        ``generate`` would return; incomplete UTF-8 at a chunk boundary is
+        withheld until the bytes complete (decode uses errors="replace",
+        so an unstable replacement char must never be emitted early).
+
+        Capability beyond the reference: its playground blocks on a full
+        Ollama response per request (services/dashboard/app.py:3127-3299);
+        here first tokens reach the client after one decode chunk.
+        """
+        ids = self.tokenizer.encode(prompt)[-self.cfg.max_seq_len // 2 :]
+
+        def deltas(all_ids: list, done: bool, prev: str) -> tuple:
+            text = self.tokenizer.decode(all_ids)
+            if not done:
+                text = text.rstrip("�")  # partial multi-byte tail
+            if text.startswith(prev) and len(text) > len(prev):
+                return text[len(prev):], text
+            return "", prev
+
+        eng = self.engine()
+        if eng is not None and eng.fits(len(ids), max_tokens):
+            import queue as _q
+
+            ch: "_q.Queue" = _q.Queue()
+            try:
+                fut = eng.submit(
+                    ids, max_tokens,
+                    on_tokens=lambda new, done: ch.put((list(new), done)),
+                )
+            except RuntimeError:
+                fut = None  # engine closed: solo fallback below
+            if fut is not None:
+                out: list = []
+                prev = ""
+                while True:
+                    try:
+                        new, done = ch.get(timeout=0.5)
+                    except _q.Empty:
+                        if fut.done():  # engine died mid-request
+                            fut.result()  # raises the loop's error
+                            break
+                        continue
+                    out.extend(new)
+                    d, prev = deltas(out, done, prev)
+                    if d:
+                        yield d
+                    if done:
+                        break
+                return
+
+        # Solo fallback: same chunked decode as _generate_ids_chunked, one
+        # yield per device chunk.
+        import numpy as onp
+
+        plen = len(ids)
+        pchunk = int(os.environ.get("KAKVEDA_PREFILL_CHUNK", "0"))
+        plen = _prefill_width(plen, pchunk)
+        ml = _bucket_len(plen + max_tokens + 1, self.cfg.max_seq_len)
+        sess = DecodeSession(
+            self.params, self.cfg, [ids], chunk_steps=16, max_len=ml, prefill_chunk=pchunk
+        )
+        eos = self.tokenizer.EOS
+        out = []
+        prev = ""
+        budget = min(max_tokens, sess.steps_left)
+        done = False
+        while budget > 0 and not done:
+            chunk = sess.step_chunk(min(16, budget))
+            if chunk is None:
+                break
+            budget -= chunk.shape[1]
+            for t in onp.asarray(chunk)[0].tolist():
+                if t == eos or len(out) >= max_tokens:
+                    done = True
+                    break
+                out.append(t)
+            d, prev = deltas(out, done or budget <= 0, prev)
+            if d:
+                yield d
+
     def generate(self, prompt: str, *, model: Optional[str] = None, max_tokens: int = 64) -> GenerateResult:
         started = time.perf_counter()
         ids = self.tokenizer.encode(prompt)[-self.cfg.max_seq_len // 2 :]

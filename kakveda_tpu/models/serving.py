@@ -286,6 +286,10 @@ class _Slot:
     max_new: int
     out: List[int] = field(default_factory=list)
     done: bool = False
+    # Streaming: called from process_chunk with (new_tokens, done) after
+    # each chunk. MUST be fast/non-blocking (queue put) — it runs on the
+    # engine loop thread between device dispatches.
+    on_tokens: Optional[object] = None
 
 
 class ContinuousBatcher:
@@ -415,7 +419,11 @@ class ContinuousBatcher:
         return len(self.slots)
 
     def admit(
-        self, prompt_ids: List[int], max_new_tokens: int = 64, temperature: float = 0.0
+        self,
+        prompt_ids: List[int],
+        max_new_tokens: int = 64,
+        temperature: float = 0.0,
+        on_tokens=None,
     ) -> int:
         """Prefill into a free slot; returns a request id.
 
@@ -465,7 +473,9 @@ class ContinuousBatcher:
                 jnp.asarray([padded], jnp.int32), jnp.asarray(slot),
                 jnp.asarray(self._kv_np.copy()), jnp.asarray(self._off_np.copy()),
             )
-        self.slots[slot] = _Slot(req_id=rid, prompt_len=bucket, max_new=max_new_tokens)
+        self.slots[slot] = _Slot(
+            req_id=rid, prompt_len=bucket, max_new=max_new_tokens, on_tokens=on_tokens
+        )
         return rid
 
     def step_async(self):
@@ -523,6 +533,7 @@ class ContinuousBatcher:
         for slot, st in snapshot.items():
             if st.done:
                 continue  # retired by an earlier chunk; these are overshoot tokens
+            n_before = len(st.out)
             for t in toks_h[slot]:
                 t = int(t)
                 if self.eos_id is not None and t == self.eos_id:
@@ -532,6 +543,14 @@ class ContinuousBatcher:
                 if len(st.out) >= st.max_new or st.prompt_len + len(st.out) + 1 >= self.max_len:
                     st.done = True
                     break
+            if st.on_tokens is not None:
+                # Streaming: surface this chunk's accepted tokens as they
+                # land. Exceptions must not kill the engine loop — a gone
+                # stream consumer just stops receiving.
+                try:
+                    st.on_tokens(st.out[n_before:], st.done)
+                except Exception:  # noqa: BLE001
+                    st.on_tokens = None
             if st.done:
                 self.results[st.req_id] = st.out
                 finished.append(st.req_id)
@@ -619,9 +638,17 @@ class ServingEngine:
         return bucket + max_new_tokens + 1 <= ml
 
     def submit(
-        self, prompt_ids: List[int], max_new_tokens: int = 64, temperature: float = 0.0
+        self,
+        prompt_ids: List[int],
+        max_new_tokens: int = 64,
+        temperature: float = 0.0,
+        on_tokens=None,
     ) -> Future:
-        """Enqueue a request; the Future resolves to the generated id list."""
+        """Enqueue a request; the Future resolves to the generated id list.
+
+        ``on_tokens(new_ids, done)`` (optional) streams each decode chunk's
+        accepted tokens as they land — called on the engine loop thread, so
+        it must be non-blocking (push to a queue and return)."""
         with self._submit_lock:
             # Atomic with close()'s drain: without the lock a put landing
             # between close()'s _closed.set() and its queue drain would
@@ -629,7 +656,7 @@ class ServingEngine:
             if self._closed.is_set():
                 raise RuntimeError("ServingEngine is closed")
             fut: Future = Future()
-            self._q.put((list(prompt_ids), max_new_tokens, temperature, fut))
+            self._q.put((list(prompt_ids), max_new_tokens, temperature, on_tokens, fut))
             self.stats["submitted"] += 1
             return fut
 
@@ -691,11 +718,13 @@ class ServingEngine:
             except Exception as e:  # noqa: BLE001 — registration errors belong to the caller
                 self._fail(fut, e)
             return
-        ids, max_new, temp, fut = item
+        ids, max_new, temp, on_tokens, fut = item
         if not fut.set_running_or_notify_cancel():
             return
         try:
-            rid = self.cb.admit(ids, max_new_tokens=max_new, temperature=temp)
+            rid = self.cb.admit(
+                ids, max_new_tokens=max_new, temperature=temp, on_tokens=on_tokens
+            )
         except Exception as e:  # noqa: BLE001 — admission errors belong to the caller
             self._fail(fut, e)
             return

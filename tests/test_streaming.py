@@ -1,0 +1,168 @@
+"""Streaming generation: engine on_tokens callbacks, runtime text-delta
+generator, and the playground SSE endpoint.
+
+Beyond-reference capability: the reference's playground blocks on one full
+Ollama reply per request (services/dashboard/app.py:3127-3299); here text
+deltas reach the client per decode chunk, token-identical to the blocking
+path.
+"""
+
+import asyncio
+import json
+
+import jax
+import jax.numpy as jnp
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from kakveda_tpu.models.generate import LlamaRuntime, generate_tokens
+from kakveda_tpu.models.llama import LlamaConfig, init_params
+from kakveda_tpu.models.serving import ContinuousBatcher, ServingEngine
+
+CFG = LlamaConfig(
+    vocab_size=264, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+    d_ff=128, max_seq_len=128, dtype=jnp.float32,
+)
+
+
+def test_batcher_on_tokens_streams_exact_results():
+    """Chunk callbacks deliver exactly the tokens the blocking result
+    carries, in order, with done=True on the final chunk."""
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    prompts = [[5, 6, 7], [10, 11, 12, 13]]
+    streamed = {0: [], 1: []}
+    flags = {0: [], 1: []}
+
+    cb = ContinuousBatcher(params, CFG, batch_slots=2, max_len=64, chunk_steps=4)
+    rids = [
+        cb.admit(
+            p, max_new_tokens=10,
+            on_tokens=(lambda i: lambda new, done: (streamed[i].extend(new), flags[i].append(done)))(i),
+        )
+        for i, p in enumerate(prompts)
+    ]
+    while cb.active:
+        cb.step()
+    for i, rid in enumerate(rids):
+        assert streamed[i] == cb.results[rid]
+        assert flags[i][-1] is True
+        assert all(f is False for f in flags[i][:-1])
+
+
+def test_engine_stream_callback_runs_on_loop():
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    got = []
+    eng = ServingEngine(params, CFG, batch_slots=2, max_len=64, chunk_steps=4)
+    try:
+        fut = eng.submit([5, 6, 7], 8, on_tokens=lambda new, done: got.extend(new))
+        result = fut.result(timeout=120)
+        assert got == result
+    finally:
+        eng.close()
+
+
+@pytest.mark.parametrize("continuous", ["1", "0"])
+def test_runtime_generate_stream_matches_generate(monkeypatch, continuous):
+    """Joined deltas equal the blocking generate() text on BOTH paths —
+    engine streaming and the chunked solo fallback."""
+    monkeypatch.setenv("KAKVEDA_SERVE_CONTINUOUS", continuous)
+    rt = LlamaRuntime(cfg=CFG, seed=0)
+    try:
+        prompt = "stream parity check"
+        blocking = rt.generate(prompt, max_tokens=12).text
+        parts = list(rt.generate_stream(prompt, max_tokens=12))
+        assert len(parts) >= 1
+        assert "".join(parts) == blocking
+    finally:
+        rt.retire()
+
+
+def test_playground_stream_sse(tmp_path, monkeypatch):
+    """The SSE endpoint emits delta events then a done event, records the
+    run, and the concatenated deltas equal the blocking response text."""
+    from kakveda_tpu.dashboard.app import make_dashboard_app
+    from kakveda_tpu.platform import Platform
+
+    monkeypatch.setenv("KAKVEDA_SERVE_CONTINUOUS", "1")
+    from kakveda_tpu.dashboard.core import RATE_LIMITER
+
+    RATE_LIMITER._hits.clear()
+    rt = LlamaRuntime(cfg=CFG, seed=0)
+    plat = Platform(data_dir=tmp_path / "data", capacity=256, dim=1024)
+    app = make_dashboard_app(platform=plat, db_path=tmp_path / "dash.db", model=rt)
+
+    async def go():
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            r = await client.post(
+                "/login",
+                data={"email": "admin@local", "password": "admin123", "next": "/"},
+                allow_redirects=False,
+            )
+            assert r.status == 302
+            blocking = rt.generate("hello stream").text  # endpoint default max_tokens
+            r = await client.post(
+                "/playground/stream", data={"prompt": "hello stream", "target": "model"}
+            )
+            assert r.status == 200
+            assert r.headers["Content-Type"].startswith("text/event-stream")
+            body = await r.text()
+            events = [
+                json.loads(line[len("data: "):])
+                for line in body.splitlines()
+                if line.startswith("data: ")
+            ]
+            assert events, body
+            assert events[-1].get("done") is True
+            text = "".join(e.get("delta", "") for e in events)
+            assert text == blocking
+            # The run landed in trace_runs like /playground/run does.
+            r = await client.get("/runs?q=provider:tpu")
+            assert r.status == 200
+        finally:
+            await client.close()
+
+    asyncio.run(go())
+    rt.retire()
+
+
+def test_playground_stream_stub_fallback(tmp_path):
+    """Runtimes without generate_stream still stream: one delta + done."""
+    from kakveda_tpu.dashboard.app import make_dashboard_app
+    from kakveda_tpu.dashboard.core import RATE_LIMITER
+    from kakveda_tpu.models.runtime import StubRuntime
+    from kakveda_tpu.platform import Platform
+
+    RATE_LIMITER._hits.clear()
+    plat = Platform(data_dir=tmp_path / "data", capacity=256, dim=1024)
+    app = make_dashboard_app(
+        platform=plat, db_path=tmp_path / "dash.db", model=StubRuntime()
+    )
+
+    async def go():
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            r = await client.post(
+                "/login",
+                data={"email": "admin@local", "password": "admin123", "next": "/"},
+                allow_redirects=False,
+            )
+            assert r.status == 302
+            r = await client.post(
+                "/playground/stream", data={"prompt": "please cite sources"}
+            )
+            assert r.status == 200
+            events = [
+                json.loads(line[len("data: "):])
+                for line in (await r.text()).splitlines()
+                if line.startswith("data: ")
+            ]
+            deltas = [e for e in events if "delta" in e]
+            assert len(deltas) == 1 and deltas[0]["delta"]
+            assert events[-1].get("done") is True
+        finally:
+            await client.close()
+
+    asyncio.run(go())
