@@ -146,6 +146,14 @@ class StubRuntime:
             },
         )
 
+    def generate_stream(self, prompt: str, *, model: Optional[str] = None, max_tokens: int = 256):
+        """Deterministic chunked stream so the SSE path is exercisable with
+        no hardware: the canned response arrives word by word, joining to
+        exactly generate().text."""
+        words = STUB_RESPONSE.split(" ")
+        for i, w in enumerate(words):
+            yield w if i == len(words) - 1 else w + " "
+
 
 class OllamaRuntime:
     """HTTP client for an external Ollama, with stub fallback on any error —
@@ -402,6 +410,10 @@ class MultiModelRuntime:
 
     def generate_batch(self, prompts: list, *, model: Optional[str] = None, max_tokens: int = 256) -> list:
         return self._get(model).generate_batch(prompts, model=model, max_tokens=max_tokens)
+
+    def generate_stream(self, prompt: str, *, model: Optional[str] = None, max_tokens: int = 64):
+        """Stream from the resolved model's runtime (SSE playground path)."""
+        return self._get(model).generate_stream(prompt, model=model, max_tokens=max_tokens)
 
 
 _RUNTIMES: Dict[str, Any] = {}

@@ -127,21 +127,31 @@ def test_playground_stream_sse(tmp_path, monkeypatch):
     rt.retire()
 
 
-def test_playground_stream_stub_fallback(tmp_path):
-    """Runtimes without generate_stream still stream: one delta + done."""
+def test_playground_stream_stub_and_fallback(tmp_path):
+    """The stub runtime streams word-by-word (hermetic SSE demo), and a
+    runtime WITHOUT generate_stream still streams via the one-delta
+    fallback."""
     from kakveda_tpu.dashboard.app import make_dashboard_app
     from kakveda_tpu.dashboard.core import RATE_LIMITER
     from kakveda_tpu.models.runtime import StubRuntime
     from kakveda_tpu.platform import Platform
+
+    class NoStream(StubRuntime):
+        generate_stream = None  # simulate a runtime without streaming
 
     RATE_LIMITER._hits.clear()
     plat = Platform(data_dir=tmp_path / "data", capacity=256, dim=1024)
     app = make_dashboard_app(
         platform=plat, db_path=tmp_path / "dash.db", model=StubRuntime()
     )
+    app2 = make_dashboard_app(
+        platform=plat, db_path=tmp_path / "dash2.db", model=NoStream()
+    )
 
-    async def go():
-        client = TestClient(TestServer(app))
+    from kakveda_tpu.models.runtime import STUB_RESPONSE
+
+    async def run_one(a):
+        client = TestClient(TestServer(a))
         await client.start_server()
         try:
             r = await client.post(
@@ -159,10 +169,16 @@ def test_playground_stream_stub_fallback(tmp_path):
                 for line in (await r.text()).splitlines()
                 if line.startswith("data: ")
             ]
-            deltas = [e for e in events if "delta" in e]
-            assert len(deltas) == 1 and deltas[0]["delta"]
+            deltas = [e["delta"] for e in events if "delta" in e]
             assert events[-1].get("done") is True
+            return deltas
         finally:
             await client.close()
+
+    async def go():
+        word_deltas = await run_one(app)
+        assert len(word_deltas) > 1 and "".join(word_deltas) == STUB_RESPONSE
+        fallback_deltas = await run_one(app2)
+        assert len(fallback_deltas) == 1 and fallback_deltas[0] == STUB_RESPONSE
 
     asyncio.run(go())
