@@ -569,9 +569,10 @@ def setup(app: web.Application) -> None:
         """Server-sent-events streaming generation: text deltas reach the
         client per decode chunk instead of after the full response — the
         reference's playground blocks on one whole Ollama reply
-        (services/dashboard/app.py:3127-3299). Runtimes without streaming
-        (stub, Ollama client) fall back to a single delta event. The run
-        is recorded to trace_runs exactly like /playground/run."""
+        (services/dashboard/app.py:3127-3299). Runtimes without a
+        generate_stream (the Ollama client) fall back to a single delta
+        event; the stub streams word-by-word. The run is recorded to
+        trace_runs exactly like /playground/run."""
         form = await request.post()
         prompt = str(form.get("prompt") or "")
         if not prompt:

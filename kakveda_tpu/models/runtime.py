@@ -411,8 +411,10 @@ class MultiModelRuntime:
     def generate_batch(self, prompts: list, *, model: Optional[str] = None, max_tokens: int = 256) -> list:
         return self._get(model).generate_batch(prompts, model=model, max_tokens=max_tokens)
 
-    def generate_stream(self, prompt: str, *, model: Optional[str] = None, max_tokens: int = 64):
-        """Stream from the resolved model's runtime (SSE playground path)."""
+    def generate_stream(self, prompt: str, *, model: Optional[str] = None, max_tokens: int = 256):
+        """Stream from the resolved model's runtime (SSE playground path).
+        Default budget matches generate()/generate_batch here — a streamed
+        answer must not silently truncate shorter than the blocking one."""
         return self._get(model).generate_stream(prompt, model=model, max_tokens=max_tokens)
 
 
