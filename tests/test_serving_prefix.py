@@ -68,6 +68,26 @@ def test_prefix_admission_parity_int8_kv():
     assert cb.run_all(prompts, max_new_tokens=8) == solo
 
 
+def test_prefix_admission_parity_sliding_window():
+    """Mistral-style sliding window: the suffix prefill's banded attention
+    over slab rows must match the single-shot prefill exactly (same
+    decode_step path as chunked prefill, but worth locking — the band
+    crosses the slab/suffix boundary)."""
+    cfg = LlamaConfig(
+        vocab_size=264, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=128, max_seq_len=128, dtype=jnp.float32, sliding_window=12,
+    )
+    params = init_params(jax.random.PRNGKey(4), cfg)
+    prompts = _prompts()[:3]
+    solo = [
+        generate_tokens(params, cfg, p, max_new_tokens=8, max_len=128) for p in prompts
+    ]
+    cb = ContinuousBatcher(params, cfg, batch_slots=2, max_len=128, chunk_steps=4)
+    assert cb.register_prefix(PREFIX)
+    assert cb.run_all(prompts, max_new_tokens=8) == solo
+    assert cb.prefix_stats["hits"] >= 2
+
+
 def test_prefix_matching_rules():
     params = init_params(jax.random.PRNGKey(2), CFG)
     cb = ContinuousBatcher(params, CFG, batch_slots=2, max_len=64, chunk_steps=4)
