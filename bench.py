@@ -1291,6 +1291,14 @@ def _bench_serve(backend: str) -> dict:
         """One full concurrent-HTTP round at the given pipelining setting
         (fresh runtime + apps, so the engine thread reads the env)."""
         os.environ["KAKVEDA_SERVE_PIPELINE"] = pipeline
+        # The login limiter is process-global and keyed by peer IP: inside
+        # the full sweep, this metric's 2×n_clients logins (all 127.0.0.1)
+        # cross the 20/60s window and every later login bounces — which
+        # zeroed the metric with a bare AssertionError. Fresh window per
+        # workload, exactly like tests/test_dashboard.py's fixture.
+        from kakveda_tpu.dashboard.core import RATE_LIMITER
+
+        RATE_LIMITER._hits.clear()
         rt = LlamaRuntime(cfg=cfg, params=params, seed=0)
         tmp = Path(tempfile.mkdtemp(prefix="kakveda-bench-serve-"))
         plat = Platform(data_dir=tmp / "data", capacity=1 << 14, dim=2048)
