@@ -7,6 +7,8 @@ from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Any, List, Optional
 
+import secrets
+
 import jinja2
 from aiohttp import web
 
@@ -52,7 +54,9 @@ class DashboardContext:
 
     def render(self, request: web.Request, template: str, **ctx: Any) -> web.Response:
         user = request.get("user")
-        html = self.jinja.get_template(template).render(user=user, request=request, **ctx)
+        html = self.jinja.get_template(template).render(
+            user=user, request=request, csp_nonce=request.get("csp_nonce", ""), **ctx
+        )
         return web.Response(text=html, content_type="text/html")
 
 
@@ -145,10 +149,16 @@ async def user_middleware(request: web.Request, handler):
     return await handler(request)
 
 
-def _stamp_security_headers(response) -> None:
+def _stamp_security_headers(response, nonce: str = "") -> None:
+    # Inline scripts (warnings charts, playground streaming) carry a
+    # per-request nonce: script-src falls back to default-src 'self'
+    # otherwise, and 'self' BLOCKS inline execution in real browsers —
+    # a gap TestClient-based tests can't see (clients don't enforce CSP).
+    script_src = f" 'nonce-{nonce}'" if nonce else ""
     response.headers.setdefault(
         "Content-Security-Policy",
-        "default-src 'self'; style-src 'self' 'unsafe-inline'",
+        f"default-src 'self'; script-src 'self'{script_src}; "
+        "style-src 'self' 'unsafe-inline'",
     )
     response.headers.setdefault("X-Frame-Options", "DENY")
     response.headers.setdefault("X-Content-Type-Options", "nosniff")
@@ -164,13 +174,14 @@ async def security_headers_middleware(request: web.Request, handler):
     (reference: services/dashboard/app.py:615-626). Redirects and error
     pages are raised as HTTPException by most handlers, so the raised path
     must be stamped too."""
+    request["csp_nonce"] = secrets.token_urlsafe(16)
     try:
         response = await handler(request)
     except web.HTTPException as exc:
-        _stamp_security_headers(exc)
+        _stamp_security_headers(exc, request["csp_nonce"])
         _stamp_csrf_cookie(request, exc)
         raise
-    _stamp_security_headers(response)
+    _stamp_security_headers(response, request["csp_nonce"])
     _stamp_csrf_cookie(request, response)
     return response
 
@@ -185,8 +196,6 @@ def _stamp_csrf_cookie(request: web.Request, response) -> None:
     before enforcement is switched on."""
     if request.cookies.get(CSRF_COOKIE):
         return
-    import secrets
-
     try:
         response.set_cookie(
             CSRF_COOKIE,

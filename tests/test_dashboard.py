@@ -488,6 +488,34 @@ def test_security_headers(tmp_path):
     run(go())
 
 
+def test_csp_nonce_covers_inline_scripts(tmp_path):
+    """Pages with executable inline scripts must carry the SAME nonce in
+    the CSP header and the <script> tags — script-src otherwise falls
+    back to 'self', which blocks inline execution in real browsers (a
+    gap no TestClient assertion on status codes can see). Each response
+    must get a FRESH nonce (a static one is as weak as unsafe-inline)."""
+    import re
+
+    async def go():
+        client = await _client(_mk_app(tmp_path))
+        try:
+            await _login(client)
+            nonces = []
+            for _ in range(2):
+                r = await client.get("/warnings")
+                csp = r.headers["Content-Security-Policy"]
+                m = re.search(r"script-src 'self' 'nonce-([^']+)'", csp)
+                assert m, csp
+                body = await r.text()
+                assert f'<script nonce="{m.group(1)}">' in body
+                nonces.append(m.group(1))
+            assert nonces[0] != nonces[1]
+        finally:
+            await client.close()
+
+    run(go())
+
+
 def test_production_requires_secret(tmp_path, monkeypatch):
     monkeypatch.setenv("KAKVEDA_ENV", "production")
     with pytest.raises(RuntimeError, match="JWT secret"):
