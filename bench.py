@@ -1306,6 +1306,7 @@ def _bench_serve(backend: str) -> dict:
         svc = make_service_app(platform=plat)
         lat_play: list = []
         lat_warn: list = []
+        lat_ttft: list = []
         stop = asyncio.Event()
 
         async def go():
@@ -1362,6 +1363,18 @@ def _bench_serve(backend: str) -> dict:
                 t_wall = time.perf_counter() - t0
                 stop.set()
                 await wt
+                # TTFT via the SSE endpoint: time from POST to the first
+                # delta event (streaming makes this a real SLO — the
+                # blocking path's first byte IS the last byte).
+                for p in prompts[:4]:
+                    ts = time.perf_counter()
+                    r = await clients[0].post(
+                        "/playground/stream", data={"prompt": p, "target": "model"}
+                    )
+                    async for _chunk in r.content.iter_any():
+                        lat_ttft.append(time.perf_counter() - ts)
+                        break
+                    await r.release()
             finally:
                 for c in clients:
                     await c.close()
@@ -1383,6 +1396,7 @@ def _bench_serve(backend: str) -> dict:
             "n_reqs": len(lat_play),
             "seq_est": float(np.sum(lat_play)),
             "completed": completed,
+            "ttft_p50": float(np.percentile(lat_ttft, 50)) if lat_ttft else 0.0,
         }
 
     prev_env = os.environ.get("KAKVEDA_SERVE_PIPELINE")
@@ -1423,6 +1437,7 @@ def _bench_serve(backend: str) -> dict:
         "preset": preset,
         "unpipelined_p95_ms": round(base["p95"] * 1000, 1),
         "pipeline_p95_gain": round(base["p95"] / max(r["p95"], 1e-9), 2),
+        "stream_ttft_p50_ms": round(r["ttft_p50"] * 1000, 1),
     }
 
 
