@@ -774,8 +774,12 @@ class LlamaRuntime:
                     if len(common) >= 16:
                         try:
                             eng.register_prefix(list(common))
-                        except RuntimeError:
-                            pass  # engine closed mid-flight: solo path below
+                        except Exception:  # noqa: BLE001 — registration is an
+                            # optimization only: engine closed mid-flight
+                            # (RuntimeError) or a saturated pool timing out
+                            # the registration future (TimeoutError) must
+                            # not fail the batch itself.
+                            pass
                 with profiling.annotate("llama.generate_batch_online"):
                     futs = [eng.submit(i, max_new_tokens=max_tokens) for i in ids]
                     new_ids = [f.result() for f in futs]
