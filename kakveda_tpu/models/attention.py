@@ -54,7 +54,7 @@ _NEG_INF = -1e30
 # ---------------------------------------------------------------------------
 
 
-def _gqa_xla(q, k, v, pos0, kv_valid, window: int = 0, softcap: float = 0.0):
+def _gqa_xla(q, k, v, pos0, kv_valid, window: int = 0, softcap: float = 0.0, full_mask=None):
     b, s, h, d = q.shape
     _, kv, l, _ = k.shape
     r = h // kv
@@ -66,17 +66,22 @@ def _gqa_xla(q, k, v, pos0, kv_valid, window: int = 0, softcap: float = 0.0):
     if softcap:
         # Gemma-2 attention-logit softcapping: cap·tanh(s/cap), pre-mask.
         scores = softcap * jnp.tanh(scores / softcap)
-    q_pos = pos0 + jnp.arange(s)
-    l_pos = jnp.arange(l)
-    mask = q_pos[:, None] >= l_pos[None, :]  # [S, L]
-    if window:
-        # Sliding-window attention (Mistral): keep iff q_pos − l_pos < window.
-        mask &= (q_pos[:, None] - l_pos[None, :]) < window
-    if kv_valid is not None:
-        full = mask[None, :, :] & kv_valid[:, None, :]  # [B, S, L]
-        scores = jnp.where(full[:, None, :, None, :], scores, _NEG_INF)
+    if full_mask is not None:
+        # Caller-computed [B, S, L] mask (per-slot query positions — the
+        # speculative serving chunk); replaces causal/window/kv_valid.
+        scores = jnp.where(full_mask[:, None, :, None, :], scores, _NEG_INF)
     else:
-        scores = jnp.where(mask[None, None, :, None, :], scores, _NEG_INF)
+        q_pos = pos0 + jnp.arange(s)
+        l_pos = jnp.arange(l)
+        mask = q_pos[:, None] >= l_pos[None, :]  # [S, L]
+        if window:
+            # Sliding-window attention (Mistral): keep iff q_pos − l_pos < window.
+            mask &= (q_pos[:, None] - l_pos[None, :]) < window
+        if kv_valid is not None:
+            full = mask[None, :, :] & kv_valid[:, None, :]  # [B, S, L]
+            scores = jnp.where(full[:, None, :, None, :], scores, _NEG_INF)
+        else:
+            scores = jnp.where(mask[None, None, :, None, :], scores, _NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
     out = jnp.einsum("bgsrl,bgld->bgsrd", probs, v)
     return out.transpose(0, 2, 1, 3, 4).reshape(b, s, h, d).astype(q.dtype)
@@ -352,6 +357,7 @@ def gqa_cache_attention(
     k_scale: jax.Array | None = None,  # int8 cache: [B, KV, L] per-row scales
     v_scale: jax.Array | None = None,
     use_flash: bool | None = None,
+    full_mask: jax.Array | None = None,  # [B, S, L] per-query mask (spec chunks)
 ) -> jax.Array:
     """Cached GQA attention — dispatches to the Pallas flash kernel on TPU
     (inference shapes that fit its tiling), XLA grouped einsum otherwise.
@@ -370,11 +376,19 @@ def gqa_cache_attention(
 
         return _kv_dequant(k, k_scale, q.dtype), _kv_dequant(v, v_scale, q.dtype)
 
-    if softcap:
+    if full_mask is not None or softcap:
+        # full_mask: per-slot query positions (the speculative serving
+        # chunk) — inexpressible in the flash kernel's scalar-pos0 causal
+        # mask, so these shapes take the XLA path. S ≤ k+1 keeps its
+        # scratch tiny. softcap likewise always takes the XLA path.
         if k_scale is not None:
             kd, vd = _dequant()
-            return _gqa_xla(q, kd, vd, pos0, kv_valid, window=window, softcap=softcap)
-        return _gqa_xla(q, k, v, pos0, kv_valid, window=window, softcap=softcap)
+            return _gqa_xla(
+                q, kd, vd, pos0, kv_valid, window=window, softcap=softcap, full_mask=full_mask
+            )
+        return _gqa_xla(
+            q, k, v, pos0, kv_valid, window=window, softcap=softcap, full_mask=full_mask
+        )
     if use_flash is None:
         from kakveda_tpu.ops.device import is_tpu_backend
 

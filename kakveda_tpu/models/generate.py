@@ -692,6 +692,7 @@ class LlamaRuntime:
                 "window": eng.cb.max_len,
                 "closed": eng._closed.is_set(),
                 "prefix": dict(eng.cb.prefix_stats),
+                "spec": dict(eng.cb.spec_stats) if eng.cb.spec_k else None,
             }
         return {
             "runtime": "tpu",

@@ -105,17 +105,18 @@ def _admit_jit(params, cfg: LlamaConfig, cache, last, prompt, slot, kv_valid, po
     return out, last
 
 
-@partial(jax.jit, static_argnames=("cfg", "n_steps"), donate_argnums=(2,))
-def _step_chunk_jit(params, cfg: LlamaConfig, cache, last, slot_pos, kv_valid, pos_offset, temps, rng, n_steps: int):
-    """Advance every slot by ``n_steps`` tokens in one program.
+def _forward_wide(params, cfg: LlamaConfig, cache_k, cache_v, cache_ks, cache_vs, tokens, slot_pos, kv_valid, pos_offset):
+    """THE serving-chunk forward body, S-wide with PER-SLOT positions:
+    token i of slot b writes cache row ``slot_pos[b]+i`` and attends rows
+    ``col <= slot_pos[b]+i`` (within kv_valid, and the sliding-window band
+    when the layer has one). Shared by the plain decode chunk (S=1 inside
+    a scan) and the speculative verify chunk (S=k+1) — ONE body to honor
+    model-family flags, not two. Attention goes through
+    ``gqa_cache_attention``: S=1 masks are expressible as [B, L] kv_valid
+    (keeping the flash / int8-streaming dispatch), S>1 passes the full
+    [B, S, L] mask (XLA path; S <= k+1 keeps its scratch tiny).
 
-    ``slot_pos`` [B] — per-slot NEXT cache index (prompt length + tokens
-    decoded so far). decode_step's scalar `pos` can't express per-slot
-    positions, so the chunk body re-implements the cached step with a
-    per-slot write index: token t of slot b lands at cache[b, :, slot_pos[b]+t].
-    ``temps`` [B] — per-slot sampling temperature; a slot with temp ≤ 0
-    decodes greedily, others sample categorically (one rng split per step,
-    shared across slots — rows are independent draws of the same key).
+    Returns (logits [B, S, V] vocab-masked f32, new_k, new_v, new_ks, new_vs).
     """
     from kakveda_tpu.models.attention import gqa_cache_attention
     from kakveda_tpu.models.llama import (
@@ -130,9 +131,101 @@ def _step_chunk_jit(params, cfg: LlamaConfig, cache, last, slot_pos, kv_valid, p
         wmat,
     )
 
-    b = last.shape[0]
+    b, s = tokens.shape
     hd = cfg.head_dim
-    max_len = cache["k"][0].shape[2]
+    max_len = cache_k[0].shape[2]
+    kq = cfg.kv_quant == "int8"
+
+    positions = slot_pos[:, None] + jnp.arange(s)[None, :] - pos_offset[:, None]
+    cos, sin = _rope_freqs(cfg, positions)
+    x = embed_tokens(params, cfg, tokens)
+
+    col = jnp.arange(max_len)[None, None, :]  # [1, 1, L]
+    qpos = (slot_pos[:, None] + jnp.arange(s)[None, :])[:, :, None]  # [B, S, 1]
+    base_mask = kv_valid[:, None, :] & (col <= qpos)  # [B, S, L]
+    win_mask = base_mask
+    if cfg.sliding_window:
+        win_mask = base_mask & (col > qpos - cfg.sliding_window)
+
+    rows = jnp.arange(b)[:, None]  # [B, 1]
+    wcols = slot_pos[:, None] + jnp.arange(s)[None, :]  # [B, S] write indices
+    new_k, new_v, new_ks, new_vs = [], [], [], []
+    for li in range(cfg.n_layers):
+        mask = win_mask if cfg.layer_window(li) else base_mask
+        layer = params["layers"][li]
+        h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+        dt = h.dtype
+        q, k, v = qkv_proj(h, layer, cfg, dt)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        # Per-slot scatter: row i of slot b lands at cache[b, :, slot_pos[b]+i]
+        # — a real scatter (in-place row writes), not a whole-cache rewrite;
+        # mode="drop" clamps overshoot past the window (discarded host-side).
+        k_rows = k.transpose(0, 2, 1, 3)  # [B, KV, S, D]
+        v_rows = v.transpose(0, 2, 1, 3)
+        ks_all = vs_all = None
+        if kq:
+            # Same per-row quantizer as decode_step, so a slot's cache
+            # bytes are identical to its solo decode — int8 parity is
+            # exact, not approximate-squared.
+            k_i8, k_sc = _kv_quant_rows(k_rows)
+            v_i8, v_sc = _kv_quant_rows(v_rows)
+            k_all = cache_k[li].at[rows, :, wcols].set(k_i8.transpose(0, 2, 1, 3), mode="drop")
+            v_all = cache_v[li].at[rows, :, wcols].set(v_i8.transpose(0, 2, 1, 3), mode="drop")
+            ks_all = cache_ks[li].at[rows, :, wcols].set(k_sc.transpose(0, 2, 1), mode="drop")
+            vs_all = cache_vs[li].at[rows, :, wcols].set(v_sc.transpose(0, 2, 1), mode="drop")
+            new_ks.append(ks_all)
+            new_vs.append(vs_all)
+        else:
+            k_all = cache_k[li].at[rows, :, wcols].set(
+                k_rows.transpose(0, 2, 1, 3).astype(cfg.dtype), mode="drop"
+            )
+            v_all = cache_v[li].at[rows, :, wcols].set(
+                v_rows.transpose(0, 2, 1, 3).astype(cfg.dtype), mode="drop"
+            )
+        new_k.append(k_all)
+        new_v.append(v_all)
+        if s == 1:
+            # [B, L] mask keeps the flash/int8-streaming dispatch;
+            # pos0=max_len makes the kernel's scalar causal mask a no-op.
+            attn = gqa_cache_attention(
+                q, k_all, v_all, jnp.asarray(max_len), mask[:, 0, :],
+                softcap=cfg.attn_softcap, k_scale=ks_all, v_scale=vs_all,
+            )
+        else:
+            attn = gqa_cache_attention(
+                q, k_all, v_all, jnp.asarray(max_len), None,
+                softcap=cfg.attn_softcap, k_scale=ks_all, v_scale=vs_all,
+                full_mask=mask,
+            )
+        attn = attn.reshape(b, s, cfg.n_heads * hd) @ wmat(layer["wo"], dt)
+        if "post_attn_norm" in layer:
+            attn = rms_norm(attn, layer["post_attn_norm"], cfg.norm_eps)
+        x = x + attn
+        h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+        m = mlp_block(h, layer, cfg)
+        if "post_ffw_norm" in layer:
+            m = rms_norm(m, layer["post_ffw_norm"], cfg.norm_eps)
+        x = x + m
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = (x @ wmat(params["lm_head"], cfg.dtype)).astype(jnp.float32)
+    logits = softcap_logits(logits, cfg.final_softcap)
+    logits = mask_pad_vocab(logits, cfg)
+    return logits, new_k, new_v, new_ks, new_vs
+
+
+@partial(jax.jit, static_argnames=("cfg", "n_steps"), donate_argnums=(2,))
+def _step_chunk_jit(params, cfg: LlamaConfig, cache, last, slot_pos, kv_valid, pos_offset, temps, rng, n_steps: int):
+    """Advance every slot by ``n_steps`` tokens in one program.
+
+    ``slot_pos`` [B] — per-slot NEXT cache index (prompt length + tokens
+    decoded so far). decode_step's scalar `pos` can't express per-slot
+    positions, so the chunk scans :func:`_forward_wide` at S=1 with a
+    per-slot write index: token t of slot b lands at cache[b, :, slot_pos[b]+t].
+    ``temps`` [B] — per-slot sampling temperature; a slot with temp <= 0
+    decodes greedily, others sample categorically (one rng split per step,
+    shared across slots — rows are independent draws of the same key).
+    """
     kq = cfg.kv_quant == "int8"
 
     def one_step(carry, _):
@@ -142,72 +235,11 @@ def _step_chunk_jit(params, cfg: LlamaConfig, cache, last, slot_pos, kv_valid, p
             sub, last / jnp.maximum(temps, 1e-6)[:, None], axis=-1
         )
         nxt = jnp.where(temps > 0.0, sampled, jnp.argmax(last, axis=-1))  # [B]
-        tokens = nxt[:, None].astype(jnp.int32)
-        positions = (slot_pos - pos_offset)[:, None]  # logical positions
-        cos, sin = _rope_freqs(cfg, positions)
-        x = embed_tokens(params, cfg, tokens)
-        new_k, new_v, new_ks, new_vs = [], [], [], []
-        # Validity for reads this step: slots < own write index, plus self.
-        # A sliding window (Mistral) folds in here — the query's slot index
-        # IS slot_pos[b], so the band is (slot_pos − window, slot_pos].
-        # Alternating windows (Gemma-2) need per-layer masks.
-        col = jnp.arange(max_len)[None, :]
-        base_valid = kv_valid & (col <= slot_pos[:, None])
-        windowed_valid = base_valid
-        if cfg.sliding_window:
-            windowed_valid = base_valid & (col > (slot_pos[:, None] - cfg.sliding_window))
-        for li in range(cfg.n_layers):
-            step_valid = windowed_valid if cfg.layer_window(li) else base_valid
-            layer = params["layers"][li]
-            h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
-            dt = h.dtype
-            q, k, v = qkv_proj(h, layer, cfg, dt)
-            q = apply_rope(q, cos, sin)
-            k = apply_rope(k, cos, sin)
-            # Per-slot scatter: k[b] -> cache_k[li][b, :, slot_pos[b]] —
-            # a real scatter (in-place row writes), not a whole-cache
-            # rewrite via one-hot blending.
-            kh = k.transpose(0, 2, 1, 3)[:, :, 0, :]  # [B, KV, D]
-            vh = v.transpose(0, 2, 1, 3)[:, :, 0, :]
-            rows = jnp.arange(b)
-            ks_all = vs_all = None
-            if kq:
-                # Same per-row quantizer as decode_step, so a slot's cache
-                # bytes are identical to its solo decode — int8 parity is
-                # exact, not approximate-squared.
-                k_i8, k_sc = _kv_quant_rows(kh)
-                v_i8, v_sc = _kv_quant_rows(vh)
-                k_all = cache_k[li].at[rows, :, slot_pos, :].set(k_i8, mode="drop")
-                v_all = cache_v[li].at[rows, :, slot_pos, :].set(v_i8, mode="drop")
-                ks_all = cache_ks[li].at[rows, :, slot_pos].set(k_sc, mode="drop")
-                vs_all = cache_vs[li].at[rows, :, slot_pos].set(v_sc, mode="drop")
-                new_ks.append(ks_all)
-                new_vs.append(vs_all)
-            else:
-                k_all = cache_k[li].at[rows, :, slot_pos, :].set(kh.astype(cfg.dtype), mode="drop")
-                v_all = cache_v[li].at[rows, :, slot_pos, :].set(vh.astype(cfg.dtype), mode="drop")
-            new_k.append(k_all)
-            new_v.append(v_all)
-            # Attention over the slot's valid prefix. pos0=max_len makes the
-            # kernel's scalar causal mask a no-op; step_valid does the work.
-            attn = gqa_cache_attention(
-                q, k_all, v_all, jnp.asarray(max_len), step_valid,
-                softcap=cfg.attn_softcap, k_scale=ks_all, v_scale=vs_all,
-            )
-            attn = attn.reshape(b, 1, cfg.n_heads * hd) @ wmat(layer["wo"], dt)
-            if "post_attn_norm" in layer:
-                attn = rms_norm(attn, layer["post_attn_norm"], cfg.norm_eps)
-            x = x + attn
-            h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
-            m = mlp_block(h, layer, cfg)
-            if "post_ffw_norm" in layer:
-                m = rms_norm(m, layer["post_ffw_norm"], cfg.norm_eps)
-            x = x + m
-        x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-        logits = (x @ wmat(params["lm_head"], cfg.dtype)).astype(jnp.float32)[:, -1, :]
-        logits = softcap_logits(logits, cfg.final_softcap)
-        logits = mask_pad_vocab(logits, cfg)
-        return (new_k, new_v, new_ks, new_vs, logits, slot_pos + 1, rng), nxt
+        logits, new_k, new_v, new_ks, new_vs = _forward_wide(
+            params, cfg, cache_k, cache_v, cache_ks, cache_vs,
+            nxt[:, None].astype(jnp.int32), slot_pos, kv_valid, pos_offset,
+        )
+        return (new_k, new_v, new_ks, new_vs, logits[:, -1, :], slot_pos + 1, rng), nxt
 
     init = (
         cache["k"], cache["v"],
@@ -221,6 +253,48 @@ def _step_chunk_jit(params, cfg: LlamaConfig, cache, last, slot_pos, kv_valid, p
     if kq:
         out["ks"], out["vs"] = cks, cvs
     return out, last, slot_pos, rng, toks.T  # [B, n_steps]
+
+
+@partial(jax.jit, static_argnames=("cfg", "k"), donate_argnums=(2,))
+def _spec_chunk_jit(params, cfg: LlamaConfig, cache, last, slot_pos, kv_valid, pos_offset, drafts, k: int):
+    """Speculative verify chunk: each slot advances 1..k+1 GREEDY tokens in
+    ONE :func:`_forward_wide` pass over k+1 positions.
+
+    ``drafts`` [B, k] are host-side prompt-lookup guesses for the tokens
+    AFTER the committed next token t0 (= argmax(last), computed in-program
+    so every chunk emits >= 1 token). The k+1-wide forward writes all rows
+    and produces logits at every position; the accepted prefix is the run
+    of drafts matching their own greedy verdicts. Rows written past the
+    accepted point hold K/V of rejected tokens — never read (validity is
+    bounded by each query's own position) and overwritten as real decoding
+    reaches them, the same clamp-and-discard contract as pipelined
+    overshoot. Decode is weight-bandwidth-bound, so the k+1-wide forward
+    rides the SAME weight stream as a 1-wide step — accepted tokens are
+    nearly free (models/speculative.py measures 1.3-1.7 tokens/round on
+    judge-shaped traffic).
+
+    Returns (cache, new_last [B,V], new_slot_pos [B], toks [B, k+1],
+    counts [B]) — the host emits ``toks[b, :counts[b]]``.
+    """
+    kq = cfg.kv_quant == "int8"
+    t0 = jnp.argmax(last, axis=-1).astype(jnp.int32)  # [B]
+    tokens = jnp.concatenate([t0[:, None], drafts.astype(jnp.int32)], axis=1)  # [B, k+1]
+    logits, new_k, new_v, new_ks, new_vs = _forward_wide(
+        params, cfg, cache["k"], cache["v"],
+        cache.get("ks", []), cache.get("vs", []),
+        tokens, slot_pos, kv_valid, pos_offset,
+    )
+    new_cache = {"pos": cache["pos"], "k": new_k, "v": new_v}
+    if kq:
+        new_cache["ks"], new_cache["vs"] = new_ks, new_vs
+
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, k+1]; [b, i] follows tokens[b, :i+1]
+    match = (drafts.astype(jnp.int32) == greedy[:, :-1]).astype(jnp.int32)  # [B, k]
+    m_acc = jnp.sum(jnp.cumprod(match, axis=1), axis=1)  # [B] accepted drafts
+    counts = m_acc + 1  # emitted = t0 + accepted drafts
+    # Next chunk's `last` = logits after the final emitted token.
+    new_last = jnp.take_along_axis(logits, m_acc[:, None, None], axis=1)[:, 0, :]
+    return new_cache, new_last, slot_pos + counts, tokens, counts
 
 
 @partial(jax.jit, static_argnames=("cfg",), donate_argnums=(2,))
@@ -290,6 +364,9 @@ class _Slot:
     # each chunk. MUST be fast/non-blocking (queue put) — it runs on the
     # engine loop thread between device dispatches.
     on_tokens: Optional[object] = None
+    # Prompt ids retained for host-side speculative drafting (prompt +
+    # out = the lookup corpus).
+    prompt_ids: List[int] = field(default_factory=list)
 
 
 class ContinuousBatcher:
@@ -306,10 +383,13 @@ class ContinuousBatcher:
         chunk_steps: int = 8,
         eos_id: Optional[int] = None,
         rng: Optional[jax.Array] = None,
+        spec_k: int = 0,
     ):
         self.params, self.cfg = params, cfg
         self.B, self.max_len = batch_slots, max_len
         self.chunk_steps = chunk_steps
+        self.spec_k = spec_k
+        self.spec_stats = {"chunks": 0, "emitted": 0, "slot_chunks": 0}
         self.eos_id = eos_id
         self.cache = init_cache(cfg, batch=batch_slots, max_len=max_len)
         self.last = jnp.full((batch_slots, cfg.vocab_size), -1e30, jnp.float32)
@@ -474,7 +554,8 @@ class ContinuousBatcher:
                 jnp.asarray(self._kv_np.copy()), jnp.asarray(self._off_np.copy()),
             )
         self.slots[slot] = _Slot(
-            req_id=rid, prompt_len=bucket, max_new=max_new_tokens, on_tokens=on_tokens
+            req_id=rid, prompt_len=bucket, max_new=max_new_tokens, on_tokens=on_tokens,
+            prompt_ids=list(prompt_ids),
         )
         return rid
 
@@ -494,17 +575,7 @@ class ContinuousBatcher:
         to the unpipelined path."""
         if not self.slots:
             return None
-        # Grow validity on the host mirror (vectorized over slots): each
-        # active slot may read its next chunk of rows as it writes them
-        # (enforced per-step by step_valid inside the chunk program). The
-        # left-pad region [0, pos_offset) stays invalid. One [B, L] upload
-        # per chunk replaces per-slot device scatters.
-        ar = np.arange(self.max_len)[None, :]
-        active = np.zeros((self.B,), bool)
-        active[list(self.slots)] = True
-        limit = (self._pos_np + self.chunk_steps)[:, None]
-        grow = active[:, None] & (ar >= self._off_np[:, None]) & (ar < limit)
-        self._kv_np |= grow
+        self._grow_valid(self.chunk_steps)
 
         self.cache, self.last, _, self.rng, toks = _step_chunk_jit(
             self.params, self.cfg, self.cache, self.last, jnp.asarray(self._pos_np.copy()),
@@ -533,30 +604,100 @@ class ContinuousBatcher:
         for slot, st in snapshot.items():
             if st.done:
                 continue  # retired by an earlier chunk; these are overshoot tokens
-            n_before = len(st.out)
-            for t in toks_h[slot]:
-                t = int(t)
-                if self.eos_id is not None and t == self.eos_id:
-                    st.done = True
-                    break
-                st.out.append(t)
-                if len(st.out) >= st.max_new or st.prompt_len + len(st.out) + 1 >= self.max_len:
-                    st.done = True
-                    break
-            if st.on_tokens is not None:
-                # Streaming: surface this chunk's accepted tokens as they
-                # land. Exceptions must not kill the engine loop — a gone
-                # stream consumer just stops receiving.
-                try:
-                    st.on_tokens(st.out[n_before:], st.done)
-                except Exception:  # noqa: BLE001
-                    st.on_tokens = None
-            if st.done:
-                self.results[st.req_id] = st.out
-                finished.append(st.req_id)
-                del self.slots[slot]
-                self.free.append(slot)
-                self._kv_np[slot] = False
+            self._emit(slot, st, toks_h[slot], finished)
+        return finished
+
+    def _emit(self, slot: int, st: _Slot, tok_row, finished: List[int]) -> None:
+        """Accept a chunk's tokens into a slot (EOS / budget / window stops),
+        fire the streaming callback, retire when done. Shared by the plain
+        chunk path and the speculative path."""
+        n_before = len(st.out)
+        for t in tok_row:
+            t = int(t)
+            if self.eos_id is not None and t == self.eos_id:
+                st.done = True
+                break
+            st.out.append(t)
+            if len(st.out) >= st.max_new or st.prompt_len + len(st.out) + 1 >= self.max_len:
+                st.done = True
+                break
+        if st.on_tokens is not None:
+            # Streaming: surface this chunk's accepted tokens as they
+            # land. Exceptions must not kill the engine loop — a gone
+            # stream consumer just stops receiving.
+            try:
+                st.on_tokens(st.out[n_before:], st.done)
+            except Exception:  # noqa: BLE001
+                st.on_tokens = None
+        if st.done:
+            self.results[st.req_id] = st.out
+            finished.append(st.req_id)
+            del self.slots[slot]
+            self.free.append(slot)
+            self._kv_np[slot] = False
+
+    def _grow_valid(self, steps: int) -> None:
+        """Grow read-validity on the host mirror (vectorized over slots):
+        each active slot may read its next ``steps`` rows as it writes
+        them (reads stay bounded per-step by ``col <= slot_pos`` inside
+        the chunk program). The left-pad region [0, pos_offset) stays
+        invalid. One [B, L] upload per chunk replaces per-slot device
+        scatters. ONE definition for both chunk flavors — the invariant
+        must not fork."""
+        ar = np.arange(self.max_len)[None, :]
+        active = np.zeros((self.B,), bool)
+        active[list(self.slots)] = True
+        limit = (self._pos_np + steps)[:, None]
+        self._kv_np |= active[:, None] & (ar >= self._off_np[:, None]) & (ar < limit)
+
+    @staticmethod
+    def _draft(hist: List[int], k: int) -> List[int]:
+        """Prompt-lookup draft (host side): find the most recent earlier
+        occurrence of the last token and copy what followed it, SHIFTED by
+        one — the verify chunk's first position is the committed token t0
+        (known only on device), so drafts guess t0's continuation. PAD (0)
+        fills when history gives nothing; wrong drafts cost nothing extra
+        (the verify forward runs k+1 wide either way)."""
+        if not hist:
+            return [0] * k
+        t = hist[-1]
+        for j in range(len(hist) - 2, -1, -1):
+            if hist[j] == t:
+                d = hist[j + 2 : j + 2 + k]
+                return d + [0] * (k - len(d))
+        return [0] * k
+
+    def step_spec(self) -> List[int]:
+        """One speculative verify chunk for every active slot (greedy pools
+        only — the engine falls back to plain chunks when any active slot
+        samples). Synchronous: per-slot acceptance counts must reach the
+        host before the next dispatch, so this path trades the pipelining
+        RTT overlap for 1..k+1 tokens per weight stream."""
+        if not self.slots:
+            return []
+        k = self.spec_k
+        drafts = np.zeros((self.B, k), np.int32)
+        for slot, st in self.slots.items():
+            drafts[slot] = self._draft(st.prompt_ids + st.out, k)
+        self._grow_valid(k + 1)
+        self.cache, self.last, _, toks, counts = _spec_chunk_jit(
+            self.params, self.cfg, self.cache, self.last,
+            jnp.asarray(self._pos_np.copy()), jnp.asarray(self._kv_np.copy()),
+            jnp.asarray(self._off_np.copy()), jnp.asarray(drafts), k,
+        )
+        toks_h = np.asarray(toks)
+        counts_h = np.asarray(counts).astype(np.int32)
+        # Every slot's mirror advances by ITS emitted count (inactive slots
+        # drift harmlessly — admission resets their position, exactly as
+        # with the lockstep += chunk_steps of the plain path).
+        self._pos_np += counts_h
+        finished: List[int] = []
+        self.spec_stats["chunks"] += 1
+        for slot, st in list(self.slots.items()):
+            n = int(counts_h[slot])
+            self.spec_stats["emitted"] += n
+            self.spec_stats["slot_chunks"] += 1
+            self._emit(slot, st, toks_h[slot][:n], finished)
         return finished
 
     def step(self) -> List[int]:
@@ -614,10 +755,13 @@ class ServingEngine:
         chunk_steps: int = 8,
         eos_id: Optional[int] = None,
         rng: Optional[jax.Array] = None,
+        spec_k: Optional[int] = None,
     ):
+        if spec_k is None:
+            spec_k = int(os.environ.get("KAKVEDA_SERVE_SPEC", "0"))
         self.cb = ContinuousBatcher(
             params, cfg, batch_slots=batch_slots, max_len=max_len,
-            chunk_steps=chunk_steps, eos_id=eos_id, rng=rng,
+            chunk_steps=chunk_steps, eos_id=eos_id, rng=rng, spec_k=spec_k,
         )
         self._q: "queue.Queue[Tuple[List[int], int, float, Future]]" = queue.Queue()
         self._closed = threading.Event()
@@ -757,17 +901,39 @@ class ServingEngine:
                         self._admit_one(self._q.get_nowait())
                     except queue.Empty:
                         break
-                if self.cb.slots:
+                use_spec = (
+                    self.cb.spec_k > 0
+                    and self.cb.slots
+                    and all(
+                        self.cb._temp_np[slot] <= 0.0 for slot in self.cb.slots
+                    )
+                )
+                if use_spec:
+                    # Speculative verify chunks are synchronous (per-slot
+                    # acceptance must reach the host before the next
+                    # dispatch): drain any pipelined handle first, then
+                    # advance every greedy slot 1..k+1 tokens in one
+                    # weight stream.
+                    finished = self.cb.process_chunk(pending_handle)
+                    pending_handle = None
+                    if self.cb.slots:
+                        self.stats["max_active"] = max(
+                            self.stats["max_active"], self.cb.active
+                        )
+                        finished += self.cb.step_spec()
+                        self.stats["chunks"] += 1
+                elif self.cb.slots:
                     self.stats["max_active"] = max(self.stats["max_active"], self.cb.active)
                     handle = self.cb.step_async()
                     self.stats["chunks"] += 1
-                else:
-                    handle = None
-                if not pipelined:
-                    finished = self.cb.process_chunk(handle)
+                    if not pipelined:
+                        finished = self.cb.process_chunk(handle)
+                    else:
+                        finished = self.cb.process_chunk(pending_handle)
+                        pending_handle = handle
                 else:
                     finished = self.cb.process_chunk(pending_handle)
-                    pending_handle = handle
+                    pending_handle = None
                 for rid in finished:
                     self.stats["completed"] += 1
                     fut = self._pend.pop(rid, None)
