@@ -702,7 +702,13 @@ class ContinuousBatcher:
 
     def step(self) -> List[int]:
         """One decode chunk for every active slot; returns req_ids finished
-        in this chunk (their token lists land in ``results``)."""
+        in this chunk (their token lists land in ``results``). With
+        ``spec_k`` set and an all-greedy pool this IS a speculative verify
+        chunk — ONE dispatch rule for step()/run_all/engine callers."""
+        if self.spec_k and self.slots and all(
+            self._temp_np[s] <= 0.0 for s in self.slots
+        ):
+            return self.step_spec()
         return self.process_chunk(self.step_async())
 
     def run_all(self, prompts: List[List[int]], max_new_tokens: int = 64) -> List[List[int]]:
