@@ -72,6 +72,14 @@ while true; do
         else
             set_status "probing (last attempt: bench wedged/outage at $(date -u +%FT%TZ))"
             log "bench did not complete (outage mid-run?); partial preserved, will retry"
+            # Preserve whatever the wedged sweep DID measure as a tracked
+            # artifact (the outage JSON also carries it, but this survives
+            # even if the process died before printing).
+            if [ -s .bench_partial.json ]; then
+                cp .bench_partial.json artifacts/bench_partial_last.json
+                commit_paths "Partial hardware sweep captured before mid-run outage" \
+                    artifacts/bench_partial_last.json
+            fi
             sleep 60
         fi
     else
