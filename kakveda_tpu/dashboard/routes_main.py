@@ -564,6 +564,23 @@ def setup(app: web.Application) -> None:
             result=None,
         )
 
+    def record_playground_run(trace_id, t0, t1, prompt, text, provider, model, latency_ms, span, meta):
+        """One trace_runs row + span for a playground invocation — shared by
+        the blocking and streaming endpoints (same table shape, same cost
+        accounting)."""
+        tokens_in, tokens_out = estimate_tokens(prompt), estimate_tokens(text)
+        ctx.db.execute(
+            "INSERT OR IGNORE INTO trace_runs (trace_id, ts, app_id, agent_id, prompt,"
+            " response, provider, model, latency_ms, tokens_in, tokens_out,"
+            " cost_micro_usd, status) VALUES (?,?,?,?,?,?,?,?,?,?,?,?,'ok')",
+            (
+                trace_id, t0, "playground", provider, prompt, text, provider,
+                model, latency_ms, tokens_in, tokens_out,
+                estimate_cost_micro_usd(tokens_in, tokens_out),
+            ),
+        )
+        ctx.db.add_span(trace_id, span, t0, t1, meta=meta)
+
     @require_roles("admin", "operator")
     async def playground_stream(request):
         """Server-sent-events streaming generation: text deltas reach the
@@ -637,20 +654,11 @@ def setup(app: web.Application) -> None:
         finally:
             await task
         if text:
-            trace_id = new_trace_id()
             t1 = time.time()
-            tokens_in, tokens_out = estimate_tokens(prompt), estimate_tokens(text)
-            ctx.db.execute(
-                "INSERT OR IGNORE INTO trace_runs (trace_id, ts, app_id, agent_id, prompt,"
-                " response, provider, model, latency_ms, tokens_in, tokens_out,"
-                " cost_micro_usd, status) VALUES (?,?,?,?,?,?,?,?,?,?,?,?,'ok')",
-                (
-                    trace_id, t0, "playground", "tpu", prompt, text, "tpu",
-                    chosen, int((t1 - t0) * 1000), tokens_in, tokens_out,
-                    estimate_cost_micro_usd(tokens_in, tokens_out),
-                ),
+            record_playground_run(
+                new_trace_id(), t0, t1, prompt, text, "tpu", chosen,
+                int((t1 - t0) * 1000), "playground.stream", {"streamed": True},
             )
-            ctx.db.add_span(trace_id, "playground.stream", t0, t1, meta={"streamed": True})
         await resp.write_eof()
         return resp
 
@@ -703,27 +711,10 @@ def setup(app: web.Application) -> None:
                 text = f"model error: {e}"
                 meta = {"provider": "error", "model": chosen, "error": str(e)}
         t1 = time.time()
-        tokens_in, tokens_out = estimate_tokens(prompt), estimate_tokens(text)
-        ctx.db.execute(
-            "INSERT OR IGNORE INTO trace_runs (trace_id, ts, app_id, agent_id, prompt, response,"
-            " provider, model, latency_ms, tokens_in, tokens_out, cost_micro_usd, status)"
-            " VALUES (?,?,?,?,?,?,?,?,?,?,?,?,'ok')",
-            (
-                trace_id,
-                t0,
-                "playground",
-                meta.get("provider"),
-                prompt,
-                text,
-                meta.get("provider"),
-                meta.get("model"),
-                meta.get("latency_ms", int((t1 - t0) * 1000)),
-                tokens_in,
-                tokens_out,
-                estimate_cost_micro_usd(tokens_in, tokens_out),
-            ),
+        record_playground_run(
+            trace_id, t0, t1, prompt, text, meta.get("provider"), meta.get("model"),
+            meta.get("latency_ms", int((t1 - t0) * 1000)), "playground.run", meta,
         )
-        ctx.db.add_span(trace_id, "playground.run", t0, t1, meta=meta)
         if experiment:
             exp = ctx.db.one("SELECT id FROM experiments WHERE name=?", (experiment,))
             if exp:

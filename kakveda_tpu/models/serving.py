@@ -700,14 +700,23 @@ class ContinuousBatcher:
             self._emit(slot, st, toks_h[slot][:n], finished)
         return finished
 
+    def spec_ready(self) -> bool:
+        """True when the next chunk should be a speculative verify chunk:
+        spec enabled and every active slot greedy. THE predicate for both
+        step() and the engine loop (which needs it separately to drain its
+        pipelined handle before going synchronous)."""
+        return bool(
+            self.spec_k
+            and self.slots
+            and all(self._temp_np[s] <= 0.0 for s in self.slots)
+        )
+
     def step(self) -> List[int]:
         """One decode chunk for every active slot; returns req_ids finished
         in this chunk (their token lists land in ``results``). With
         ``spec_k`` set and an all-greedy pool this IS a speculative verify
         chunk — ONE dispatch rule for step()/run_all/engine callers."""
-        if self.spec_k and self.slots and all(
-            self._temp_np[s] <= 0.0 for s in self.slots
-        ):
+        if self.spec_ready():
             return self.step_spec()
         return self.process_chunk(self.step_async())
 
@@ -907,14 +916,7 @@ class ServingEngine:
                         self._admit_one(self._q.get_nowait())
                     except queue.Empty:
                         break
-                use_spec = (
-                    self.cb.spec_k > 0
-                    and self.cb.slots
-                    and all(
-                        self.cb._temp_np[slot] <= 0.0 for slot in self.cb.slots
-                    )
-                )
-                if use_spec:
+                if self.cb.spec_ready():
                     # Speculative verify chunks are synchronous (per-slot
                     # acceptance must reach the host before the next
                     # dispatch): drain any pipelined handle first, then

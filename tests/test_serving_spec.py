@@ -33,20 +33,11 @@ def _solo(params, cfg, n=12):
 
 
 def test_spec_chunk_parity_multi_slot():
+    """run_all's step() dispatches to verify chunks for a greedy pool."""
     params = init_params(jax.random.PRNGKey(0), CFG)
     solo = _solo(params, CFG)
     cb = ContinuousBatcher(params, CFG, batch_slots=2, max_len=128, chunk_steps=4, spec_k=4)
-    rids = {}
-    pending = list(enumerate(PROMPTS))
-    while pending or cb.slots:
-        while pending and cb.free:
-            i, p = pending.pop(0)
-            rids[cb.admit(p, max_new_tokens=12)] = i
-        cb.step_spec()
-    outs = [None] * len(PROMPTS)
-    for rid, i in rids.items():
-        outs[i] = cb.results[rid]
-    assert outs == solo
+    assert cb.run_all(PROMPTS, max_new_tokens=12) == solo
     assert cb.spec_stats["chunks"] > 0
     # Every chunk emits at least one token per active slot.
     assert cb.spec_stats["emitted"] >= cb.spec_stats["slot_chunks"]
@@ -77,17 +68,7 @@ def test_spec_parity_int8_kv():
     params = init_params(jax.random.PRNGKey(2), cfg)
     solo = _solo(params, cfg, n=8)
     cb = ContinuousBatcher(params, cfg, batch_slots=2, max_len=128, chunk_steps=4, spec_k=4)
-    rids = {}
-    pending = list(enumerate(PROMPTS))
-    while pending or cb.slots:
-        while pending and cb.free:
-            i, p = pending.pop(0)
-            rids[cb.admit(p, max_new_tokens=8)] = i
-        cb.step_spec()
-    outs = [None] * len(PROMPTS)
-    for rid, i in rids.items():
-        outs[i] = cb.results[rid]
-    assert outs == solo
+    assert cb.run_all(PROMPTS, max_new_tokens=8) == solo
 
 
 def test_spec_parity_sliding_window():
@@ -98,17 +79,7 @@ def test_spec_parity_sliding_window():
     params = init_params(jax.random.PRNGKey(3), cfg)
     solo = _solo(params, cfg, n=10)
     cb = ContinuousBatcher(params, cfg, batch_slots=2, max_len=128, chunk_steps=4, spec_k=4)
-    rids = {}
-    pending = list(enumerate(PROMPTS))
-    while pending or cb.slots:
-        while pending and cb.free:
-            i, p = pending.pop(0)
-            rids[cb.admit(p, max_new_tokens=10)] = i
-        cb.step_spec()
-    outs = [None] * len(PROMPTS)
-    for rid, i in rids.items():
-        outs[i] = cb.results[rid]
-    assert outs == solo
+    assert cb.run_all(PROMPTS, max_new_tokens=10) == solo
 
 
 def test_engine_spec_greedy_and_sampled_fallback(monkeypatch):
