@@ -245,7 +245,30 @@ def setup(app: web.Application) -> None:
         stats = ctx.model.serving_stats() if hasattr(ctx.model, "serving_stats") else {
             "runtime": getattr(ctx.model, "name", "unknown"), "engine": None,
         }
-        return ctx.render(request, "admin_serving.html", stats=stats)
+        return ctx.render(
+            request, "admin_serving.html", stats=stats,
+            prefix_result=request.query.get("prefix", ""),
+            can_register_prefix=callable(getattr(ctx.model, "register_prefix", None)),
+        )
+
+    @require_roles("admin")
+    async def admin_serving_prefix(request):
+        """Register a shared prompt prefix (system preamble) on the serving
+        engine from the ops panel — later requests starting with it
+        prefill only their suffix (models/serving.py prefix cache)."""
+        from kakveda_tpu.dashboard.routes_main import off_loop
+
+        form = await request.post()
+        text = str(form.get("prefix") or "").strip()
+        reg = getattr(ctx.model, "register_prefix", None)
+        if not text or not callable(reg):
+            raise web.HTTPFound("/admin/serving?prefix=unsupported")
+        ok = await off_loop(reg, text)
+        ctx.db.audit(
+            request["user"].email, "serving.prefix_register",
+            {"chars": len(text), "accepted": bool(ok)},
+        )
+        raise web.HTTPFound(f"/admin/serving?prefix={'registered' if ok else 'refused'}")
 
     @require_roles("admin")
     async def admin_agent_delete(request):
@@ -533,6 +556,7 @@ def setup(app: web.Application) -> None:
             web.post("/admin/purge-demo", admin_purge_demo),
             web.get("/admin/agents", admin_agents_page),
             web.get("/admin/serving", admin_serving_page),
+            web.post("/admin/serving/prefix", admin_serving_prefix),
             web.post("/admin/agents/delete", admin_agent_delete),
             web.get("/admin/agents/{name}/test", admin_agent_test),
             web.get("/agents", agents_page),

@@ -188,6 +188,52 @@ def test_generate_batch_auto_registers_common_head(monkeypatch):
         rt.retire()
 
 
+def test_admin_prefix_registration(tmp_path, monkeypatch):
+    """The ops panel registers a prefix on the live engine and the stats
+    row reflects it; runtimes without support get 'unsupported'."""
+    import asyncio
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from kakveda_tpu.dashboard.app import make_dashboard_app
+    from kakveda_tpu.dashboard.core import RATE_LIMITER
+    from kakveda_tpu.models.generate import LlamaRuntime
+    from kakveda_tpu.platform import Platform
+
+    monkeypatch.setenv("KAKVEDA_SERVE_CONTINUOUS", "1")
+    RATE_LIMITER._hits.clear()
+    rt = LlamaRuntime(cfg=CFG, seed=0)
+    plat = Platform(data_dir=tmp_path / "data", capacity=256, dim=1024)
+    app = make_dashboard_app(platform=plat, db_path=tmp_path / "dash.db", model=rt)
+
+    async def go():
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            r = await client.post(
+                "/login",
+                data={"email": "admin@local", "password": "admin123", "next": "/"},
+                allow_redirects=False,
+            )
+            assert r.status == 302
+            body = await (await client.get("/admin/serving")).text()
+            assert "Register a serving prefix" in body
+            r = await client.post(
+                "/admin/serving/prefix",
+                data={"prefix": "The shared system preamble for all requests. "},
+                allow_redirects=False,
+            )
+            assert r.status == 302 and "registered" in r.headers["Location"]
+            # The engine exists now and holds the prefix.
+            assert rt._engine is not None
+            assert rt._engine.cb.prefix_stats["registered"] == 1
+        finally:
+            await client.close()
+
+    asyncio.run(go())
+    rt.retire()
+
+
 def test_prefix_disabled_by_env(monkeypatch):
     monkeypatch.setenv("KAKVEDA_SERVE_PREFIX", "0")
     params = init_params(jax.random.PRNGKey(0), CFG)
