@@ -344,6 +344,16 @@ def _admit_prefix_jit(
     return out, last
 
 
+@partial(jax.jit, static_argnames=("cfg",))
+def _prefix_prefill_jit(params, cfg: LlamaConfig, ids):
+    """One compiled prefill for prefix registration ([1, plen] exact-length
+    cache). Eager decode_step here would pay a per-op dispatch — thousands
+    of ~80 ms round trips on a tunneled chip — for what is one program."""
+    scratch = init_cache(cfg, batch=1, max_len=ids.shape[1])
+    _, scratch = decode_step(params, cfg, ids, scratch, last_only=True)
+    return scratch
+
+
 @dataclass
 class _Prefix:
     """One registered shared prompt prefix: token ids + per-layer K/V slabs
@@ -444,10 +454,8 @@ class ContinuousBatcher:
             return False
         if ids in self._prefixes:
             return True
-        scratch = init_cache(self.cfg, batch=1, max_len=len(ids))
-        _, scratch = decode_step(
-            self.params, self.cfg, jnp.asarray([list(ids)], jnp.int32), scratch,
-            last_only=True,
+        scratch = _prefix_prefill_jit(
+            self.params, self.cfg, jnp.asarray([list(ids)], jnp.int32)
         )
         keys = ("k", "v") + (("ks", "vs") if self.cfg.kv_quant == "int8" else ())
         # Bounded store: auto-registration (generate_batch common heads)
