@@ -56,6 +56,17 @@ def _cmd_doctor(args: argparse.Namespace) -> int:
     def _jax():
         import jax
 
+        # Honor JAX_PLATFORMS before touching the backend: the image's
+        # sitecustomize pins jax to the remote accelerator via jax.config,
+        # and with the chip in an outage the claim loop BLOCKS (no
+        # exception for the fallback below to catch) — doctor would hang.
+        plat = os.environ.get("JAX_PLATFORMS", "")
+        if plat:
+            try:
+                jax.config.update("jax_platforms", plat.lower())
+            except Exception:  # noqa: BLE001 — best effort
+                pass
+
         try:
             backend = jax.default_backend()
             note = ""
@@ -100,6 +111,25 @@ def _cmd_doctor(args: argparse.Namespace) -> int:
             raise RuntimeError("production with default JWT secret — set DASHBOARD_JWT_SECRET")
         return "set" if rc.dashboard_jwt_secret != "dev-secret-change-me" else "dev default (fine outside production)"
 
+    def _serving_levers():
+        """The env-tunable serving configuration in one line — what the
+        engine will actually run with (models/serving.py knobs)."""
+        e = os.environ.get
+        parts = [
+            f"continuous={'on' if e('KAKVEDA_SERVE_CONTINUOUS', '1') != '0' else 'OFF'}",
+            f"slots={e('KAKVEDA_SERVE_SLOTS', '8')}",
+            f"window={e('KAKVEDA_SERVE_WINDOW', 'auto')}",
+            f"chunk={e('KAKVEDA_SERVE_CHUNK', '8')}",
+            f"pipeline={'on' if e('KAKVEDA_SERVE_PIPELINE', '1') != '0' else 'OFF'}",
+            f"prefix={'on' if e('KAKVEDA_SERVE_PREFIX', '1') != '0' else 'OFF'}",
+            f"spec_k={e('KAKVEDA_SERVE_SPEC', '0')}",
+            f"quant={e('KAKVEDA_QUANT', 'none')}",
+            f"kv_quant={e('KAKVEDA_KV_QUANT', 'none')}",
+        ]
+        if e("KAKVEDA_HBM_BUDGET"):
+            parts.append(f"hbm_budget={e('KAKVEDA_HBM_BUDGET')}")
+        return " ".join(parts)
+
     def _redis():
         url = os.environ.get("KAKVEDA_REDIS_URL")
         if not url:
@@ -118,6 +148,7 @@ def _cmd_doctor(args: argparse.Namespace) -> int:
     check("device compute", _device_compute)
     check("native extension", _native)
     check("config", lambda: str(Path(os.environ.get("KAKVEDA_CONFIG_PATH", "config/config.yaml")).resolve()))
+    check("serving levers", _serving_levers)
     check("config parse", _config_parse)
     check("jwt secret", _jwt_secret)
     check("redis", _redis)
