@@ -610,16 +610,29 @@ def setup(app: web.Application) -> None:
         ch: asyncio.Queue = asyncio.Queue()
         t0 = time.time()
 
+        import threading
+
+        cancelled = threading.Event()
+
         def pump():
             # Blocking generator runs in the executor; deltas hop to the
             # event loop thread-safely. The sentinel carries the outcome.
+            # On client disconnect the handler sets `cancelled`; closing
+            # the generator cancels the engine request (slot frees instead
+            # of decoding for nobody).
             try:
                 stream_fn = getattr(ctx.model, "generate_stream", None)
                 parts: list = []
                 if callable(stream_fn):
-                    for d in stream_fn(prompt, model=chosen):
-                        parts.append(d)
-                        loop.call_soon_threadsafe(ch.put_nowait, ("delta", d))
+                    gen = stream_fn(prompt, model=chosen)
+                    try:
+                        for d in gen:
+                            parts.append(d)
+                            loop.call_soon_threadsafe(ch.put_nowait, ("delta", d))
+                            if cancelled.is_set():
+                                break
+                    finally:
+                        gen.close()
                 else:
                     gen = ctx.model.generate(prompt, model=chosen)
                     parts.append(gen.text)
@@ -651,7 +664,10 @@ def setup(app: web.Application) -> None:
                         + b"\n\n"
                     )
                     break
+        except (ConnectionResetError, ConnectionError):
+            cancelled.set()  # client went away: stop generating for nobody
         finally:
+            cancelled.set()
             await task
         if text:
             t1 = time.time()

@@ -852,20 +852,27 @@ class LlamaRuntime:
             if fut is not None:
                 out: list = []
                 prev = ""
-                while True:
-                    try:
-                        new, done = ch.get(timeout=0.5)
-                    except _q.Empty:
-                        if fut.done():  # engine died mid-request
-                            fut.result()  # raises the loop's error
+                try:
+                    while True:
+                        try:
+                            new, done = ch.get(timeout=0.5)
+                        except _q.Empty:
+                            if fut.done():  # engine died mid-request
+                                fut.result()  # raises the loop's error
+                                break
+                            continue
+                        out.extend(new)
+                        d, prev = deltas(out, done, prev)
+                        if d:
+                            yield d
+                        if done:
                             break
-                        continue
-                    out.extend(new)
-                    d, prev = deltas(out, done, prev)
-                    if d:
-                        yield d
-                    if done:
-                        break
+                finally:
+                    # Abandoned mid-stream (consumer close() → GeneratorExit
+                    # lands at the yield): free the engine slot instead of
+                    # decoding a result nobody will read.
+                    if not fut.done():
+                        eng.cancel(fut)
                 return
 
         # Solo fallback: same chunked decode as _generate_ids_chunked, one

@@ -790,6 +790,7 @@ class ServingEngine:
         self._closed = threading.Event()
         self._submit_lock = threading.Lock()  # closes the submit/close race
         self._pend: Dict[int, Future] = {}  # loop-owned; close() fails leftovers
+        self._waiting: List = []  # loop-owned: admitted-when-a-slot-frees queue
         self.stats = {"submitted": 0, "completed": 0, "max_active": 0, "chunks": 0}
         self._thread = threading.Thread(target=self._loop, daemon=True, name="serving-engine")
         self._thread.start()
@@ -834,6 +835,19 @@ class ServingEngine:
         thread while the loop thread decodes for everyone at once."""
         return self.submit(prompt_ids, max_new_tokens, temperature).result()
 
+    def cancel(self, fut: Future) -> None:
+        """Best-effort cancel of a submitted request: if still queued, the
+        Future cancels; if mid-decode, the loop retires its slot at the
+        next chunk boundary (the slot frees for other traffic instead of
+        decoding a result nobody will read — the disconnect case). The
+        Future resolves with the tokens generated so far."""
+        if fut.cancel():
+            return  # never admitted; set_running_or_notify_cancel skips it
+        with self._submit_lock:
+            if self._closed.is_set():
+                return
+            self._q.put(("cancel", fut, fut))
+
     def register_prefix(self, prefix_ids: List[int], timeout: float = 120.0) -> bool:
         """Precompute a shared prompt prefix's K/V once; later submits whose
         prompts start with it prefill only their suffix. Runs on the loop
@@ -871,11 +885,40 @@ class ServingEngine:
             except queue.Empty:
                 break
             self._fail(fut, RuntimeError("ServingEngine closed"))
+        for item in self._waiting:
+            self._fail(item[-1], RuntimeError("ServingEngine closed"))
+        self._waiting.clear()
         for fut in list(self._pend.values()):
             self._fail(fut, RuntimeError("ServingEngine closed mid-request"))
         self._pend.clear()
 
     def _admit_one(self, item) -> None:
+        if item[0] == "cancel":
+            _, fut, _ = item
+            rid = next((r for r, f in self._pend.items() if f is fut), None)
+            if rid is None:
+                return  # already finished (or was never admitted)
+            for slot, st in list(self.cb.slots.items()):
+                if st.req_id == rid:
+                    # Retire now: partial tokens resolve the Future, the
+                    # slot re-enters the free list before the next chunk.
+                    # done=True first — a pipelined handle's snapshot still
+                    # holds this _Slot and must skip it as overshoot, never
+                    # double-retire a slot admission may have reused.
+                    st.done = True
+                    self.cb.results[rid] = st.out
+                    del self.cb.slots[slot]
+                    self.cb.free.append(slot)
+                    self.cb._kv_np[slot] = False
+                    break
+            self._pend.pop(rid, None)
+            toks = self.cb.results.pop(rid, [])
+            if not fut.done():
+                try:
+                    fut.set_result(toks)
+                except Exception:  # noqa: BLE001 — lost the race with completion
+                    pass
+            return
         if item[0] == "prefix":
             _, ids, fut = item
             if not fut.set_running_or_notify_cancel():
@@ -908,22 +951,32 @@ class ServingEngine:
         # overshoot chunk runs at the end of each busy period.
         pipelined = os.environ.get("KAKVEDA_SERVE_PIPELINE", "1") != "0"
         pending_handle = None
+
+        def pump_queue(block: bool) -> None:
+            # Control items (cancel, prefix registration) act immediately —
+            # a cancel matters MOST when the pool is full, so they must
+            # not wait behind the capacity gate. Generation requests wait
+            # in _waiting until a slot frees.
+            try:
+                while True:
+                    item = self._q.get(timeout=0.1) if block else self._q.get_nowait()
+                    block = False
+                    if item[0] in ("cancel", "prefix"):
+                        self._admit_one(item)
+                    else:
+                        self._waiting.append(item)
+            except queue.Empty:
+                pass
+            while self._waiting and self.cb.has_capacity:
+                self._admit_one(self._waiting.pop(0))
+
         try:
             while not self._closed.is_set():
-                if not self.cb.slots and pending_handle is None:
-                    # Idle: block for the next request (bounded so close()
-                    # is prompt) instead of spinning on an empty pool.
-                    try:
-                        self._admit_one(self._q.get(timeout=0.1))
-                    except queue.Empty:
-                        continue
-                # Admit everything already waiting while slots are free —
-                # new arrivals join mid-decode at the next chunk boundary.
-                while self.cb.has_capacity:
-                    try:
-                        self._admit_one(self._q.get_nowait())
-                    except queue.Empty:
-                        break
+                # Idle: block briefly for the next arrival (bounded so
+                # close() is prompt) instead of spinning on an empty pool.
+                pump_queue(
+                    block=not self.cb.slots and pending_handle is None and not self._waiting
+                )
                 if self.cb.spec_ready():
                     # Speculative verify chunks are synchronous (per-slot
                     # acceptance must reach the host before the next
@@ -967,6 +1020,9 @@ class ServingEngine:
             with self._submit_lock:
                 self._closed.set()
             err = RuntimeError(f"ServingEngine loop died: {type(e).__name__}: {e}")
+            for item in self._waiting:
+                self._fail(item[-1], err)
+            self._waiting.clear()
             for fut in list(self._pend.values()):
                 self._fail(fut, err)
             self._pend.clear()
