@@ -624,7 +624,10 @@ def setup(app: web.Application) -> None:
                 stream_fn = getattr(ctx.model, "generate_stream", None)
                 parts: list = []
                 if callable(stream_fn):
-                    gen = stream_fn(prompt, model=chosen)
+                    try:
+                        gen = stream_fn(prompt, model=chosen, cancel=cancelled)
+                    except TypeError:  # runtime without cancel support
+                        gen = stream_fn(prompt, model=chosen)
                     try:
                         for d in gen:
                             parts.append(d)

@@ -810,7 +810,8 @@ class LlamaRuntime:
         ]
 
     def generate_stream(
-        self, prompt: str, *, model: Optional[str] = None, max_tokens: int = 64
+        self, prompt: str, *, model: Optional[str] = None, max_tokens: int = 64,
+        cancel=None,
     ):
         """Streaming generation: yields text deltas as decode chunks land.
 
@@ -826,6 +827,11 @@ class LlamaRuntime:
         Capability beyond the reference: its playground blocks on a full
         Ollama response per request (services/dashboard/app.py:3127-3299);
         here first tokens reach the client after one decode chunk.
+
+        ``cancel`` (optional ``threading.Event``): set by the consumer on
+        client disconnect — observed BETWEEN deltas too (a request still
+        queued or mid-prefill cancels promptly, not only after its first
+        token arrives). Closing the generator has the same effect.
         """
         ids = self.tokenizer.encode(prompt)[-self.cfg.max_seq_len // 2 :]
 
@@ -857,6 +863,8 @@ class LlamaRuntime:
                         try:
                             new, done = ch.get(timeout=0.5)
                         except _q.Empty:
+                            if cancel is not None and cancel.is_set():
+                                break  # finally cancels the engine request
                             if fut.done():  # engine died mid-request
                                 fut.result()  # raises the loop's error
                                 break
@@ -892,6 +900,8 @@ class LlamaRuntime:
         budget = min(max_tokens, sess.steps_left)
         done = False
         while budget > 0 and not done:
+            if cancel is not None and cancel.is_set():
+                break  # abandoned: stop dispatching chunks
             chunk = sess.step_chunk(min(16, budget))
             if chunk is None:
                 break

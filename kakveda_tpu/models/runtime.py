@@ -146,12 +146,14 @@ class StubRuntime:
             },
         )
 
-    def generate_stream(self, prompt: str, *, model: Optional[str] = None, max_tokens: int = 256):
+    def generate_stream(self, prompt: str, *, model: Optional[str] = None, max_tokens: int = 256, cancel=None):
         """Deterministic chunked stream so the SSE path is exercisable with
         no hardware: the canned response arrives word by word, joining to
         exactly generate().text."""
         words = STUB_RESPONSE.split(" ")
         for i, w in enumerate(words):
+            if cancel is not None and cancel.is_set():
+                return
             yield w if i == len(words) - 1 else w + " "
 
 
@@ -411,11 +413,13 @@ class MultiModelRuntime:
     def generate_batch(self, prompts: list, *, model: Optional[str] = None, max_tokens: int = 256) -> list:
         return self._get(model).generate_batch(prompts, model=model, max_tokens=max_tokens)
 
-    def generate_stream(self, prompt: str, *, model: Optional[str] = None, max_tokens: int = 256):
+    def generate_stream(self, prompt: str, *, model: Optional[str] = None, max_tokens: int = 256, cancel=None):
         """Stream from the resolved model's runtime (SSE playground path).
         Default budget matches generate()/generate_batch here — a streamed
         answer must not silently truncate shorter than the blocking one."""
-        return self._get(model).generate_stream(prompt, model=model, max_tokens=max_tokens)
+        return self._get(model).generate_stream(
+            prompt, model=model, max_tokens=max_tokens, cancel=cancel
+        )
 
 
 _RUNTIMES: Dict[str, Any] = {}

@@ -596,9 +596,13 @@ class ContinuousBatcher:
         except Exception:  # noqa: BLE001 — backends without async copy
             pass
         # Slot refs (shared, not copied): a slot retired by an EARLIER
-        # handle's processing shows st.done here and its overshoot tokens
-        # are skipped. Slots are only freed/re-admitted in process_chunk,
-        # so a handle's snapshot can never alias a newer request.
+        # handle's processing — or by cancel_request between chunks —
+        # shows st.done here and its overshoot tokens are skipped. A
+        # freed slot re-admitted before this handle is processed gets a
+        # NEW _Slot object (the snapshot still holds the done one), and
+        # the admit scatter is ordered after the in-flight chunk by the
+        # functional cache threading — so a snapshot can never alias or
+        # corrupt a newer request.
         return toks, dict(self.slots)
 
     def process_chunk(self, handle) -> List[int]:
@@ -707,6 +711,22 @@ class ContinuousBatcher:
             self.spec_stats["slot_chunks"] += 1
             self._emit(slot, st, toks_h[slot][:n], finished)
         return finished
+
+    def cancel_request(self, rid: int) -> Optional[List[int]]:
+        """Retire a mid-decode request NOW (between chunks): returns its
+        partial tokens, frees the slot, and marks the _Slot done so a
+        stale pipelined snapshot skips it as overshoot. THE retirement
+        bookkeeping for cancellation — one definition, shared with the
+        normal retire tail in _emit. Returns None when the rid is not
+        active (already finished or never admitted)."""
+        for slot, st in list(self.slots.items()):
+            if st.req_id == rid:
+                st.done = True
+                del self.slots[slot]
+                self.free.append(slot)
+                self._kv_np[slot] = False
+                return st.out
+        return None
 
     def spec_ready(self) -> bool:
         """True when the next chunk should be a speculative verify chunk:
@@ -898,21 +918,10 @@ class ServingEngine:
             rid = next((r for r, f in self._pend.items() if f is fut), None)
             if rid is None:
                 return  # already finished (or was never admitted)
-            for slot, st in list(self.cb.slots.items()):
-                if st.req_id == rid:
-                    # Retire now: partial tokens resolve the Future, the
-                    # slot re-enters the free list before the next chunk.
-                    # done=True first — a pipelined handle's snapshot still
-                    # holds this _Slot and must skip it as overshoot, never
-                    # double-retire a slot admission may have reused.
-                    st.done = True
-                    self.cb.results[rid] = st.out
-                    del self.cb.slots[slot]
-                    self.cb.free.append(slot)
-                    self.cb._kv_np[slot] = False
-                    break
+            toks = self.cb.cancel_request(rid)
             self._pend.pop(rid, None)
-            toks = self.cb.results.pop(rid, [])
+            if toks is None:
+                toks = self.cb.results.pop(rid, [])  # finished between chunks
             if not fut.done():
                 try:
                     fut.set_result(toks)

@@ -107,6 +107,40 @@ def test_engine_cancel_queued_request():
         eng.close()
 
 
+def test_generate_stream_cancel_before_first_token(monkeypatch):
+    """A streaming request still WAITING for a slot (pool full, zero
+    deltas delivered) cancels promptly when the consumer sets the cancel
+    event — it must not sit until its first token arrives."""
+    import threading
+    import time as _time
+
+    from kakveda_tpu.models.generate import LlamaRuntime
+
+    monkeypatch.setenv("KAKVEDA_SERVE_CONTINUOUS", "1")
+    monkeypatch.setenv("KAKVEDA_SERVE_SLOTS", "1")
+    rt = LlamaRuntime(cfg=CFG, seed=0)
+    try:
+        eng = rt.engine()
+        blocker = eng.submit([5, 6, 7], 40)  # occupies the only slot
+        cancel_ev = threading.Event()
+        got: list = []
+
+        def consume():
+            for d in rt.generate_stream("queued then abandoned", max_tokens=10, cancel=cancel_ev):
+                got.append(d)
+
+        t = threading.Thread(target=consume)
+        t.start()
+        _time.sleep(1.0)  # let it enqueue behind the blocker
+        cancel_ev.set()
+        t.join(timeout=30)
+        assert not t.is_alive(), "stream consumer still blocked after cancel"
+        assert got == []  # never produced a token
+        assert len(blocker.result(timeout=120)) > 0  # slot owner unaffected
+    finally:
+        rt.retire()
+
+
 @pytest.mark.parametrize("continuous", ["1", "0"])
 def test_runtime_generate_stream_matches_generate(monkeypatch, continuous):
     """Joined deltas equal the blocking generate() text on BOTH paths —
