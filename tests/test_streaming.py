@@ -141,6 +141,54 @@ def test_generate_stream_cancel_before_first_token(monkeypatch):
         rt.retire()
 
 
+@pytest.mark.parametrize("seed", [0, 1])
+def test_engine_randomized_submit_cancel_stress(seed):
+    """Randomized interleaving of submits and cancels against the live
+    engine: every Future must resolve (result or CancelledError), the
+    slot pool must fully drain (free == B), and accounting must balance.
+    The slot-reuse/cancel/pipelining interactions this shakes out are
+    exactly the ones a deterministic test can't enumerate."""
+    import random
+    from concurrent.futures import CancelledError
+
+    rng = random.Random(seed)
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    eng = ServingEngine(params, CFG, batch_slots=2, max_len=64, chunk_steps=2)
+    futs = []
+    try:
+        for _ in range(24):
+            op = rng.random()
+            if op < 0.7 or not futs:
+                prompt = [rng.randrange(5, 250) for _ in range(rng.randrange(1, 9))]
+                futs.append(eng.submit(prompt, rng.randrange(4, 24)))
+            else:
+                eng.cancel(rng.choice(futs))
+            if rng.random() < 0.3:
+                import time as _time
+
+                _time.sleep(0.05)
+        results = 0
+        cancelled = 0
+        for f in futs:
+            try:
+                toks = f.result(timeout=300)
+                assert isinstance(toks, list)
+                results += 1
+            except CancelledError:
+                cancelled += 1
+        assert results + cancelled == len(futs)
+        # Pool fully drained: every slot back on the free list.
+        for _ in range(100):
+            if len(eng.cb.free) == eng.cb.B and not eng.cb.slots:
+                break
+            import time as _time
+
+            _time.sleep(0.1)
+        assert len(eng.cb.free) == eng.cb.B and not eng.cb.slots
+    finally:
+        eng.close()
+
+
 @pytest.mark.parametrize("continuous", ["1", "0"])
 def test_runtime_generate_stream_matches_generate(monkeypatch, continuous):
     """Joined deltas equal the blocking generate() text on BOTH paths —
