@@ -665,19 +665,36 @@ class ContinuousBatcher:
     @staticmethod
     def _draft(hist: List[int], k: int) -> List[int]:
         """Prompt-lookup draft (host side): find the most recent earlier
-        occurrence of the last token and copy what followed it, SHIFTED by
-        one — the verify chunk's first position is the committed token t0
-        (known only on device), so drafts guess t0's continuation. PAD (0)
-        fills when history gives nothing; wrong drafts cost nothing extra
-        (the verify forward runs k+1 wide either way)."""
-        if not hist:
+        occurrence of the LONGEST matching history suffix (3→2→1 tokens —
+        longer context anchors the copy in the right template region) and
+        copy what followed it, SHIFTED by one — the verify chunk's first
+        position is the committed token t0 (known only on device), so
+        drafts guess t0's continuation. PAD (0) fills when history gives
+        nothing; wrong drafts cost nothing extra (the verify forward runs
+        k+1 wide either way)."""
+        n = len(hist)
+        if n < 2:
             return [0] * k
-        t = hist[-1]
-        for j in range(len(hist) - 2, -1, -1):
-            if hist[j] == t:
-                d = hist[j + 2 : j + 2 + k]
-                return d + [0] * (k - len(d))
-        return [0] * k
+        # One reverse scan over occurrences of the last token, extending
+        # each hit leftward to measure suffix-match length (≤3). No slice
+        # allocations: this runs on the synchronous spec path, where host
+        # time adds directly to every chunk's latency.
+        last = hist[-1]
+        best_j, best_m = -1, 0
+        for j in range(n - 2, -1, -1):
+            if hist[j] != last:
+                continue
+            m = 1
+            while m < 3 and j - m >= 0 and hist[j - m] == hist[n - 1 - m]:
+                m += 1
+            if m > best_m:
+                best_j, best_m = j, m
+                if m == 3:
+                    break
+        if best_j < 0:
+            return [0] * k
+        d = hist[best_j + 2 : best_j + 2 + k]
+        return d + [0] * (k - len(d))
 
     def step_spec(self) -> List[int]:
         """One speculative verify chunk for every active slot (greedy pools

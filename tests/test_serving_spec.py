@@ -43,6 +43,34 @@ def test_spec_chunk_parity_multi_slot():
     assert cb.spec_stats["emitted"] >= cb.spec_stats["slot_chunks"]
 
 
+def test_draft_lookup_semantics():
+    """The host draft heuristic itself (acceptance-neutral to parity, so
+    only a direct test catches a shift bug that would silently collapse
+    the speculative win): longest recent suffix match, copy SHIFTED by
+    one (the copy's first token is the t0 analog, not a draft)."""
+    d = ContinuousBatcher._draft
+    # History "A B C x ... A B C" — 3-token suffix matches at j=2; the
+    # t0 analog is hist[3] (=9), drafts start at hist[4].
+    hist = [7, 8, 3, 9, 4, 5, 7, 8, 3]
+    assert d(hist, 4) == [4, 5, 7, 8]
+    # Single-token match only: last token 3 occurred at j=1; t0 analog is
+    # hist[2], drafts from hist[3].
+    hist2 = [1, 3, 6, 2, 5, 3]
+    assert d(hist2, 3) == [2, 5, 3]
+    # Longest match preferred over a more recent shorter one: suffix
+    # [8, 3] matches ending at j=2 even though a later lone 3 sits at
+    # j=4; the t0 analog is hist[3] (=1), drafts start at hist[4].
+    hist3 = [9, 8, 3, 1, 3, 2, 8, 3]
+    assert d(hist3, 2) == [3, 2]
+    # No earlier occurrence / degenerate history: PAD drafts.
+    assert d([1, 2, 3], 3) == [0, 0, 0]
+    assert d([5], 2) == [0, 0]
+    assert d([], 2) == [0, 0]
+    # Tail shorter than k pads with PAD.
+    hist4 = [4, 6, 4]
+    assert d(hist4, 4) == [4, 0, 0, 0]
+
+
 def test_spec_acceptance_on_repetitive_traffic():
     """A prompt that forces token repetition must accept drafts: emitted
     tokens per slot-chunk > 1 on average (the spec win exists)."""
