@@ -141,8 +141,8 @@ def test_generate_stream_cancel_before_first_token(monkeypatch):
         rt.retire()
 
 
-@pytest.mark.parametrize("seed", [0, 1])
-def test_engine_randomized_submit_cancel_stress(seed):
+@pytest.mark.parametrize("seed,spec_k", [(0, 0), (1, 0), (2, 4), (3, 4)])
+def test_engine_randomized_submit_cancel_stress(seed, spec_k):
     """Randomized interleaving of submits and cancels against the live
     engine: every Future must resolve (result or CancelledError), the
     slot pool must fully drain (free == B), and accounting must balance.
@@ -153,7 +153,7 @@ def test_engine_randomized_submit_cancel_stress(seed):
 
     rng = random.Random(seed)
     params = init_params(jax.random.PRNGKey(0), CFG)
-    eng = ServingEngine(params, CFG, batch_slots=2, max_len=64, chunk_steps=2)
+    eng = ServingEngine(params, CFG, batch_slots=2, max_len=64, chunk_steps=2, spec_k=spec_k)
     futs = []
     try:
         for _ in range(24):
