@@ -624,48 +624,75 @@ def _measure_spec_judge(k: int) -> dict:
         for i in range(6)
     ]
 
-    def drain(spec_k: int):
-        """run_all drains the pool (step() dispatches spec vs plain on
-        spec_k); returns (wall, tokens-per-verify, outputs)."""
-        cb = ContinuousBatcher(params, cfg, batch_slots=3, max_len=512, chunk_steps=8, spec_k=spec_k)
-        t0 = time.perf_counter()
-        outs = cb.run_all(pool_prompts, max_new_tokens=96)
-        wall = time.perf_counter() - t0
-        rate = (
-            cb.spec_stats["emitted"] / cb.spec_stats["slot_chunks"]
-            if cb.spec_stats["slot_chunks"] else 0.0
-        )
-        return wall, rate, outs
-
-    def drain_pipelined():
-        """The PRODUCTION plain arm: the engine's pipelined loop (dispatch
-        chunk i+1 before fetching chunk i) — the fair baseline for the
-        spec speedup, since spec chunks are inherently synchronous and an
-        unpipelined plain arm would charge its unoverlapped fetch RTTs to
-        the comparison."""
-        cb = ContinuousBatcher(params, cfg, batch_slots=3, max_len=512, chunk_steps=8)
+    def drain_pipelined(cb):
+        """ONE engine-shaped pipelined drain for BOTH arms (dispatch
+        chunk i+1 before fetching chunk i; verify chunks thread their
+        post-acceptance slot_pos on device and draft from copy cursors —
+        the same ordering the ServingEngine loop runs). The arms differ
+        only in what spec_ready() dispatches, so an auto-gated-off spec
+        pool times the SAME code path as the plain arm by construction —
+        the gate's "within 5% of plain" contract is structural, not
+        luck. Reusable: a warm pass doubles as gate calibration."""
         pending = list(enumerate(pool_prompts))
-        order = {}
-        handle = None
+        order, handle, spec_handle = {}, None, None
         t0 = time.perf_counter()
-        while pending or cb.slots or handle is not None:
+        while pending or cb.slots or handle is not None or spec_handle is not None:
+            if pending and cb.free and spec_handle is not None:
+                # Admission needs host-authoritative slot state.
+                cb.process_spec_chunk(spec_handle)
+                spec_handle = None
             while pending and cb.free:
                 i, p = pending.pop(0)
                 order[cb.admit(p, max_new_tokens=96)] = i
-            nxt = cb.step_async() if cb.slots else None
-            cb.process_chunk(handle)
-            handle = nxt
+            if cb.spec_ready():
+                cb.process_chunk(handle)
+                handle = None
+                if spec_handle is not None and cb.spec_pipeline_ready():
+                    # Full-accept regime: overlap draft/accept with the
+                    # next verify chunk's device time (cursor drafts).
+                    nxt = cb.step_spec_async()
+                    cb.process_spec_chunk(spec_handle)
+                    spec_handle = nxt
+                else:
+                    # Acceptance-preserving sync order.
+                    cb.process_spec_chunk(spec_handle)
+                    spec_handle = None
+                    if cb.slots and cb.spec_ready():
+                        spec_handle = cb.step_spec_async()
+            elif cb.slots:
+                cb.process_spec_chunk(spec_handle)
+                spec_handle = None
+                nxt = cb.step_async()
+                cb.process_chunk(handle)
+                handle = nxt
+            else:
+                cb.process_chunk(handle)
+                cb.process_spec_chunk(spec_handle)
+                handle = spec_handle = None
         wall = time.perf_counter() - t0
         outs = [None] * len(pool_prompts)
         for rid, i in order.items():
             outs[i] = cb.results.pop(rid)
         return wall, outs
 
-    drain(0)  # warm both compiled paths off-clock
-    drain(k)
-    _, outs_plain = drain_pipelined()  # warm the pipelined plain arm too
-    wall_plain, outs_plain = drain_pipelined()
-    wall_spec, engine_rate, outs_spec = drain(k)
+    # ONE batcher per arm, reused warm→measured: the spec batcher's warm
+    # pass doubles as the auto-gate's calibration+warmup, so the measured
+    # pass reports the gate's SETTLED verdict (spec chunks if they pay,
+    # plain fallback if they don't) — a fresh batcher would re-pay warmup
+    # spec chunks inside the timed window.
+    cb_plain = ContinuousBatcher(params, cfg, batch_slots=3, max_len=512, chunk_steps=8)
+    cb_spec = ContinuousBatcher(
+        params, cfg, batch_slots=3, max_len=512, chunk_steps=8, spec_k=k
+    )
+    drain_pipelined(cb_plain)  # warm compiled paths off-clock
+    drain_pipelined(cb_spec)  # warm + gate calibration
+    # Best-of-3 per arm: the tiny-preset drains are ~100 ms, where one
+    # scheduler hiccup would swamp the within-5% gate contract.
+    wall_plain, outs_plain = drain_pipelined(cb_plain)
+    wall_spec, outs_spec = drain_pipelined(cb_spec)
+    for _ in range(2):
+        wall_plain = min(wall_plain, drain_pipelined(cb_plain)[0])
+        wall_spec = min(wall_spec, drain_pipelined(cb_spec)[0])
     # Parity is exact in math (tests/test_serving_spec.py, f32); tolerate
     # at most one request flipping on a bitwise logit tie (argmax order
     # differs across program shapes — the CLAUDE.md greedy-parity gotcha)
@@ -677,6 +704,8 @@ def _measure_spec_judge(k: int) -> dict:
             "judge requests — beyond tie noise, a real parity bug"
         )
 
+    s = cb_spec.spec_stats
+    engine_rate = s["emitted"] / s["slot_chunks"] if s["slot_chunks"] else 0.0
     return {
         "tokens_per_round": st["tokens_per_round"],
         "rounds": st["rounds"],
@@ -686,6 +715,11 @@ def _measure_spec_judge(k: int) -> dict:
         "engine_wall_spec_s": wall_spec,
         "engine_tokens_per_verify": engine_rate,
         "engine_parity_mismatches": n_mismatch,
+        "engine_gate_state": s["gate_state"],
+        "engine_break_even": s["break_even"],
+        "engine_tokens_per_verify_recent": s["tokens_per_verify"],
+        "engine_accept_rate": s["accepted"] / s["drafted"] if s["drafted"] else 0.0,
+        "engine_k_trace": list(s["k_trace"])[-16:],
     }
 
 
@@ -733,6 +767,9 @@ def _bench_spec(backend: str) -> dict:
             f"{j['engine_wall_plain_s']:.2f}s pipelined-plain vs {j['engine_wall_spec_s']:.2f}s spec "
             f"({j['engine_wall_plain_s'] / max(j['engine_wall_spec_s'], 1e-9):.2f}x, "
             f"{j['engine_tokens_per_verify']:.2f} tokens/verify, "
+            f"accept {j['engine_accept_rate']:.2f}, gate {j['engine_gate_state']} "
+            f"@break-even {j['engine_break_even']:.2f}, "
+            f"k trace {j['engine_k_trace']}, "
             f"{j['engine_parity_mismatches']} tie-flips)",
             file=sys.stderr,
         )
@@ -740,6 +777,13 @@ def _bench_spec(backend: str) -> dict:
             j["engine_wall_plain_s"] / max(j["engine_wall_spec_s"], 1e-9), 2
         )
         out["engine_tokens_per_verify"] = round(j["engine_tokens_per_verify"], 2)
+        # The auto-gate's verdict: when verify chunks can't clear the
+        # measured break-even the pool decodes plain — the spec arm then
+        # matches the plain arm instead of shipping a configured slowdown.
+        out["engine_gate_state"] = j["engine_gate_state"]
+        out["engine_break_even"] = round(j["engine_break_even"], 2)
+        out["engine_accept_rate"] = round(j["engine_accept_rate"], 3)
+        out["engine_adaptive_k_trace"] = j["engine_k_trace"]
     return out
 
 

@@ -123,6 +123,9 @@ def _cmd_doctor(args: argparse.Namespace) -> int:
             f"pipeline={'on' if e('KAKVEDA_SERVE_PIPELINE', '1') != '0' else 'OFF'}",
             f"prefix={'on' if e('KAKVEDA_SERVE_PREFIX', '1') != '0' else 'OFF'}",
             f"spec_k={e('KAKVEDA_SERVE_SPEC', '0')}",
+            f"spec_gate=warmup{e('KAKVEDA_SERVE_SPEC_WARMUP', '8')}"
+            f"/calib{e('KAKVEDA_SERVE_SPEC_CALIB', '2')}"
+            f"/reprobe{e('KAKVEDA_SERVE_SPEC_REPROBE', '256')}",
             f"quant={e('KAKVEDA_QUANT', 'none')}",
             f"kv_quant={e('KAKVEDA_KV_QUANT', 'none')}",
         ]

@@ -648,7 +648,18 @@ def setup(app: web.Application) -> None:
         text = ""
         try:
             while True:
-                kind, payload = await ch.get()
+                try:
+                    kind, payload = await asyncio.wait_for(ch.get(), timeout=0.5)
+                except asyncio.TimeoutError:
+                    # No delta yet (queued behind a full pool, or a slow
+                    # model): a write has never failed, so poll the
+                    # transport — a gone client must cancel the engine
+                    # request instead of holding a slot for nobody.
+                    tr = request.transport
+                    if tr is None or tr.is_closing():
+                        cancelled.set()
+                        break
+                    continue
                 if kind == "delta":
                     await resp.write(
                         b"data: " + json.dumps({"delta": payload}).encode() + b"\n\n"
@@ -674,8 +685,24 @@ def setup(app: web.Application) -> None:
             await task
         if text:
             t1 = time.time()
+            # Provider/model from the runtime, not a hardcoded "tpu": the
+            # stream yields text only (no meta), but the blocking
+            # endpoint records meta["provider"], and a stub/Ollama-backed
+            # stream must attribute the same way or provider: queries and
+            # the runs table mislabel streamed traffic.
+            provider = getattr(ctx.model, "name", None) or "tpu"
+            model_used = (
+                chosen
+                or getattr(ctx.model, "model_label", None)
+                or getattr(ctx.model, "model", None)
+            )
+            if model_used is None:
+                try:
+                    model_used = (ctx.model.list_models() or [None])[0]
+                except Exception:  # noqa: BLE001 — attribution must not fail the stream
+                    model_used = None
             record_playground_run(
-                new_trace_id(), t0, t1, prompt, text, "tpu", chosen,
+                new_trace_id(), t0, t1, prompt, text, provider, model_used,
                 int((t1 - t0) * 1000), "playground.stream", {"streamed": True},
             )
         await resp.write_eof()

@@ -43,7 +43,7 @@ row). Parity + speedup characteristics: tests/test_speculative.py and
 from __future__ import annotations
 
 from functools import partial
-from typing import Optional
+from typing import List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -56,6 +56,104 @@ from kakveda_tpu.models.llama import (
     init_cache,
     mask_pad_vocab,
 )
+
+
+# ---------------------------------------------------------------------------
+# Host-side drafting (the continuous-batching engine's side of speculation).
+#
+# The fused single-sequence loop below drafts ON DEVICE (vectorized bigram
+# match — one dispatch per generation amortizes everything). The serving
+# engine can't fuse its loop that way: admissions, retirements and
+# cancellations interleave with chunks on the host, so its drafts are host
+# lookups between dispatches — which puts them on the per-chunk latency
+# path. These utilities keep that path O(k): an incremental n-gram suffix
+# index (append O(1) via three dicts) replaces the O(history) reverse scan
+# per slot per chunk, and a copy cursor lets a pipelined engine extend an
+# in-flight chunk's predicted emission without having seen it.
+# ---------------------------------------------------------------------------
+
+
+def copy_run(
+    toks: List[int], start: int, count: int, period: int, n: Optional[int] = None
+) -> Tuple[List[int], int]:
+    """Copy ``count`` tokens from ``toks`` starting at ``start``, wrapping
+    cyclically with ``period`` past index ``n`` (default: len at call time).
+
+    The wrap implements periodic extrapolation: a suffix that matches at
+    anchor j hypothesizes ``hist[t] == hist[t - p]`` with ``p = n-1-j``, so
+    a copy region that runs off the end of history re-enters one period
+    back instead of going empty — this is what keeps constant and
+    short-period loops drafting (the period-1 degeneracy fix: a trailing
+    same-token run anchors at j = n-2 with an empty literal tail, but
+    p = 1 tiles the run forward). ``period <= 0`` (cross-corpus copies,
+    where periodicity of someone else's text means nothing) stops at the
+    end instead. ``n`` freezes the wrap boundary so a cursor stays
+    deterministic while the underlying history list grows.
+
+    Returns ``(tokens, next_index)`` — tokens may be shorter than
+    ``count`` only when period <= 0; next_index is the continuation
+    cursor in the same (possibly wrapped-logical) coordinate.
+    """
+    n = len(toks) if n is None else n
+    out: List[int] = []
+    idx = start
+    for _ in range(count):
+        while idx >= n:
+            if period <= 0:
+                return out, idx
+            idx -= period
+        out.append(toks[idx])
+        idx += 1
+    return out, idx
+
+
+class NgramIndex:
+    """Incremental suffix index over a token stream for prompt-lookup
+    drafting: three dicts map every 1/2/3-gram to its most recent end
+    position. ``append`` is O(1); the ``anchor`` property — the most
+    recent EARLIER occurrence of the longest suffix (3→2→1) ending at the
+    stream tail — is maintained as tokens arrive, so drafting never
+    rescans history. ``lookup`` answers the same question for a foreign
+    tail (cross-corpus drafting from a registered prefix slab)."""
+
+    __slots__ = ("toks", "_maps", "anchor")
+
+    def __init__(self, toks=()):
+        self.toks: List[int] = []
+        self._maps: Tuple[dict, dict, dict] = ({}, {}, {})
+        self.anchor: Tuple[int, int] = (-1, 0)  # (end pos, match len)
+        for t in toks:
+            self.append(t)
+
+    def append(self, t: int) -> None:
+        toks = self.toks
+        toks.append(int(t))
+        i = len(toks) - 1
+        # Anchor BEFORE indexing position i: the maps still hold only
+        # earlier occurrences, so the longest-suffix hit can never be the
+        # suffix matching itself.
+        self.anchor = (-1, 0)
+        for m in (3, 2, 1):
+            if i + 1 >= m:
+                j = self._maps[m - 1].get(tuple(toks[i - m + 1 : i + 1]), -1)
+                if j >= 0:
+                    self.anchor = (j, m)
+                    break
+        for m in (1, 2, 3):
+            if i + 1 >= m:
+                self._maps[m - 1][tuple(toks[i - m + 1 : i + 1])] = i
+
+    def lookup(self, tail: List[int]) -> Tuple[int, int]:
+        """(end pos, match len) of the most recent occurrence in THIS
+        corpus of the longest suffix of ``tail`` (3→2→1); (-1, 0) on miss.
+        Unlike ``anchor`` the hit may be the corpus's own tail — callers
+        copying a continuation must check the copy region is non-empty."""
+        for m in (3, 2, 1):
+            if len(tail) >= m:
+                j = self._maps[m - 1].get(tuple(tail[-m:]), -1)
+                if j >= 0:
+                    return j, m
+        return -1, 0
 
 
 @partial(jax.jit, static_argnames=("cfg", "k", "max_new"))
