@@ -1520,7 +1520,7 @@ def _bench_serve(backend: str) -> dict:
         wall = asyncio.run(go())
         completed = 0
         if rt._engine is not None:
-            completed = rt._engine.stats["completed"]
+            completed = rt._engine.stats()["completed"]
             rt._engine.close()
         p50, p95 = (float(x) for x in np.percentile(lat_play, [50, 95]))
         return {
@@ -1745,6 +1745,19 @@ def _bench_continuous(backend: str) -> dict:
     }
 
 
+def _metrics_plane() -> dict:
+    """Compact snapshot of the process-global metrics registry, folded
+    into every emitted bench JSON line: BENCH_*.json then carries the
+    acceptance/gate/prefix-hit trajectories the metrics the run generated
+    — not just the headline walls. Zero-valued series are dropped."""
+    try:
+        from kakveda_tpu.core.metrics import get_registry
+
+        return get_registry().snapshot(compact=True)
+    except Exception:  # noqa: BLE001 — telemetry must never sink a bench line
+        return {}
+
+
 def load_resumable_partial(partial_path: str, backend: str) -> dict:
     """Load already-measured metrics from a prior wedged sweep.
 
@@ -1926,7 +1939,9 @@ def main() -> int:
         "serve": _bench_serve,
     }
     if which in fns:
-        print(json.dumps(fns[which](backend)))
+        out = fns[which](backend)
+        out["metrics_plane"] = _metrics_plane()
+        print(json.dumps(out))
         return 0
 
     # Default: every metric in one run, one JSON line — the driver records
@@ -1996,6 +2011,7 @@ def main() -> int:
             pass
     headline = results[0]
     headline["extra_metrics"] = results[1:]
+    headline["metrics_plane"] = _metrics_plane()
     print(json.dumps(headline))
     return 0
 

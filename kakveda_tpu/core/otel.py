@@ -70,9 +70,42 @@ def get_tracer() -> Optional[Any]:
     return _tracer
 
 
+def add_span_events(name: str, payload: Optional[dict]) -> None:
+    """Attach a flat payload (e.g. the serving engine's request timeline)
+    to the CURRENT server span as one event, so traces and /metrics
+    correlate by request id. No-op without otel, a recording span, or a
+    payload — observability never breaks the request path."""
+    if _tracer is None or not payload:
+        return
+    try:
+        from opentelemetry import trace
+
+        span = trace.get_current_span()
+        if span is None or not span.is_recording():
+            return
+        span.add_event(
+            name,
+            {
+                k: v
+                for k, v in payload.items()
+                if isinstance(v, (str, bool, int, float))
+            },
+        )
+    except Exception:  # noqa: BLE001 — telemetry must not take the request down
+        pass
+
+
 def otel_middleware():
-    """aiohttp middleware: one server span per request (no-op when off)."""
+    """aiohttp middleware: one server span per request (no-op when off).
+
+    The request id is resolved HERE (incoming header or fresh) and stashed
+    on the request so the inner request-context middleware reuses it —
+    span attribute ``request.id`` and the logged/echoed ``x-request-id``
+    are the same value, the correlation key across traces, /metrics
+    exemplars and the flight recorder."""
     from aiohttp import web
+
+    from kakveda_tpu.core.runtime import ensure_request_id, get_runtime_config
 
     @web.middleware
     async def mw(request: web.Request, handler):
@@ -81,11 +114,15 @@ def otel_middleware():
             return await handler(request)
         from opentelemetry.trace import SpanKind, Status, StatusCode
 
+        cfg = get_runtime_config(service_name="kakveda-tpu")
+        rid = ensure_request_id(request.headers.get(cfg.request_id_header))
+        request["request_id"] = rid
         with tracer.start_as_current_span(
             f"{request.method} {request.path}", kind=SpanKind.SERVER
         ) as span:
             span.set_attribute("http.request.method", request.method)
             span.set_attribute("url.path", request.path)
+            span.set_attribute("request.id", rid)
             try:
                 response = await handler(request)
             except web.HTTPException as exc:

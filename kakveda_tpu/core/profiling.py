@@ -30,7 +30,13 @@ log = logging.getLogger("kakveda.profiling")
 
 @contextlib.contextmanager
 def annotate(name: str) -> Iterator[None]:
-    """Label enclosed device work in the profiler timeline (no-op safe)."""
+    """Label enclosed device work in the profiler timeline (no-op safe).
+
+    The block's host wall also lands on the metrics plane
+    (``kakveda_device_block_seconds{name=...}``) keyed by this SAME name —
+    the annotation an operator sees in an XPlane profile and the series on
+    /metrics share a vocabulary, so kNN/decode device time is monitorable
+    without capturing a trace."""
     # Only the profiler setup is guarded — the yield must stay outside the
     # try/except, or an exception raised by the *enclosed work* would be
     # thrown into the generator, caught here, and surface as contextlib's
@@ -44,6 +50,9 @@ def annotate(name: str) -> Iterator[None]:
         annotation.__enter__()
     except Exception:  # noqa: BLE001 — profiling must never break the hot path
         annotation = None
+    import time as _time
+
+    t0 = _time.perf_counter()
     try:
         yield
     finally:
@@ -52,6 +61,12 @@ def annotate(name: str) -> Iterator[None]:
                 annotation.__exit__(None, None, None)
             except Exception:  # noqa: BLE001
                 pass
+        try:
+            from kakveda_tpu.core import metrics as _metrics
+
+            _metrics.device_block(name, _time.perf_counter() - t0)
+        except Exception:  # noqa: BLE001 — metrics must never break the hot path
+            pass
 
 
 @contextlib.contextmanager

@@ -24,7 +24,7 @@ from kakveda_tpu.dashboard.core import (
 from kakveda_tpu.dashboard.db import make_database
 from kakveda_tpu.models.runtime import ModelRuntime, get_runtime
 from kakveda_tpu.platform import Platform
-from kakveda_tpu.service.app import request_context_middleware
+from kakveda_tpu.service.app import metrics_routes, request_context_middleware
 
 
 def make_dashboard_app(
@@ -90,6 +90,9 @@ def make_dashboard_app(
             return web.json_response({"ok": False, "error": str(e)}, status=503)
 
     app.add_routes([web.get("/healthz", healthz), web.get("/readyz", readyz)])
+    # The metrics plane (GET /metrics, GET /flightrecorder) — same routes
+    # as the service app; the registry and recorders are process-global.
+    app.add_routes(metrics_routes())
 
     # Bus subscriptions (reference: services/dashboard/app.py:1332-1431):
     # traces ingested through the platform API (not just scenario runs) land

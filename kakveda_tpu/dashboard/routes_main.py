@@ -757,6 +757,13 @@ def setup(app: web.Application) -> None:
                 text = f"model error: {e}"
                 meta = {"provider": "error", "model": chosen, "error": str(e)}
         t1 = time.time()
+        # Engine-backed generations carry their serving timeline (queue
+        # wait, prefill, TTFT, tokens/s, engine request id) in meta; hang
+        # it on this request's OTel span so traces correlate with /metrics
+        # and the flight recorder by request id. No-op without otel.
+        from kakveda_tpu.core import otel as _otel
+
+        _otel.add_span_events("serving.timeline", meta.get("serve"))
         record_playground_run(
             trace_id, t0, t1, prompt, text, meta.get("provider"), meta.get("model"),
             meta.get("latency_ms", int((t1 - t0) * 1000)), "playground.run", meta,

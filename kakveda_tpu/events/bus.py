@@ -28,6 +28,8 @@ import logging
 from pathlib import Path
 from typing import Any, Awaitable, Callable, Collection, Dict, List, Optional, Union
 
+from kakveda_tpu.core import metrics as _metrics
+
 log = logging.getLogger("kakveda.events")
 
 TOPIC_TRACE_INGESTED = "trace.ingested"
@@ -51,6 +53,21 @@ class EventBus:
         self._persist_path = Path(persist_path) if persist_path else None
         if self._persist_path is not None:
             self._replay_subscriptions()
+        reg = _metrics.get_registry()
+        self._m_published = reg.counter(
+            "kakveda_bus_events_published_total",
+            "Events published on the in-process bus", ("topic",),
+        )
+        self._m_deliveries = reg.counter(
+            "kakveda_bus_deliveries_total", "Bus deliveries by result", ("result",),
+        )
+        self._m_ok = self._m_deliveries.labels(result="ok")
+        self._m_err = self._m_deliveries.labels(result="error")
+        # Fan-out backpressure gauge: how many deliveries are in flight
+        # right now (bounded by MAX_CONCURRENT_DELIVERIES per publish).
+        self._m_inflight = reg.gauge(
+            "kakveda_bus_inflight_deliveries", "Bus deliveries currently in flight",
+        )
 
     # --- durable URL subscriptions -------------------------------------
 
@@ -155,14 +172,22 @@ class EventBus:
 
         async def one(sub, event) -> bool:
             async with sem:
-                return await self._deliver(sub, event, client=client)
+                self._m_inflight.inc()
+                try:
+                    return await self._deliver(sub, event, client=client)
+                finally:
+                    self._m_inflight.dec()
 
         try:
             results = await asyncio.gather(*[one(s, e) for s, e in pairs])
         finally:
             if client is not None:
                 await client.aclose()
-        return sum(results)
+        ok = sum(results)
+        self._m_ok.inc(ok)
+        if ok < len(results):
+            self._m_err.inc(len(results) - ok)
+        return ok
 
     async def publish(self, topic: str, event: dict, exclude: Collection[Handler] = ()) -> int:
         """Fan out to all subscribers concurrently; returns delivered count.
@@ -171,6 +196,7 @@ class EventBus:
         batched ingest, which invokes its internal reactors once per batch
         directly and must not have them re-triggered per event.
         """
+        self._m_published.labels(topic=topic).inc()
         subs = [s for s in self._subs.get(topic, []) if s not in exclude]
         if not subs:
             return 0
@@ -181,6 +207,7 @@ class EventBus:
     ) -> int:
         """Publish a batch of events concurrently (bounded-concurrency
         fan-out over all event×subscriber deliveries)."""
+        self._m_published.labels(topic=topic).inc(len(events))
         subs = [s for s in self._subs.get(topic, []) if s not in exclude]
         if not subs or not events:
             return 0

@@ -645,6 +645,7 @@ class LlamaRuntime:
                             max_len=min(window, self.cfg.max_seq_len),
                             chunk_steps=int(os.environ.get("KAKVEDA_SERVE_CHUNK", "8")),
                             eos_id=self.tokenizer.EOS,
+                            name=self.model_label,
                         )
                     except Exception as e:  # noqa: BLE001
                         # KV-pool allocation can fail on a memory-tight
@@ -685,15 +686,18 @@ class LlamaRuntime:
         eng = self._engine  # peek, never build
         stats = None
         if eng is not None:
+            # stats() is the lock-guarded deep-copy snapshot (the loop
+            # thread mutates spec_stats/k_trace concurrently with this
+            # panel) — never read the live dicts here.
             stats = {
-                **eng.stats,
+                **eng.stats(),
                 "active": eng.cb.active,
                 "slots": eng.cb.B,
                 "window": eng.cb.max_len,
                 "closed": eng._closed.is_set(),
-                "prefix": dict(eng.cb.prefix_stats),
-                "spec": dict(eng.cb.spec_stats) if eng.cb.spec_k else None,
             }
+            if not eng.cb.spec_k:
+                stats["spec"] = None
         return {
             "runtime": "tpu",
             "model": self.model_label,
@@ -946,8 +950,17 @@ class LlamaRuntime:
                 # identical to the solo decode below.
                 try:
                     with profiling.annotate("llama.generate_online"):
-                        new_ids = eng.generate_ids(ids, max_tokens)
+                        fut = eng.submit(ids, max_tokens)
+                        new_ids = fut.result()
                     meta_extra = {"continuous": True}
+                    # The engine attaches the request's lifecycle timeline
+                    # (queue wait, prefill, TTFT, tokens/s, engine request
+                    # id) to the Future — surfaced in meta so HTTP layers
+                    # can hang it on the request's OTel span and correlate
+                    # traces with /metrics and the flight recorder.
+                    tl = getattr(fut, "timeline", None)
+                    if tl is not None:
+                        meta_extra["serve"] = tl
                 except RuntimeError:
                     new_ids = None  # engine closed/died: solo path below
             if new_ids is None:

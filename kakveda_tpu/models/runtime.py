@@ -265,6 +265,21 @@ class MultiModelRuntime:
         self._bytes: Dict[str, int] = {}  # label -> exact weight+KV bytes
         self._load_lock = threading.Lock()  # serializes load/evict/budget
         self._lru_lock = threading.Lock()  # guards _loaded order mutations only
+        # HBM headroom on the metrics plane: budget is static, loaded
+        # bytes move on every load/evict — headroom is the difference,
+        # computed by the dashboard/alert side.
+        from kakveda_tpu.core import metrics as _metrics
+
+        reg = _metrics.get_registry()
+        self._m_budget = reg.gauge(
+            "kakveda_hbm_budget_bytes",
+            "Configured HBM weight+KV budget (0 = unbudgeted)",
+        )
+        self._m_loaded = reg.gauge(
+            "kakveda_hbm_loaded_bytes",
+            "Resident weight+KV bytes accounted by the model router",
+        )
+        self._m_budget.set(self._budget or 0)
 
     def _estimate_bytes(self, path: str) -> int:
         """Pre-load footprint estimate from config.json alone (no weight
@@ -326,6 +341,7 @@ class MultiModelRuntime:
             return False
         self._bytes.pop(victim, None)
         rt.retire()
+        self._m_loaded.set(self.loaded_bytes())
         return True
 
     def loaded_bytes(self) -> int:
@@ -402,6 +418,7 @@ class MultiModelRuntime:
             self._bytes[label] = _tree_bytes(rt.params) + self._engine_pool_bytes(rt.cfg)
             with self._lru_lock:
                 self._loaded[label] = rt
+            self._m_loaded.set(self.loaded_bytes())
             return rt
 
     def list_models(self) -> list:

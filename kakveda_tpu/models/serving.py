@@ -52,6 +52,8 @@ vector threads through the chunk body; greedy slots stay exact).
 
 from __future__ import annotations
 
+import copy
+import logging
 import os
 import queue
 import threading
@@ -66,6 +68,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from kakveda_tpu.core import metrics as _metrics
 from kakveda_tpu.models.llama import (
     LlamaConfig,
     Params,
@@ -74,6 +77,10 @@ from kakveda_tpu.models.llama import (
     mask_pad_vocab,
 )
 from kakveda_tpu.models.speculative import NgramIndex, copy_run
+
+log = logging.getLogger("kakveda.serving")
+
+_GATE_STATES = ("disabled", "warmup", "on", "off")
 
 
 @partial(jax.jit, static_argnames=("cfg",), donate_argnums=(2,))
@@ -413,14 +420,23 @@ class ContinuousBatcher:
         eos_id: Optional[int] = None,
         rng: Optional[jax.Array] = None,
         spec_k: int = 0,
+        name: str = "default",
+        recorder: Optional[_metrics.FlightRecorder] = None,
     ):
         self.params, self.cfg = params, cfg
         self.B, self.max_len = batch_slots, max_len
         self.chunk_steps = chunk_steps
         self.spec_k = spec_k
+        self.name = name
+        self.recorder = recorder
         # Observability + the acceptance auto-gate's decision state, one
         # dict so serving_stats/bench surface everything at once.
         # gate_state: disabled (spec_k=0) | warmup (measuring) | on | off.
+        # The loop thread mutates this concurrently with readers — every
+        # mutation holds ``stats_lock`` (RLock: the gate helper nests
+        # inside locked sections) and readers go through
+        # :meth:`stats_snapshot` / ``ServingEngine.stats()``.
+        self.stats_lock = threading.RLock()
         self.spec_stats = {
             "chunks": 0, "emitted": 0, "slot_chunks": 0,
             "drafted": 0, "accepted": 0,
@@ -429,6 +445,64 @@ class ContinuousBatcher:
             "break_even": 0.0,
             "k_trace": [],  # pool verify width per chunk, last 64
         }
+        # Metrics-plane children, resolved ONCE here: a per-chunk update is
+        # a lock + an add, nothing label-shaped on the hot path.
+        reg = _metrics.get_registry()
+        self._gate_gauge = reg.gauge(
+            "kakveda_serving_spec_gate_state",
+            "1 for the pool's current speculation gate state "
+            "(disabled|warmup|on|off)", ("engine", "state"),
+        )
+        self._gate_transitions = reg.counter(
+            "kakveda_serving_gate_transitions_total",
+            "Speculation auto-gate state transitions", ("engine", "from", "to"),
+        )
+        for gs in _GATE_STATES:
+            self._gate_gauge.labels(engine=name, state=gs).set(
+                1.0 if gs == self.spec_stats["gate_state"] else 0.0
+            )
+        chunk_hist = reg.histogram(
+            "kakveda_serving_chunk_seconds",
+            "Effective decode-chunk wall (dispatch to process, overlapped "
+            "under pipelining)", ("engine", "flavor"),
+        )
+        prefix_ctr = reg.counter(
+            "kakveda_serving_prefix_requests_total",
+            "Admissions by prefix-cache result", ("engine", "result"),
+        )
+        self._mx = {
+            "chunk_plain": chunk_hist.labels(engine=name, flavor="plain"),
+            "chunk_spec": chunk_hist.labels(engine=name, flavor="spec"),
+            "tokens": reg.counter(
+                "kakveda_serving_tokens_total",
+                "Decode tokens emitted to callers", ("engine",),
+            ).labels(engine=name),
+            "drafted": reg.counter(
+                "kakveda_serving_spec_drafted_total",
+                "Speculative draft tokens sent to verify chunks", ("engine",),
+            ).labels(engine=name),
+            "accepted": reg.counter(
+                "kakveda_serving_spec_accepted_total",
+                "Speculative draft tokens accepted by verify chunks",
+                ("engine",),
+            ).labels(engine=name),
+            "prefix_hit": prefix_ctr.labels(engine=name, result="hit"),
+            "prefix_miss": prefix_ctr.labels(engine=name, result="miss"),
+            "active": reg.gauge(
+                "kakveda_serving_active_slots",
+                "Occupied slots in the continuous-batching pool", ("engine",),
+            ).labels(engine=name),
+            "spec_k": reg.gauge(
+                "kakveda_serving_spec_k",
+                "Pool verify width of the most recent speculative chunk",
+                ("engine",),
+            ).labels(engine=name),
+        }
+        reg.gauge(
+            "kakveda_serving_slots",
+            "Total slots in the continuous-batching pool", ("engine",),
+        ).labels(engine=name).set(batch_slots)
+        self._last_k_rec = 0
         # Gate inputs: recent per-chunk wall times for each arm (median —
         # robust to one-off compile spikes), recent per-slot emitted
         # counts, and the knobs. Walls are recorded where the chunk's
@@ -527,8 +601,42 @@ class ContinuousBatcher:
             ids=ids, kv={k: scratch[k] for k in keys},
             index=NgramIndex(ids) if self.spec_k else None,
         )
-        self.prefix_stats["registered"] += 1
+        with self.stats_lock:
+            self.prefix_stats["registered"] += 1
         return True
+
+    def stats_snapshot(self) -> dict:
+        """Deep-copied spec/prefix stats under the stats lock — THE read
+        API. The loop thread mutates the live dicts between chunks
+        (``k_trace`` append vs list copy is the observable race), so
+        readers never touch them directly."""
+        with self.stats_lock:
+            return {
+                "spec": copy.deepcopy(self.spec_stats),
+                "prefix": dict(self.prefix_stats),
+            }
+
+    def _set_gate_state(self, new: str) -> None:
+        """ONE definition of a gate transition: spec_stats, the state
+        gauge vector, the transition counter and the flight recorder move
+        together. Caller holds ``stats_lock``."""
+        old = self.spec_stats["gate_state"]
+        if new == old:
+            return
+        self.spec_stats["gate_state"] = new
+        self._gate_gauge.labels(engine=self.name, state=old).set(0.0)
+        self._gate_gauge.labels(engine=self.name, state=new).set(1.0)
+        self._gate_transitions.labels(
+            **{"engine": self.name, "from": old, "to": new}
+        ).inc()
+        if self.recorder is not None:
+            self.recorder.record(
+                "gate", **{
+                    "from": old, "to": new,
+                    "tokens_per_verify": self.spec_stats["tokens_per_verify"],
+                    "break_even": self.spec_stats["break_even"],
+                }
+            )
 
     def _match_prefix(self, prompt_ids: List[int]):
         """Longest registered prefix of ``prompt_ids`` plus the suffix-chunk
@@ -615,8 +723,10 @@ class ContinuousBatcher:
         )
         if m is not None:
             pe, split, sw = m
-            self.prefix_stats["hits"] += 1
-            self.prefix_stats["hit_tokens_saved"] += split
+            with self.stats_lock:
+                self.prefix_stats["hits"] += 1
+                self.prefix_stats["hit_tokens_saved"] += split
+            self._mx["prefix_hit"].inc()
             self.cache, self.last = _admit_prefix_jit(
                 self.params, self.cfg, self.cache, self.last,
                 pe.kv, jnp.asarray([list(prompt_ids[split:])], jnp.int32),
@@ -625,6 +735,7 @@ class ContinuousBatcher:
                 jnp.asarray(off + split, jnp.int32),
             )
         else:
+            self._mx["prefix_miss"].inc()
             padded = [0] * off + list(prompt_ids)
             self.cache, self.last = _admit_jit(
                 self.params, self.cfg, self.cache, self.last,
@@ -639,6 +750,7 @@ class ContinuousBatcher:
             prompt_ids=list(prompt_ids),
             k=self.spec_k,
         )
+        self._mx["active"].set(len(self.slots))
         return rid
 
     def step_async(self):
@@ -698,8 +810,10 @@ class ContinuousBatcher:
         # wall — under pipelining the fetch overlapped the next chunk's
         # device work, so this interval is the overlapped cost the spec
         # arm has to beat, not the synchronous one.
+        wall = time.perf_counter() - t_dispatch
+        self._mx["chunk_plain"].observe(wall)
         if self.spec_k and any(not st.done for st in snapshot.values()):
-            self.note_plain_wall(time.perf_counter() - t_dispatch)
+            self.note_plain_wall(wall)
         finished = []
         for slot, st in snapshot.items():
             if st.done:
@@ -723,6 +837,8 @@ class ContinuousBatcher:
             if len(st.out) >= st.max_new or st.prompt_len + len(st.out) + 1 >= self.max_len:
                 st.done = True
                 break
+        if len(st.out) > n_before:
+            self._mx["tokens"].inc(len(st.out) - n_before)
         if st.on_tokens is not None:
             # Streaming: surface this chunk's accepted tokens as they
             # land. Exceptions must not kill the engine loop — a gone
@@ -737,6 +853,7 @@ class ContinuousBatcher:
             del self.slots[slot]
             self.free.append(slot)
             self._kv_np[slot] = False
+            self._mx["active"].set(len(self.slots))
 
     def _grow_valid(self, steps: int) -> None:
         """Grow read-validity on the host mirror (vectorized over slots):
@@ -933,8 +1050,10 @@ class ContinuousBatcher:
         counts_h = np.asarray(counts).astype(np.int32)
         self._spec_pending -= 1
         self._spec_pending_width -= k + 1
+        wall = time.perf_counter() - t_dispatch
+        self._mx["chunk_spec"].observe(wall)
         if k in self._spec_widths_warm:
-            self._spec_walls.append(time.perf_counter() - t_dispatch)
+            self._spec_walls.append(wall)
         else:
             self._spec_widths_warm.add(k)  # compile run — not a cost sample
         # Every slot's mirror advances by ITS emitted count (inactive slots
@@ -942,8 +1061,11 @@ class ContinuousBatcher:
         # with the lockstep += chunk_steps of the plain path).
         self._pos_np += counts_h
         finished: List[int] = []
-        self.spec_stats["chunks"] += 1
         self._gate_spec_chunks += 1
+        # Per-chunk stats accumulate locally and land in spec_stats under
+        # ONE lock acquire — the lock must not be held across _emit (its
+        # streaming callbacks are caller code).
+        em = sc = dr = ac = 0
         for slot, st in snapshot.items():
             if st.done:
                 st.spec_cursor = None
@@ -951,10 +1073,10 @@ class ContinuousBatcher:
             n = int(counts_h[slot])
             kd = kmap.get(slot, k)
             a = max(0, min(n - 1, kd))  # accepted drafts (t0 is free)
-            self.spec_stats["emitted"] += n
-            self.spec_stats["slot_chunks"] += 1
-            self.spec_stats["drafted"] += kd
-            self.spec_stats["accepted"] += a
+            em += n
+            sc += 1
+            dr += kd
+            ac += a
             self._tpv_recent.append(n)
             # Per-slot adaptive k: a fully-accepted chunk DOUBLES the
             # draft width (rejected drafts ride the same weight stream,
@@ -978,10 +1100,23 @@ class ContinuousBatcher:
             if pred is None or n != kd + 1 or emitted != pred[:n]:
                 st.spec_cursor = None
             self._emit(slot, st, toks_h[slot][:n], finished)
-        kt = self.spec_stats["k_trace"]
-        kt.append(k)
-        if len(kt) > 64:
-            del kt[0]
+        with self.stats_lock:
+            s = self.spec_stats
+            s["chunks"] += 1
+            s["emitted"] += em
+            s["slot_chunks"] += sc
+            s["drafted"] += dr
+            s["accepted"] += ac
+            kt = s["k_trace"]
+            kt.append(k)
+            if len(kt) > 64:
+                del kt[0]
+        self._mx["drafted"].inc(dr)
+        self._mx["accepted"].inc(ac)
+        self._mx["spec_k"].set(k)
+        if self.recorder is not None and k != self._last_k_rec:
+            self.recorder.record("pool_k", k=k)
+            self._last_k_rec = k
         self._gate_eval()
         return finished
 
@@ -1017,14 +1152,15 @@ class ContinuousBatcher:
             self._plain_walls.append(wall)
         else:
             self._plain_warm = True  # compile run — not a cost sample
-        if self.spec_stats["gate_state"] == "off":
-            self._gate_plain_since_off += 1
-            if self._gate_reprobe and self._gate_plain_since_off >= self._gate_reprobe:
-                self.spec_stats["gate_state"] = "warmup"
-                self._gate_spec_chunks = 0
-                self._gate_plain_since_off = 0
-                self._gate_reprobes += 1
-                self._tpv_recent.clear()
+        with self.stats_lock:
+            if self.spec_stats["gate_state"] == "off":
+                self._gate_plain_since_off += 1
+                if self._gate_reprobe and self._gate_plain_since_off >= self._gate_reprobe:
+                    self._set_gate_state("warmup")
+                    self._gate_spec_chunks = 0
+                    self._gate_plain_since_off = 0
+                    self._gate_reprobes += 1
+                    self._tpv_recent.clear()
 
     def _gate_eval(self) -> None:
         """The acceptance auto-gate: speculation pays iff observed
@@ -1038,23 +1174,24 @@ class ContinuousBatcher:
         doesn't flap."""
         if not self.spec_k:
             return
-        g = self.spec_stats
         tpv = float(np.mean(self._tpv_recent)) if self._tpv_recent else 0.0
-        g["tokens_per_verify"] = round(tpv, 3)
         if self._spec_walls and self._plain_walls:
             spec_w = float(np.median(self._spec_walls))
             plain_w = float(np.median(self._plain_walls)) / max(self.chunk_steps, 1)
             be = spec_w / max(plain_w, 1e-9)
         else:
             be = self._gate_prior  # no plain measurement yet: conservative prior
-        g["break_even"] = round(be, 3)
-        if g["gate_state"] in ("warmup", "on") and self._gate_spec_chunks >= self._gate_warmup:
-            need = be * (1.1 if self._gate_reprobes else 1.0)
-            if tpv < need:
-                g["gate_state"] = "off"
-                self._gate_plain_since_off = 0
-            else:
-                g["gate_state"] = "on"
+        with self.stats_lock:
+            g = self.spec_stats
+            g["tokens_per_verify"] = round(tpv, 3)
+            g["break_even"] = round(be, 3)
+            if g["gate_state"] in ("warmup", "on") and self._gate_spec_chunks >= self._gate_warmup:
+                need = be * (1.1 if self._gate_reprobes else 1.0)
+                if tpv < need:
+                    self._set_gate_state("off")
+                    self._gate_plain_since_off = 0
+                else:
+                    self._set_gate_state("on")
 
     def cancel_request(self, rid: int) -> Optional[List[int]]:
         """Retire a mid-decode request NOW (between chunks): returns its
@@ -1069,6 +1206,7 @@ class ContinuousBatcher:
                 del self.slots[slot]
                 self.free.append(slot)
                 self._kv_np[slot] = False
+                self._mx["active"].set(len(self.slots))
                 return st.out
         return None
 
@@ -1149,21 +1287,112 @@ class ServingEngine:
         eos_id: Optional[int] = None,
         rng: Optional[jax.Array] = None,
         spec_k: Optional[int] = None,
+        name: Optional[str] = None,
     ):
         if spec_k is None:
             spec_k = int(os.environ.get("KAKVEDA_SERVE_SPEC", "0"))
+        self.name = name or "default"
+        # The flight recorder: request timelines + gate/k transitions,
+        # dumped via GET /flightrecorder and automatically on loop death.
+        self.recorder = _metrics.FlightRecorder(f"serving/{self.name}")
         self.cb = ContinuousBatcher(
             params, cfg, batch_slots=batch_slots, max_len=max_len,
             chunk_steps=chunk_steps, eos_id=eos_id, rng=rng, spec_k=spec_k,
+            name=self.name, recorder=self.recorder,
         )
-        self._q: "queue.Queue[Tuple[List[int], int, float, Future]]" = queue.Queue()
+        reg = _metrics.get_registry()
+        el = {"engine": self.name}
+        self._m_requests = reg.counter(
+            "kakveda_serving_requests_total",
+            "Serving requests by outcome", ("engine", "outcome"),
+        )
+        self._mx = {
+            "queue_wait": reg.histogram(
+                "kakveda_serving_queue_wait_seconds",
+                "Submit-to-admission wait in the serving engine queue",
+                ("engine",),
+            ).labels(**el),
+            "prefill": reg.histogram(
+                "kakveda_serving_prefill_seconds",
+                "Admission prefill dispatch wall per request", ("engine",),
+            ).labels(**el),
+            "ttft": reg.histogram(
+                "kakveda_serving_ttft_seconds",
+                "Submit-to-first-token latency per request", ("engine",),
+            ).labels(**el),
+            "request": reg.histogram(
+                "kakveda_serving_request_seconds",
+                "Submit-to-completion wall per request", ("engine",),
+            ).labels(**el),
+            "rate": reg.histogram(
+                "kakveda_serving_tokens_per_second",
+                "Per-request decode rate (tokens / request wall)",
+                ("engine",), buckets=_metrics.RATE_BUCKETS,
+            ).labels(**el),
+            "errors": reg.counter(
+                "kakveda_serving_engine_errors_total",
+                "Serving-engine loop deaths (flight recorder dumped on "
+                "each)", ("engine",),
+            ).labels(**el),
+        }
+        self._q: "queue.Queue[Tuple[List[int], int, float, object, float, Future]]" = queue.Queue()
         self._closed = threading.Event()
         self._submit_lock = threading.Lock()  # closes the submit/close race
         self._pend: Dict[int, Future] = {}  # loop-owned; close() fails leftovers
         self._waiting: List = []  # loop-owned: admitted-when-a-slot-frees queue
-        self.stats = {"submitted": 0, "completed": 0, "max_active": 0, "chunks": 0}
+        self._track: Dict[int, dict] = {}  # loop-owned per-request timeline state
+        self._stats = {"submitted": 0, "completed": 0, "max_active": 0, "chunks": 0}
         self._thread = threading.Thread(target=self._loop, daemon=True, name="serving-engine")
         self._thread.start()
+
+    def stats(self) -> dict:
+        """Lock-guarded deep-copy snapshot of the engine counters plus the
+        batcher's spec/prefix stats. The loop thread mutates all of these
+        concurrently with readers (``k_trace`` append vs list copy), so
+        THE read API is this snapshot — never the live dicts."""
+        with self.cb.stats_lock:
+            snap = dict(self._stats)
+            snap["spec"] = copy.deepcopy(self.cb.spec_stats)
+            snap["prefix"] = dict(self.cb.prefix_stats)
+        return snap
+
+    def _bump(self, key: str, v: int = 1) -> None:
+        with self.cb.stats_lock:
+            self._stats[key] += v
+
+    def _note_active(self) -> None:
+        with self.cb.stats_lock:
+            self._stats["max_active"] = max(self._stats["max_active"], self.cb.active)
+
+    def _finish_telemetry(self, rid: int, n_tokens: int) -> Optional[dict]:
+        """Close a request's timeline: observe the lifecycle histograms,
+        record the flight-recorder event, and return the timeline dict
+        (attached to the caller's Future so generate() can surface it in
+        meta / as OTel span events)."""
+        tr = self._track.pop(rid, None)
+        if tr is None:
+            return None
+        wall = time.perf_counter() - tr["submit"]
+        rate = n_tokens / wall if wall > 0 else 0.0
+        self._mx["request"].observe(wall)
+        if n_tokens:
+            self._mx["rate"].observe(rate)
+        self._m_requests.labels(engine=self.name, outcome="completed").inc()
+        tl = {
+            "request_id": rid,
+            "queue_wait_ms": round((tr["admit"] - tr["submit"]) * 1000, 3),
+            "prefill_ms": round(tr.get("prefill_s", 0.0) * 1000, 3),
+            "ttft_ms": (
+                round((tr["first"] - tr["submit"]) * 1000, 3)
+                if tr["first"] is not None else None
+            ),
+            "wall_ms": round(wall * 1000, 3),
+            "tokens": n_tokens,
+            "tokens_per_s": round(rate, 2),
+        }
+        if self.recorder is not None:
+            self.recorder.record("request", **tl)
+        return tl
 
     def fits(self, prompt_len: int, max_new_tokens: int) -> bool:
         """True when the request can run in the pool WITHOUT truncating
@@ -1194,8 +1423,11 @@ class ServingEngine:
             if self._closed.is_set():
                 raise RuntimeError("ServingEngine is closed")
             fut: Future = Future()
-            self._q.put((list(prompt_ids), max_new_tokens, temperature, on_tokens, fut))
-            self.stats["submitted"] += 1
+            self._q.put(
+                (list(prompt_ids), max_new_tokens, temperature, on_tokens,
+                 time.perf_counter(), fut)
+            )
+            self._bump("submitted")
             return fut
 
     def generate_ids(
@@ -1264,6 +1496,7 @@ class ServingEngine:
             for fut in list(self._pend.values()):
                 self._fail(fut, err)
             self._pend.clear()
+            self._track.clear()
 
     def close(self) -> None:
         with self._submit_lock:
@@ -1283,6 +1516,10 @@ class ServingEngine:
                 return  # already finished (or was never admitted)
             toks = self.cb.cancel_request(rid)
             self._pend.pop(rid, None)
+            self._track.pop(rid, None)
+            self._m_requests.labels(engine=self.name, outcome="cancelled").inc()
+            if self.recorder is not None:
+                self.recorder.record("cancel", request_id=rid, tokens=len(toks or []))
             if toks is None:
                 toks = self.cb.results.pop(rid, [])  # finished between chunks
             if not fut.done():
@@ -1300,16 +1537,37 @@ class ServingEngine:
             except Exception as e:  # noqa: BLE001 — registration errors belong to the caller
                 self._fail(fut, e)
             return
-        ids, max_new, temp, on_tokens, fut = item
+        ids, max_new, temp, on_tokens, t_submit, fut = item
         if not fut.set_running_or_notify_cancel():
             return
+        t_admit = time.perf_counter()
+        self._mx["queue_wait"].observe(t_admit - t_submit)
+        # Lifecycle tracking rides the slot's own streaming callback: the
+        # wrapper sees each chunk's accepted tokens on the loop thread
+        # (TTFT + token counts with no extra bookkeeping in the batcher),
+        # then forwards to the caller's callback if any.
+        track = {"submit": t_submit, "admit": t_admit, "first": None, "tokens": 0}
+        mx_ttft = self._mx["ttft"]
+
+        def _on_tokens(new, done, _orig=on_tokens, _tr=track):
+            if _tr["first"] is None and new:
+                _tr["first"] = time.perf_counter()
+                mx_ttft.observe(_tr["first"] - _tr["submit"])
+            _tr["tokens"] += len(new)
+            if _orig is not None:
+                _orig(new, done)
+
         try:
             rid = self.cb.admit(
-                ids, max_new_tokens=max_new, temperature=temp, on_tokens=on_tokens
+                ids, max_new_tokens=max_new, temperature=temp, on_tokens=_on_tokens
             )
         except Exception as e:  # noqa: BLE001 — admission errors belong to the caller
+            self._m_requests.labels(engine=self.name, outcome="rejected").inc()
             self._fail(fut, e)
             return
+        track["prefill_s"] = time.perf_counter() - t_admit
+        self._mx["prefill"].observe(track["prefill_s"])
+        self._track[rid] = track
         self._pend[rid] = fut
 
     def _loop(self) -> None:
@@ -1369,14 +1627,18 @@ class ServingEngine:
 
         def finish(rids: List[int]) -> None:
             for rid in rids:
-                self.stats["completed"] += 1
+                self._bump("completed")
                 fut = self._pend.pop(rid, None)
                 toks = self.cb.results.pop(rid, [])
-                if fut is not None and not fut.done():
-                    try:
-                        fut.set_result(toks)
-                    except Exception:  # noqa: BLE001 — close() won the race
-                        pass
+                tl = self._finish_telemetry(rid, len(toks))
+                if fut is not None:
+                    if tl is not None:
+                        fut.timeline = tl  # read back by LlamaRuntime.generate
+                    if not fut.done():
+                        try:
+                            fut.set_result(toks)
+                        except Exception:  # noqa: BLE001 — close() won the race
+                            pass
 
         try:
             while not self._closed.is_set():
@@ -1394,9 +1656,7 @@ class ServingEngine:
                     finish(self.cb.process_chunk(pending_handle))
                     pending_handle = None
                     if self.cb.slots:
-                        self.stats["max_active"] = max(
-                            self.stats["max_active"], self.cb.active
-                        )
+                        self._note_active()
                         if (
                             pipelined
                             and pending_spec is not None
@@ -1409,7 +1669,7 @@ class ServingEngine:
                             nxt = self.cb.step_spec_async()
                             drain_spec()
                             pending_spec = nxt
-                            self.stats["chunks"] += 1
+                            self._bump("chunks")
                         else:
                             # Acceptance-preserving sync order: fetch and
                             # re-anchor on real history before drafting.
@@ -1421,7 +1681,7 @@ class ServingEngine:
                                     pending_spec = h
                                 else:
                                     finish(self.cb.process_spec_chunk(h))
-                                self.stats["chunks"] += 1
+                                self._bump("chunks")
                     elif pending_spec is not None:
                         drain_spec()
                 elif self.cb.slots:
@@ -1431,9 +1691,9 @@ class ServingEngine:
                         drain_spec()
                     if not self.cb.slots:
                         continue  # the drain retired the whole pool
-                    self.stats["max_active"] = max(self.stats["max_active"], self.cb.active)
+                    self._note_active()
                     handle = self.cb.step_async()
-                    self.stats["chunks"] += 1
+                    self._bump("chunks")
                     if not pipelined:
                         finish(self.cb.process_chunk(handle))
                     else:
@@ -1449,6 +1709,21 @@ class ServingEngine:
             # kill this thread silently: every pending Future would hang
             # forever and later submits would enqueue into a dead loop.
             # Mark closed (new submits raise) and fail everything pending.
+            # The flight recorder dumps automatically here — the "why" of
+            # a stochastic 500 is one log line / one /flightrecorder
+            # fetch, not log archaeology.
+            self._mx["errors"].inc()
+            if self.recorder is not None:
+                self.recorder.record(
+                    "engine_error", error=f"{type(e).__name__}: {e}"
+                )
+                try:
+                    log.error(
+                        "serving engine %s loop died (%s: %s); flight recorder dump: %s",
+                        self.name, type(e).__name__, e, self.recorder.dump_json(),
+                    )
+                except Exception:  # noqa: BLE001 — telemetry must not mask the death
+                    pass
             with self._submit_lock:
                 self._closed.set()
             self._fail_all(RuntimeError(f"ServingEngine loop died: {type(e).__name__}: {e}"))

@@ -14,8 +14,10 @@ concurrent pre-flight checks into one device call (the <10 ms p50 path).
 
 from __future__ import annotations
 
+import time
 from typing import List, Optional, Sequence
 
+from kakveda_tpu.core import metrics as _metrics
 from kakveda_tpu.core.config import ConfigStore
 from kakveda_tpu.core.fingerprint import signature_text
 from kakveda_tpu.core.schemas import WarningRequest, WarningResponse
@@ -31,16 +33,27 @@ class WarningPolicy:
     def __init__(self, gfkb: GFKB, config: Optional[ConfigStore] = None):
         self.gfkb = gfkb
         self.config = config or ConfigStore()
+        reg = _metrics.get_registry()
+        self._m_batch = reg.histogram(
+            "kakveda_warn_batch_seconds",
+            "Device kNN match wall per warn batch",
+        )
+        self._m_verdicts = reg.counter(
+            "kakveda_warn_requests_total",
+            "Pre-flight warn verdicts by action", ("action",),
+        )
 
     def warn(self, req: WarningRequest) -> WarningResponse:
         return self.warn_batch([req])[0]
 
     def warn_batch(self, reqs: Sequence[WarningRequest]) -> List[WarningResponse]:
+        t0 = time.perf_counter()
         threshold = self.config.similarity_threshold()
         default_action = self.config.default_action()
 
         sigs = [signature_text(r.prompt, r.tools, r.env) for r in reqs]
         all_matches = self.gfkb.match_batch(sigs)
+        self._m_batch.observe(time.perf_counter() - t0)
         patterns = self.gfkb.list_patterns()
 
         out: List[WarningResponse] = []
@@ -79,4 +92,6 @@ class WarningPolicy:
                         message="No high-similarity match found in GFKB.",
                     )
                 )
+        for r in out:
+            self._m_verdicts.labels(action=r.action).inc()
         return out

@@ -22,11 +22,13 @@ pub/sub contract.
 from __future__ import annotations
 
 import os
+import time
 from pathlib import Path
 from typing import List, Optional, Sequence
 
 from jax.sharding import Mesh
 
+from kakveda_tpu.core import metrics as _metrics
 from kakveda_tpu.core.config import ConfigStore
 from kakveda_tpu.core.fingerprint import signature_text
 from kakveda_tpu.core.schemas import (
@@ -99,6 +101,22 @@ class Platform:
         self.bus.subscribe(TOPIC_TRACE_INGESTED, self._on_trace_event)
         self.bus.subscribe(TOPIC_FAILURE_DETECTED, self._on_failure_event)
 
+        # Pipeline counters on the process-global metrics plane (scraped
+        # at GET /metrics; children resolved once, not per batch).
+        reg = _metrics.get_registry()
+        self._m_traces = reg.counter(
+            "kakveda_ingest_traces_total",
+            "Traces classified by the intelligence pipeline",
+        )
+        self._m_failures = reg.counter(
+            "kakveda_ingest_failures_total",
+            "Failure signals detected by the classifier tier",
+        )
+        self._m_batch_wall = reg.histogram(
+            "kakveda_ingest_batch_seconds",
+            "Classify+embed+insert wall per ingest batch",
+        )
+
     # ------------------------------------------------------------------
     # event reactors (dict payloads — the bus speaks JSON shapes)
     # ------------------------------------------------------------------
@@ -117,9 +135,12 @@ class Platform:
     # ------------------------------------------------------------------
 
     async def _classify_and_record(self, traces: Sequence[TracePayload]) -> List[FailureSignal]:
+        t0 = time.perf_counter()
+        self._m_traces.inc(len(traces))
         signals = self.classifier.classify_batch(traces)
         found = [(t, s) for t, s in zip(traces, signals) if s is not None]
         if not found:
+            self._m_batch_wall.observe(time.perf_counter() - t0)
             return []
         self.gfkb.upsert_failures_batch(
             [
@@ -150,6 +171,8 @@ class Platform:
                 [s.model_dump(mode="json") for s in signals_found],
                 exclude=exclude,
             )
+        self._m_failures.inc(len(signals_found))
+        self._m_batch_wall.observe(time.perf_counter() - t0)
         return signals_found
 
     async def ingest(self, trace: TracePayload) -> None:

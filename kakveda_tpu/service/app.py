@@ -13,6 +13,9 @@ Route map (reference originals in parentheses):
   POST /subscribe /publish, GET /topics
                                 (event-bus:8100, services/event_bus/app.py:28-59)
   GET  /healthz /readyz         (liveness/readiness)
+  GET  /metrics /flightrecorder (metrics plane — Prometheus exposition +
+                                 serving flight-recorder dump; also mounted
+                                 on the dashboard. docs/observability.md)
 
 The warn route drains through a MicroBatcher so concurrent pre-flight
 checks share one device call. External subscribers registered via
@@ -49,11 +52,46 @@ def _json_error(status: int, message: str) -> web.Response:
     return web.json_response({"ok": False, "error": message}, status=status)
 
 
+def metrics_routes() -> list:
+    """The metrics-plane routes, shared by the service app AND the
+    dashboard (one registry per process — scraping either port sees the
+    whole picture):
+
+      GET /metrics         Prometheus text exposition of the process-global
+                           registry (serving lifecycle, spec gate, pipeline,
+                           bus — see docs/observability.md for the catalog).
+      GET /flightrecorder  JSON dump of every live flight recorder's ring
+                           (recent request timelines + gate/k transitions
+                           per serving engine).
+    """
+    from kakveda_tpu.core import metrics as _metrics
+
+    async def metrics_ep(request):
+        return web.Response(
+            body=_metrics.get_registry().render().encode("utf-8"),
+            headers={"Content-Type": _metrics.PROMETHEUS_CONTENT_TYPE},
+        )
+
+    async def flightrecorder_ep(request):
+        return web.json_response({"recorders": _metrics.dump_recorders()})
+
+    return [
+        web.get("/metrics", metrics_ep),
+        web.get("/flightrecorder", flightrecorder_ep),
+    ]
+
+
 @web.middleware
 async def request_context_middleware(request: web.Request, handler):
-    """Request id + duration logging (reference: dashboard app.py:590-611)."""
+    """Request id + duration logging (reference: dashboard app.py:590-611).
+
+    When the otel middleware runs outside this one it already resolved the
+    request id (and put it on the span); reuse it so logs, the echoed
+    header and the trace all carry ONE id."""
     cfg = get_runtime_config(service_name="kakveda-tpu")
-    rid = ensure_request_id(request.headers.get(cfg.request_id_header))
+    rid = request.get("request_id") or ensure_request_id(
+        request.headers.get(cfg.request_id_header)
+    )
     started = time.perf_counter()
     try:
         response = await handler(request)
@@ -285,6 +323,7 @@ def make_app(platform: Optional[Platform] = None, **platform_kw) -> web.Applicat
             web.get("/topics", topics),
         ]
     )
+    app.add_routes(metrics_routes())
     return app
 
 

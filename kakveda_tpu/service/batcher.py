@@ -16,6 +16,8 @@ from __future__ import annotations
 import asyncio
 from typing import Awaitable, Callable, Generic, List, Sequence, Tuple, TypeVar
 
+from kakveda_tpu.core import metrics as _metrics
+
 TReq = TypeVar("TReq")
 TRes = TypeVar("TRes")
 
@@ -27,12 +29,23 @@ class MicroBatcher(Generic[TReq, TRes]):
         *,
         max_batch: int = 64,
         deadline_s: float = 0.002,
+        name: str = "warn",
     ):
         self._run_batch = run_batch
         self.max_batch = max_batch
         self.deadline_s = deadline_s
         self._queue: asyncio.Queue[Tuple[TReq, asyncio.Future]] = asyncio.Queue()
         self._task: asyncio.Task | None = None
+        reg = _metrics.get_registry()
+        self._m_depth = reg.gauge(
+            "kakveda_microbatch_queue_depth",
+            "Requests waiting in a micro-batcher queue", ("batcher",),
+        ).labels(batcher=name)
+        self._m_size = reg.histogram(
+            "kakveda_microbatch_batch_size",
+            "Coalesced batch size per micro-batcher drain", ("batcher",),
+            buckets=(1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0),
+        ).labels(batcher=name)
 
     def start(self) -> None:
         if self._task is None or self._task.done():
@@ -71,6 +84,8 @@ class MicroBatcher(Generic[TReq, TRes]):
         loop = asyncio.get_running_loop()
         while True:
             batch = await self._collect()
+            self._m_size.observe(len(batch))
+            self._m_depth.set(self._queue.qsize())
             reqs = [r for r, _ in batch]
             try:
                 # The device call is sync; run it off-loop so new requests

@@ -1,0 +1,106 @@
+#!/usr/bin/env python
+"""Static check: every ``KAKVEDA_*`` env knob the code reads must be
+documented.
+
+An undocumented knob is an outage waiting for an operator: the serving
+levers (KAKVEDA_SERVE_*), the bench sweep controls and the metrics-plane
+sizing all change production behavior, and the only discoverable surface
+is the docs. This script greps the *code* tree for knob references and the
+*docs* corpus (CLAUDE.md, README.md, TROUBLESHOOTING.md, BASELINE.md,
+docs/**/*.md) for mentions; anything referenced but never documented fails
+the check. Runs in tier-1 via tests/test_knobs.py.
+
+Usage: ``python scripts/check_knobs.py [repo_root]`` — exits nonzero and
+lists the undocumented knobs on stdout.
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+from pathlib import Path
+
+KNOB_RE = re.compile(r"KAKVEDA_[A-Z0-9_]+")
+
+# Code that can introduce operator-facing knobs. Tests are deliberately
+# excluded: KAKVEDA_TEST_* style fixtures are not operator surface.
+CODE_PATHS = ("kakveda_tpu", "scripts", "bench.py", "__graft_entry__.py")
+DOC_PATHS = ("CLAUDE.md", "README.md", "TROUBLESHOOTING.md", "BASELINE.md", "docs")
+
+# Internal/cross-process plumbing set by our own launchers, not operators.
+ALLOWLIST = frozenset({
+    "KAKVEDA_PROCESS_ID",  # set per-process by the multihost launcher
+})
+
+
+def _md_files(root: Path):
+    for rel in DOC_PATHS:
+        p = root / rel
+        if p.is_file():
+            yield p
+        elif p.is_dir():
+            yield from sorted(p.rglob("*.md"))
+
+
+def _code_files(root: Path):
+    for rel in CODE_PATHS:
+        p = root / rel
+        if p.is_file():
+            yield p
+        elif p.is_dir():
+            yield from sorted(p.rglob("*.py"))
+
+
+def referenced_knobs(root: Path) -> dict:
+    """knob -> sorted list of repo-relative files referencing it."""
+    refs: dict = {}
+    for f in _code_files(root):
+        try:
+            text = f.read_text(errors="replace")
+        except OSError:
+            continue
+        for m in set(KNOB_RE.findall(text)):
+            if m.rstrip("_") != m or m == "KAKVEDA_":
+                continue
+            refs.setdefault(m, []).append(str(f.relative_to(root)))
+    for files in refs.values():
+        files.sort()
+    return refs
+
+
+def documented_knobs(root: Path) -> set:
+    docs: set = set()
+    for f in _md_files(root):
+        try:
+            docs.update(KNOB_RE.findall(f.read_text(errors="replace")))
+        except OSError:
+            continue
+    return docs
+
+
+def undocumented_knobs(root: Path) -> dict:
+    """knob -> referencing files, for every knob the docs never mention."""
+    refs = referenced_knobs(root)
+    docs = documented_knobs(root)
+    return {
+        k: v for k, v in sorted(refs.items())
+        if k not in docs and k not in ALLOWLIST
+    }
+
+
+def main(argv: list) -> int:
+    root = Path(argv[1]).resolve() if len(argv) > 1 else Path(__file__).resolve().parents[1]
+    missing = undocumented_knobs(root)
+    if not missing:
+        print(f"check_knobs: all {len(referenced_knobs(root))} KAKVEDA_* knobs documented")
+        return 0
+    print(f"check_knobs: {len(missing)} undocumented KAKVEDA_* knob(s):")
+    for knob, files in missing.items():
+        print(f"  {knob}  (referenced by {', '.join(files[:3])}"
+              f"{', …' if len(files) > 3 else ''})")
+    print("document them in CLAUDE.md or docs/ (see docs/observability.md knob registry)")
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

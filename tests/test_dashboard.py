@@ -354,7 +354,7 @@ def test_playground_concurrent_requests_share_engine(tmp_path, monkeypatch):
 
     run(go())
     assert rt._engine is not None, "playground did not go through the engine"
-    assert rt._engine.stats["completed"] == len(prompts)
+    assert rt._engine.stats()["completed"] == len(prompts)
     rt._engine.close()
 
 

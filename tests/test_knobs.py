@@ -1,0 +1,36 @@
+"""Tier-1 guard: every KAKVEDA_* env knob the code references must be
+documented (CLAUDE.md / docs/) — scripts/check_knobs.py run as a test so
+an undocumented operator lever fails CI, not a 3am debugging session."""
+
+import subprocess
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parents[1]
+
+
+def test_every_kakveda_knob_is_documented():
+    r = subprocess.run(
+        [sys.executable, str(ROOT / "scripts" / "check_knobs.py"), str(ROOT)],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert r.returncode == 0, f"\n{r.stdout}\n{r.stderr}"
+
+
+def test_checker_catches_an_undocumented_knob(tmp_path):
+    """The checker itself works: a synthetic tree with one undocumented
+    knob fails and names it."""
+    (tmp_path / "kakveda_tpu").mkdir()
+    (tmp_path / "kakveda_tpu" / "x.py").write_text(
+        'import os\nos.environ.get("KAKVEDA_TOTALLY_NEW_KNOB")\n'
+        'os.environ.get("KAKVEDA_DOCUMENTED_KNOB")\n'
+    )
+    (tmp_path / "docs").mkdir()
+    (tmp_path / "docs" / "a.md").write_text("`KAKVEDA_DOCUMENTED_KNOB` does x\n")
+    r = subprocess.run(
+        [sys.executable, str(ROOT / "scripts" / "check_knobs.py"), str(tmp_path)],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert r.returncode == 1
+    missing = [ln.strip().split()[0] for ln in r.stdout.splitlines() if ln.startswith("  KAKVEDA_")]
+    assert missing == ["KAKVEDA_TOTALLY_NEW_KNOB"]

@@ -1,0 +1,312 @@
+"""The metrics plane: registry exposition format, concurrent-scrape
+safety, serving-engine lifecycle instrumentation, flight-recorder
+round-trips, and the /metrics + /flightrecorder HTTP endpoints."""
+
+import asyncio
+import json
+import threading
+
+import jax
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from kakveda_tpu.core import metrics as m
+
+
+# ---------------------------------------------------------------------------
+# exposition format
+# ---------------------------------------------------------------------------
+
+
+def test_counter_gauge_exposition_help_type_and_escaping():
+    reg = m.MetricsRegistry(preregister=False)
+    c = reg.counter("demo_total", "a counter", ("who",))
+    c.labels(who='he said "hi"\\here\nline').inc(3)
+    g = reg.gauge("depth", "a gauge")
+    g.set(2.5)
+    text = reg.render()
+    lines = text.splitlines()
+    assert "# HELP demo_total a counter" in lines
+    assert "# TYPE demo_total counter" in lines
+    assert "# TYPE depth gauge" in lines
+    # label escaping: backslash, double quote, and newline all escape
+    assert 'demo_total{who="he said \\"hi\\"\\\\here\\nline"} 3' in lines
+    assert "depth 2.5" in lines
+    # HELP precedes TYPE precedes samples, per family
+    hi, ti = lines.index("# HELP demo_total a counter"), lines.index("# TYPE demo_total counter")
+    si = next(i for i, ln in enumerate(lines) if ln.startswith("demo_total{"))
+    assert hi < ti < si
+
+
+def test_histogram_buckets_monotone_inf_and_sum():
+    reg = m.MetricsRegistry(preregister=False)
+    h = reg.histogram("lat_seconds", "latency", (), buckets=(0.01, 0.1, 1.0))
+    for v in (0.005, 0.05, 0.5, 5.0, 0.05):
+        h.observe(v)
+    lines = reg.render().splitlines()
+    buckets = [ln for ln in lines if ln.startswith("lat_seconds_bucket")]
+    # le values render in ascending order ending at +Inf
+    assert [ln.split("le=")[1].split("}")[0] for ln in buckets] == [
+        '"0.01"', '"0.1"', '"1"', '"+Inf"',
+    ]
+    counts = [int(ln.rsplit(" ", 1)[1]) for ln in buckets]
+    assert counts == sorted(counts), "cumulative bucket counts must be monotone"
+    assert counts[-1] == 5  # +Inf == observation count
+    assert "lat_seconds_count 5" in lines
+    sum_line = next(ln for ln in lines if ln.startswith("lat_seconds_sum"))
+    assert abs(float(sum_line.split(" ")[1]) - 5.605) < 1e-9
+
+
+def test_registry_get_or_create_and_shape_conflicts():
+    reg = m.MetricsRegistry(preregister=False)
+    a = reg.counter("x_total", "x", ("l",))
+    assert reg.counter("x_total", "ignored", ("l",)) is a
+    with pytest.raises(ValueError):
+        reg.gauge("x_total", "x", ("l",))  # type conflict
+    with pytest.raises(ValueError):
+        reg.counter("x_total", "x", ("other",))  # labelname conflict
+    with pytest.raises(ValueError):
+        a.labels(wrong="v")  # label key mismatch
+
+
+def test_concurrent_updates_while_scraping():
+    """Scrape safety: renders interleaved with updates never raise and
+    never lose counts."""
+    reg = m.MetricsRegistry(preregister=False)
+    c = reg.counter("hits_total", "h", ("t",))
+    h = reg.histogram("obs_seconds", "o", (), buckets=(0.5,))
+    N, T = 2000, 4
+    children = [c.labels(t=str(i)) for i in range(T)]
+
+    def work(i):
+        for _ in range(N):
+            children[i].inc()
+            h.observe(0.1)
+
+    threads = [threading.Thread(target=work, args=(i,)) for i in range(T)]
+    for t in threads:
+        t.start()
+    # Scrape while updates are (likely) in flight — and a fixed number of
+    # times regardless, so the assertion never depends on thread timing.
+    for _ in range(50):
+        text = reg.render()
+        assert "hits_total" in text
+    for t in threads:
+        t.join()
+    final = reg.render().splitlines()
+    vals = [int(ln.rsplit(" ", 1)[1]) for ln in final if ln.startswith("hits_total{")]
+    assert sum(vals) == N * T
+    assert f"obs_seconds_count {N * T}" in final
+
+
+def test_preregistered_catalog_is_self_describing():
+    """A bare scrape of the default registry already names the serving
+    TTFT / tokens-per-second / gate-state families (HELP/TYPE lines)."""
+    text = m.get_registry().render()
+    for fam in (
+        "kakveda_serving_ttft_seconds",
+        "kakveda_serving_tokens_per_second",
+        "kakveda_serving_spec_gate_state",
+        "kakveda_serving_queue_wait_seconds",
+    ):
+        assert f"# TYPE {fam} " in text, fam
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+
+def test_flight_recorder_ring_bound_and_json_roundtrip():
+    fr = m.FlightRecorder("test/ring", capacity=4)
+    for i in range(9):
+        fr.record("request", request_id=i, wall_ms=1.5 * i)
+    events = fr.dump()
+    assert [e["request_id"] for e in events] == [5, 6, 7, 8]
+    # round-trips through JSON unchanged
+    assert json.loads(json.dumps(events)) == events
+    assert json.loads(fr.dump_json())["name"] == "test/ring"
+    # the global dump enumerates this recorder by name
+    names = [r["name"] for r in m.dump_recorders()]
+    assert "test/ring" in names
+
+
+def test_flight_recorder_capacity_zero_disables():
+    fr = m.FlightRecorder("test/off", capacity=0)
+    fr.record("request", request_id=1)
+    assert fr.dump() == []
+
+
+# ---------------------------------------------------------------------------
+# serving-engine lifecycle instrumentation
+# ---------------------------------------------------------------------------
+
+CFG = None
+
+
+def _tiny_cfg():
+    global CFG
+    if CFG is None:
+        import jax.numpy as jnp
+
+        from kakveda_tpu.models.llama import LlamaConfig
+
+        CFG = LlamaConfig(
+            vocab_size=264, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+            d_ff=128, max_seq_len=128, dtype=jnp.float32,
+        )
+    return CFG
+
+
+def test_serving_engine_lifecycle_metrics_and_recorder():
+    from kakveda_tpu.models.llama import init_params
+    from kakveda_tpu.models.serving import ServingEngine
+
+    cfg = _tiny_cfg()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    eng = ServingEngine(
+        params, cfg, batch_slots=2, max_len=64, chunk_steps=4,
+        name="metrics-test",
+    )
+    try:
+        prompts = [[5, 6, 7], [9, 8], [41, 42, 43]]
+        futs = [eng.submit(p, max_new_tokens=6) for p in prompts]
+        outs = [f.result(timeout=120) for f in futs]
+        assert all(len(o) > 0 for o in outs)
+
+        # stats() is a snapshot: mutating it must not touch engine state
+        s = eng.stats()
+        assert s["completed"] == 3
+        s["spec"]["k_trace"].append(999)
+        assert 999 not in eng.cb.spec_stats["k_trace"]
+
+        # lifecycle histograms landed under this engine's label
+        text = m.get_registry().render()
+        assert 'kakveda_serving_ttft_seconds_count{engine="metrics-test"} 3' in text
+        assert 'kakveda_serving_request_seconds_count{engine="metrics-test"} 3' in text
+        assert 'kakveda_serving_tokens_per_second_count{engine="metrics-test"} 3' in text
+        assert (
+            'kakveda_serving_requests_total{engine="metrics-test",outcome="completed"} 3'
+            in text
+        )
+        # gate-state gauge: spec disabled pool advertises state=disabled
+        assert (
+            'kakveda_serving_spec_gate_state{engine="metrics-test",state="disabled"} 1'
+            in text
+        )
+
+        # the flight recorder holds one timeline per request with the
+        # correlating fields
+        reqs = [e for e in eng.recorder.dump() if e["kind"] == "request"]
+        assert len(reqs) == 3
+        for e in reqs:
+            for key in ("request_id", "queue_wait_ms", "ttft_ms", "wall_ms",
+                        "tokens", "tokens_per_s"):
+                assert key in e, key
+            assert e["tokens"] > 0
+        # the engine timeline also rides the caller's Future
+        assert futs[0].timeline["tokens"] == len(outs[0])
+    finally:
+        eng.close()
+
+
+# ---------------------------------------------------------------------------
+# HTTP endpoints
+# ---------------------------------------------------------------------------
+
+
+def _get_many(app, paths):
+    """One event loop for all requests — an aiohttp app binds to the loop
+    it first serves on."""
+
+    async def go():
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        out = []
+        try:
+            for path in paths:
+                r = await client.get(path)
+                out.append((r.status, r.headers.get("Content-Type", ""), await r.read()))
+        finally:
+            await client.close()
+        return out
+
+    return asyncio.run(go())
+
+
+def test_service_metrics_and_flightrecorder_endpoints(tmp_path):
+    from kakveda_tpu.platform import Platform
+    from kakveda_tpu.service.app import make_app
+
+    plat = Platform(data_dir=tmp_path / "data", capacity=256, dim=1024)
+    app = make_app(plat)
+
+    (status, ctype, body), (fstatus, _, fbody) = _get_many(
+        app, ["/metrics", "/flightrecorder"]
+    )
+    assert status == 200
+    assert ctype.startswith("text/plain")
+    text = body.decode()
+    assert "# TYPE kakveda_serving_ttft_seconds histogram" in text
+    assert "# TYPE kakveda_serving_tokens_per_second histogram" in text
+    assert "# TYPE kakveda_serving_spec_gate_state gauge" in text
+    assert "# TYPE kakveda_ingest_traces_total counter" in text
+
+    assert fstatus == 200
+    payload = json.loads(fbody)
+    assert isinstance(payload["recorders"], list)
+
+
+def test_dashboard_mounts_metrics_routes(tmp_path):
+    from kakveda_tpu.dashboard.app import make_dashboard_app
+    from kakveda_tpu.platform import Platform
+
+    plat = Platform(data_dir=tmp_path / "data", capacity=256, dim=1024)
+    app = make_dashboard_app(platform=plat, db_path=tmp_path / "dash.db")
+    (mstatus, _, mbody), (fstatus, _, fbody) = _get_many(
+        app, ["/metrics", "/flightrecorder"]
+    )
+    assert mstatus == 200 and b"kakveda_serving_ttft_seconds" in mbody
+    assert fstatus == 200 and b"recorders" in fbody
+
+
+def test_ingest_traffic_lands_on_metrics_plane(tmp_path):
+    """POST /ingest moves the pipeline counters the scrape reports."""
+    from kakveda_tpu.platform import Platform
+    from kakveda_tpu.service.app import make_app
+
+    def series_value(name):
+        snap = m.get_registry().snapshot()
+        return sum(snap.get(name, {}).get("series", {}).values()) or 0
+
+    before = series_value("kakveda_ingest_traces_total")
+    plat = Platform(data_dir=tmp_path / "data", capacity=256, dim=1024)
+    app = make_app(plat)
+
+    async def go():
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            r = await client.post(
+                "/ingest",
+                json={
+                    "trace": {
+                        "trace_id": "t-metrics-1",
+                        "ts": "2026-08-04T00:00:00Z",
+                        "app_id": "metrics-app",
+                        "agent_id": "a",
+                        "prompt": "Cite sources",
+                        "response": "References:\n[1] Fake (2020)",
+                        "model": "stub",
+                        "temperature": 0.1,
+                        "tools": [],
+                        "env": {},
+                    }
+                },
+            )
+            assert r.status == 200
+        finally:
+            await client.close()
+
+    asyncio.run(go())
+    assert series_value("kakveda_ingest_traces_total") >= before + 1

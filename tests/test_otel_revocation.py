@@ -102,3 +102,95 @@ def test_otel_enabled_creates_tracer(monkeypatch):
     run(go())
     monkeypatch.setattr(otel, "_setup_done", False)
     monkeypatch.setattr(otel, "_tracer", None)
+
+
+def test_make_app_and_dashboard_install_otel_middleware(tmp_path, monkeypatch):
+    """The satellite contract: when otel is enabled, BOTH app factories
+    actually install the otel middleware (outermost, so the span covers
+    the request-context middleware too)."""
+    from kakveda_tpu.service.app import make_app
+
+    sentinel = object()
+    monkeypatch.setattr(otel, "setup_otel", lambda name: True)
+    monkeypatch.setattr(otel, "otel_middleware", lambda: sentinel)
+
+    plat = Platform(data_dir=tmp_path / "data", capacity=256, dim=1024)
+    app = make_app(plat)
+    assert app.middlewares[0] is sentinel
+
+    dash = make_dashboard_app(
+        platform=plat, db_path=tmp_path / "dash.db", model=StubRuntime()
+    )
+    assert dash.middlewares[0] is sentinel
+
+
+def test_otel_middleware_records_request_id_and_span_events(monkeypatch):
+    """With a (fake) tracer installed, the server span carries request.id
+    equal to the echoed x-request-id header, and add_span_events attaches
+    the serving timeline (non-scalar values dropped) to the current span."""
+    import contextlib
+    import sys
+    import types
+
+    from aiohttp import web
+
+    from kakveda_tpu.service.app import request_context_middleware
+
+    recorded = {}
+
+    class FakeSpan:
+        def set_attribute(self, k, v):
+            recorded[k] = v
+
+        def add_event(self, name, attrs):
+            recorded.setdefault("events", []).append((name, dict(attrs)))
+
+        def is_recording(self):
+            return True
+
+        def set_status(self, s):
+            pass
+
+    fake_span = FakeSpan()
+
+    tr = types.ModuleType("opentelemetry.trace")
+    tr.SpanKind = types.SimpleNamespace(SERVER="server")
+    tr.Status = lambda code, desc=None: (code, desc)
+    tr.StatusCode = types.SimpleNamespace(ERROR="error")
+    tr.get_current_span = lambda: fake_span
+    ot = types.ModuleType("opentelemetry")
+    ot.trace = tr
+    monkeypatch.setitem(sys.modules, "opentelemetry", ot)
+    monkeypatch.setitem(sys.modules, "opentelemetry.trace", tr)
+
+    class FakeTracer:
+        @contextlib.contextmanager
+        def start_as_current_span(self, name, kind=None):
+            yield fake_span
+
+    monkeypatch.setattr(otel, "_tracer", FakeTracer())
+
+    async def go():
+        app = web.Application(
+            middlewares=[otel.otel_middleware(), request_context_middleware]
+        )
+
+        async def ping(request):
+            return web.json_response({"ok": True})
+
+        app.router.add_get("/ping", ping)
+        client = await _client(app)
+        try:
+            r = await client.get("/ping", headers={"x-request-id": "rid-123"})
+            assert r.status == 200
+            # one id end to end: span attribute == echoed header
+            assert r.headers["x-request-id"] == "rid-123"
+        finally:
+            await client.close()
+
+    run(go())
+    assert recorded["request.id"] == "rid-123"
+    assert recorded["http.response.status_code"] == 200
+
+    otel.add_span_events("serving.timeline", {"ttft_ms": 1.5, "refs": [1, 2]})
+    assert ("serving.timeline", {"ttft_ms": 1.5}) in recorded["events"]

@@ -164,8 +164,8 @@ def test_serving_engine_concurrent_requests_one_pool():
         futs = [eng.submit(p, max_new_tokens=10) for p in prompts]
         outs = [f.result(timeout=120) for f in futs]
         assert outs == solo
-        assert eng.stats["completed"] == len(prompts)
-        assert eng.stats["max_active"] >= 2  # actually shared, not serialized
+        assert eng.stats()["completed"] == len(prompts)
+        assert eng.stats()["max_active"] >= 2  # actually shared, not serialized
         # per-request budgets: a late admit with its own max_tokens
         late = eng.generate_ids(prompts[0], max_new_tokens=3)
         assert late == solo[0][:3]
@@ -219,13 +219,13 @@ def test_runtime_generate_routes_through_engine(monkeypatch):
         on = list(ex.map(lambda p: rt.generate(p, max_tokens=10), prompts))
     assert [r.text for r in on] == [r.text for r in off]
     assert all(r.meta.get("continuous") for r in on)
-    assert rt._engine is not None and rt._engine.stats["completed"] == 3
+    assert rt._engine is not None and rt._engine.stats()["completed"] == 3
 
     # batch entry joins the same shared pool
     batch = rt.generate_batch(prompts, max_tokens=10)
     assert [r.text for r in batch] == [r.text for r in off]
     assert all(r.meta.get("continuous") for r in batch)
-    assert rt._engine.stats["completed"] == 6
+    assert rt._engine.stats()["completed"] == 6
 
     # oversized budget → solo fallback, same engine still alive
     monkeypatch.setenv("KAKVEDA_SERVE_WINDOW", "32")

@@ -151,4 +151,4 @@ def test_concurrent_playground_load_with_warn_stream(tmp_path, tiny_runtime):
     # All generations went through ONE shared engine (continuous batching),
     # not per-request pools.
     assert rt._engine is not None
-    assert rt._engine.stats["completed"] >= N_CLIENTS * REQS_PER_CLIENT
+    assert rt._engine.stats()["completed"] >= N_CLIENTS * REQS_PER_CLIENT

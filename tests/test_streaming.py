@@ -102,7 +102,7 @@ def test_engine_cancel_queued_request():
         with pytest.raises(CancelledError):
             waiting.result(timeout=60)
         assert len(first.result(timeout=120)) > 0  # the running one completes
-        assert eng.stats["completed"] == 1
+        assert eng.stats()["completed"] == 1
     finally:
         eng.close()
 
