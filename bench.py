@@ -1377,6 +1377,16 @@ def _bench_mixed_decode(backend: str) -> dict:
     }
 
 
+def _bus_dlq_count() -> int:
+    """Process-cumulative dead-lettered event count off the metrics plane
+    (kakveda_bus_dlq_total) — folded into the serve metric so a chaos'd
+    bench line carries its own DLQ evidence."""
+    from kakveda_tpu.core import metrics as _metrics
+
+    fam = _metrics.get_registry().snapshot().get("kakveda_bus_dlq_total", {})
+    return int(sum(v for v in fam.get("series", {}).values() if isinstance(v, (int, float))))
+
+
 def _bench_serve(backend: str) -> dict:
     """Concurrent-HTTP serving SLOs: N separate logged-in clients drive
     playground generation through a REAL aiohttp dashboard server (all
@@ -1518,9 +1528,11 @@ def _bench_serve(backend: str) -> dict:
             return t_wall
 
         wall = asyncio.run(go())
-        completed = 0
+        completed = restarts = 0
         if rt._engine is not None:
-            completed = rt._engine.stats()["completed"]
+            est = rt._engine.stats()
+            completed = est["completed"]
+            restarts = est.get("restarts", 0)
             rt._engine.close()
         p50, p95 = (float(x) for x in np.percentile(lat_play, [50, 95]))
         return {
@@ -1532,6 +1544,7 @@ def _bench_serve(backend: str) -> dict:
             "n_reqs": len(lat_play),
             "seq_est": float(np.sum(lat_play)),
             "completed": completed,
+            "restarts": restarts,
             "ttft_p50": float(np.percentile(lat_ttft, 50)) if lat_ttft else 0.0,
         }
 
@@ -1584,6 +1597,11 @@ def _bench_serve(backend: str) -> dict:
         "agg_tokens_per_sec": round(tok_s, 1),
         "warn_p95_ms_under_load": round(r["p95_warn"] * 1000, 2),
         "engine_completed": r["completed"],
+        # Robustness plane: zero in a healthy run — nonzero restarts or
+        # dead-lettered events mean the workload survived real failures
+        # (or a KAKVEDA_FAULTS chaos arm was active for this sweep).
+        "engine_restarts": base["restarts"] + r["restarts"],
+        "dlq_events": _bus_dlq_count(),
         "preset": preset,
         "unpipelined_p95_ms": round(base["p95"] * 1000, 1),
         "pipeline_p95_gain": round(base["p95"] / max(r["p95"], 1e-9), 2),

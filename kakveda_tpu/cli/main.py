@@ -1,4 +1,4 @@
-"""`kakveda-tpu` CLI: init | up | down | status | reset | logs | doctor | version.
+"""`kakveda-tpu` CLI: init | up | down | status | reset | logs | dlq | doctor | version.
 
 Verb parity with the reference CLI (reference: kakveda_cli/cli.py:46-409),
 re-targeted at the single-process TPU platform: where the reference
@@ -306,6 +306,50 @@ def _cmd_down(args: argparse.Namespace) -> int:
     return 1
 
 
+def _cmd_dlq(args: argparse.Namespace) -> int:
+    """Inspect / replay the event bus dead-letter queue (data/dlq.jsonl —
+    events whose HTTP delivery exhausted its retries or short-circuited on
+    an open breaker; docs/robustness.md). ``list`` prints a summary,
+    ``replay`` re-POSTs every event and rewrites the file with what still
+    fails."""
+    dlq = Path(args.dir) / "data" / "dlq.jsonl"
+    if args.action == "replay":
+        from kakveda_tpu.events.bus import replay_dlq_file
+
+        out = replay_dlq_file(dlq, timeout=args.timeout)
+        print(json.dumps(out, indent=2))
+        return 0 if out["failed"] == 0 else 1
+    # list: per-(topic, url) counts plus the newest error, no event bodies
+    # (they can be large and may carry payload data).
+    if not dlq.exists():
+        print(json.dumps({"path": str(dlq), "events": 0, "entries": []}, indent=2))
+        return 0
+    groups: dict = {}
+    total = 0
+    for line in dlq.read_text(encoding="utf-8").splitlines():
+        if not line.strip():
+            continue
+        total += 1
+        try:
+            rec = json.loads(line)
+            key = (rec.get("topic"), rec.get("url"))
+            g = groups.setdefault(key, {"count": 0, "last_error": None, "last_ts": 0})
+            g["count"] += 1
+            if rec.get("ts", 0) >= g["last_ts"]:
+                g["last_ts"] = rec.get("ts", 0)
+                g["last_error"] = rec.get("error")
+        except ValueError:
+            groups.setdefault(("<malformed>", None), {"count": 0})["count"] += 1
+    print(json.dumps({
+        "path": str(dlq),
+        "events": total,
+        "entries": [
+            {"topic": t, "url": u, **g} for (t, u), g in sorted(groups.items(), key=str)
+        ],
+    }, indent=2))
+    return 0
+
+
 def _cmd_logs(args: argparse.Namespace) -> int:
     """Tail server.log (written by `up --detach`), optionally following —
     the reference's `logs` verb over a file instead of docker-compose
@@ -374,6 +418,12 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("-n", "--tail", type=int, default=50)
     sp.add_argument("-f", "--follow", action="store_true")
     sp.set_defaults(fn=_cmd_logs)
+
+    sp = sub.add_parser("dlq", help="inspect / replay the bus dead-letter queue")
+    sp.add_argument("action", nargs="?", choices=("list", "replay"), default="list")
+    sp.add_argument("--dir", default=".")
+    sp.add_argument("--timeout", type=float, default=5.0, help="per-POST replay timeout")
+    sp.set_defaults(fn=_cmd_dlq)
 
     sp = sub.add_parser("doctor", help="check the runtime environment")
     sp.add_argument("--dir", default=".", help="project root (for .env)")

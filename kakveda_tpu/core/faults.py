@@ -1,0 +1,170 @@
+"""Deterministic fault injection — the chaos harness the platform eats its
+own dogfood with.
+
+kakveda's premise is failure intelligence, so its own failure handling must
+be provable, not aspirational: the serving-engine supervisor, the bus's
+retry/breaker/DLQ path and the crash-safe log replay (docs/robustness.md)
+all need a way to *cause* the failures they claim to survive, on demand and
+reproducibly. This module is that switch.
+
+Design (mirrors the metrics plane's resolve-once pattern):
+
+* A **fault site** is a named point in the code (``engine.dispatch``,
+  ``bus.deliver``, ``gfkb.append``, …). Components resolve their sites ONCE
+  at construction/import via :func:`site` and keep the object; the hot-path
+  call is ``site.fire()`` — a single ``self.armed`` attribute check when
+  nothing is armed, so compiled-in sites cost nothing in production.
+* Arming is an env spec — ``KAKVEDA_FAULTS=site:prob:count,…`` (``prob``
+  defaults to 1.0, ``count`` to 1; ``count`` ``-1`` = unlimited) — parsed at
+  import, or programmatic via :func:`arm` (tests). Arming mutates the
+  existing site objects in place, so components constructed before
+  ``arm()`` still inject.
+* The RNG is seeded (``KAKVEDA_FAULTS_SEED``, default 0) so a probabilistic
+  chaos run replays the same injection sequence.
+* An injection raises :class:`FaultInjected` at the site and increments
+  ``kakveda_faults_injected_total{site=…}`` — chaos runs are observable on
+  the same /metrics plane as the recovery they exercise.
+
+The fault-site catalog lives in docs/robustness.md; adding a site means
+adding it there.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import random
+import threading
+from typing import Dict, Optional
+
+log = logging.getLogger("kakveda.faults")
+
+__all__ = ["FaultInjected", "FaultSite", "site", "arm", "disarm", "armed_sites"]
+
+
+class FaultInjected(RuntimeError):
+    """An armed fault site fired. Deliberately a RuntimeError subclass so
+    injected failures travel the exact error paths real device/IO failures
+    travel — the harness must not need special-cased handling."""
+
+    def __init__(self, site_name: str):
+        super().__init__(f"injected fault at {site_name} (KAKVEDA_FAULTS)")
+        self.site = site_name
+
+
+class FaultSite:
+    """One named injection point. ``fire()`` is the hot-path call: a bare
+    attribute check when unarmed, a lock + seeded draw when armed."""
+
+    __slots__ = ("name", "armed", "prob", "remaining", "fired")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.armed = False
+        self.prob = 0.0
+        self.remaining = 0  # -1 = unlimited
+        self.fired = 0
+
+    def fire(self) -> None:
+        if not self.armed:
+            return
+        _fire(self)
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return (
+            f"FaultSite({self.name!r}, armed={self.armed}, prob={self.prob}, "
+            f"remaining={self.remaining}, fired={self.fired})"
+        )
+
+
+_lock = threading.Lock()
+_sites: Dict[str, FaultSite] = {}
+_rng = random.Random(0)
+_m_injected = None  # resolved lazily: metrics must stay import-cycle-free
+
+
+def site(name: str) -> FaultSite:
+    """Get-or-create the site object for ``name`` — call once per component
+    (construction/import), keep the reference, ``fire()`` on the hot path."""
+    with _lock:
+        s = _sites.get(name)
+        if s is None:
+            s = _sites[name] = FaultSite(name)
+        return s
+
+
+def _fire(s: FaultSite) -> None:
+    with _lock:
+        if not s.armed:  # lost the race with disarm()
+            return
+        if s.prob < 1.0 and _rng.random() >= s.prob:
+            return
+        s.fired += 1
+        if s.remaining > 0:
+            s.remaining -= 1
+            if s.remaining == 0:
+                s.armed = False
+    global _m_injected
+    if _m_injected is None:
+        from kakveda_tpu.core import metrics as _metrics
+
+        _m_injected = _metrics.get_registry().counter(
+            "kakveda_faults_injected_total",
+            "Injected faults by site (KAKVEDA_FAULTS chaos harness)", ("site",),
+        )
+    _m_injected.labels(site=s.name).inc()
+    log.warning("fault injected at %s (fired=%d)", s.name, s.fired)
+    raise FaultInjected(s.name)
+
+
+def arm(spec: str, seed: Optional[int] = None) -> None:
+    """Arm sites from a ``site:prob:count,…`` spec (prob defaults to 1.0,
+    count to 1, count -1 = unlimited). Replaces the previous arming —
+    unlisted sites disarm. ``seed`` reseeds the shared RNG (default: keep)."""
+    parsed = []
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        fields = part.split(":")
+        name = fields[0]
+        try:
+            prob = float(fields[1]) if len(fields) > 1 and fields[1] else 1.0
+            count = int(fields[2]) if len(fields) > 2 and fields[2] else 1
+        except ValueError as e:
+            raise ValueError(f"bad KAKVEDA_FAULTS entry {part!r}: {e}") from e
+        parsed.append((name, prob, count))
+    with _lock:
+        if seed is not None:
+            _rng.seed(seed)
+        for s in _sites.values():
+            s.armed = False
+            s.prob = 0.0
+            s.remaining = 0
+        for name, prob, count in parsed:
+            s = _sites.get(name)
+            if s is None:
+                s = _sites[name] = FaultSite(name)
+            s.prob = prob
+            s.remaining = count
+            s.armed = count != 0
+            s.fired = 0  # each arming is a fresh experiment
+    if parsed:
+        log.warning("fault sites armed: %s", ", ".join(p[0] for p in parsed))
+
+
+def disarm() -> None:
+    """Disarm every site (counters survive for inspection)."""
+    arm("")
+
+
+def armed_sites() -> Dict[str, FaultSite]:
+    with _lock:
+        return {n: s for n, s in _sites.items() if s.armed}
+
+
+# Env arming at import: components resolving sites later still see it, and
+# a process started with KAKVEDA_FAULTS set injects from its first event.
+_env_spec = os.environ.get("KAKVEDA_FAULTS", "")
+if _env_spec:
+    arm(_env_spec, seed=int(os.environ.get("KAKVEDA_FAULTS_SEED", "0")))

@@ -393,6 +393,9 @@ _CORE_FAMILIES = (
     ("counter", "kakveda_serving_engine_errors_total",
      "Serving-engine loop deaths (flight recorder dumped on each)",
      ("engine",), None),
+    ("counter", "kakveda_serving_engine_restarts_total",
+     "Supervisor restarts of a serving-engine loop after a crash (bounded "
+     "by KAKVEDA_SERVE_RESTARTS)", ("engine",), None),
     ("counter", "kakveda_ingest_traces_total",
      "Traces classified by the intelligence pipeline", (), None),
     ("counter", "kakveda_ingest_failures_total",
@@ -409,6 +412,18 @@ _CORE_FAMILIES = (
      "Bus deliveries by result", ("result",), None),
     ("gauge", "kakveda_bus_inflight_deliveries",
      "Bus deliveries currently in flight", (), None),
+    ("counter", "kakveda_bus_delivery_attempts_total",
+     "URL delivery attempts by result (ok|retry|failed|short_circuit)",
+     ("result",), None),
+    ("counter", "kakveda_bus_breaker_transitions_total",
+     "Bus circuit-breaker state transitions", ("to",), None),
+    ("gauge", "kakveda_bus_breaker_open",
+     "URL subscribers whose circuit breaker is currently open", (), None),
+    ("counter", "kakveda_bus_dlq_total",
+     "Events dead-lettered after retries were exhausted or the breaker "
+     "short-circuited", (), None),
+    ("counter", "kakveda_faults_injected_total",
+     "Injected faults by site (KAKVEDA_FAULTS chaos harness)", ("site",), None),
     ("gauge", "kakveda_microbatch_queue_depth",
      "Requests waiting in a micro-batcher queue", ("batcher",), None),
     ("histogram", "kakveda_microbatch_batch_size",

@@ -4,6 +4,7 @@ playground (reference: services/dashboard/app.py §2.1-2.8 areas)."""
 from __future__ import annotations
 
 import json
+import os
 import time
 from collections import defaultdict
 from datetime import datetime, timezone
@@ -646,6 +647,11 @@ def setup(app: web.Application) -> None:
 
         task = loop.run_in_executor(None, pump)
         text = ""
+        # Idle streams emit SSE comment keepalives so buffering/idle-timeout
+        # proxies don't sever the connection while a request waits for a
+        # slot or a slow chunk (comment lines are invisible to clients).
+        keepalive_s = float(os.environ.get("KAKVEDA_SSE_KEEPALIVE", "15"))
+        last_write = time.monotonic()
         try:
             while True:
                 try:
@@ -659,14 +665,25 @@ def setup(app: web.Application) -> None:
                     if tr is None or tr.is_closing():
                         cancelled.set()
                         break
+                    if keepalive_s > 0 and time.monotonic() - last_write >= keepalive_s:
+                        await resp.write(b": keepalive\n\n")
+                        last_write = time.monotonic()
                     continue
+                last_write = time.monotonic()
                 if kind == "delta":
                     await resp.write(
                         b"data: " + json.dumps({"delta": payload}).encode() + b"\n\n"
                     )
                 elif kind == "error":
+                    # Terminal error frame (engine died mid-stream, model
+                    # raised): a typed `event: error` so EventSource
+                    # clients get an addressable event, plus the error in
+                    # the data payload for raw line parsers — then the
+                    # stream CLOSES instead of going silent until the
+                    # client times out.
                     await resp.write(
-                        b"data: " + json.dumps({"error": payload}).encode() + b"\n\n"
+                        b"event: error\ndata: "
+                        + json.dumps({"error": payload}).encode() + b"\n\n"
                     )
                     break
                 else:

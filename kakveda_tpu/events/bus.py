@@ -18,6 +18,24 @@ stuck consumer can't wedge the fan-out, and HTTP subscriptions are durable
 (in-memory dict, event_bus/app.py:25; flagged as an ordering hazard at
 startup), whereas here URL subscriptions append to a JSONL log and are
 replayed on construction.
+
+Delivery to URL subscribers is **at-least-once** (docs/robustness.md):
+
+* each failed POST retries with exponential backoff + jitter
+  (``KAKVEDA_BUS_RETRIES`` attempts, ``KAKVEDA_BUS_RETRY_BASE`` seconds);
+* a per-URL **circuit breaker** opens after
+  ``KAKVEDA_BUS_BREAKER_THRESHOLD`` consecutive event failures — while
+  open, deliveries short-circuit straight to the dead-letter queue instead
+  of burning the fan-out on a dead endpoint; after
+  ``KAKVEDA_BUS_BREAKER_COOLDOWN`` seconds one half-open probe delivery is
+  allowed through (success closes the breaker, failure reopens it);
+* events that exhaust retries (or short-circuit) append to a **dead-letter
+  JSONL** (``dlq.jsonl`` beside the subscription log) with the error and
+  attempt count; ``kakveda-tpu dlq replay`` — or :meth:`EventBus.replay_dlq`
+  in-process — re-delivers them and rewrites the file with what still fails.
+
+Local (callable) subscribers keep single-attempt semantics: they are
+in-process reactors whose failures are code bugs, not transient transport.
 """
 
 from __future__ import annotations
@@ -25,9 +43,14 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
+import os
+import random
+import threading
+import time
 from pathlib import Path
 from typing import Any, Awaitable, Callable, Collection, Dict, List, Optional, Union
 
+from kakveda_tpu.core import faults as _faults
 from kakveda_tpu.core import metrics as _metrics
 
 log = logging.getLogger("kakveda.events")
@@ -47,12 +70,39 @@ class EventBus:
         self,
         delivery_timeout: float = 3.0,
         persist_path: Optional[str | Path] = None,
+        dlq_path: Optional[str | Path] = None,
     ):
         self._subs: Dict[str, List[Union[Handler, str]]] = {}
         self.delivery_timeout = delivery_timeout
         self._persist_path = Path(persist_path) if persist_path else None
+        # Dead-letter log: defaults beside the subscription log so a
+        # persistent bus is dead-letter-capable without extra wiring; an
+        # in-memory bus (both None) counts drops on the metrics plane only.
+        if dlq_path is not None:
+            self._dlq_path: Optional[Path] = Path(dlq_path)
+        elif self._persist_path is not None:
+            self._dlq_path = self._persist_path.parent / "dlq.jsonl"
+        else:
+            self._dlq_path = None
+        self._dlq_lock = threading.Lock()
+        # At-least-once knobs, read once at construction.
+        self._retries = max(1, int(os.environ.get("KAKVEDA_BUS_RETRIES", "3")))
+        self._retry_base = float(os.environ.get("KAKVEDA_BUS_RETRY_BASE", "0.05"))
+        self._breaker_threshold = max(
+            1, int(os.environ.get("KAKVEDA_BUS_BREAKER_THRESHOLD", "5"))
+        )
+        self._breaker_cooldown = float(
+            os.environ.get("KAKVEDA_BUS_BREAKER_COOLDOWN", "30")
+        )
+        # Per-URL breaker state: {"state": closed|open|half_open,
+        # "fails": consecutive failed events, "opened_at": monotonic ts}.
+        # A threading lock, not asyncio: publish_sync spins private loops,
+        # so two event loops can touch this dict from different threads.
+        self._breakers: Dict[str, dict] = {}
+        self._breaker_lock = threading.Lock()
         if self._persist_path is not None:
             self._replay_subscriptions()
+        self._fault_deliver = _faults.site("bus.deliver")
         reg = _metrics.get_registry()
         self._m_published = reg.counter(
             "kakveda_bus_events_published_total",
@@ -63,6 +113,28 @@ class EventBus:
         )
         self._m_ok = self._m_deliveries.labels(result="ok")
         self._m_err = self._m_deliveries.labels(result="error")
+        attempts = reg.counter(
+            "kakveda_bus_delivery_attempts_total",
+            "URL delivery attempts by result (ok|retry|failed|short_circuit)",
+            ("result",),
+        )
+        self._m_att_ok = attempts.labels(result="ok")
+        self._m_att_retry = attempts.labels(result="retry")
+        self._m_att_failed = attempts.labels(result="failed")
+        self._m_att_short = attempts.labels(result="short_circuit")
+        self._m_breaker_trans = reg.counter(
+            "kakveda_bus_breaker_transitions_total",
+            "Bus circuit-breaker state transitions", ("to",),
+        )
+        self._m_breaker_open = reg.gauge(
+            "kakveda_bus_breaker_open",
+            "URL subscribers whose circuit breaker is currently open",
+        )
+        self._m_dlq = reg.counter(
+            "kakveda_bus_dlq_total",
+            "Events dead-lettered after retries were exhausted or the "
+            "breaker short-circuited",
+        )
         # Fan-out backpressure gauge: how many deliveries are in flight
         # right now (bounded by MAX_CONCURRENT_DELIVERIES per publish).
         self._m_inflight = reg.gauge(
@@ -75,18 +147,27 @@ class EventBus:
         path = self._persist_path
         if path is None or not path.exists():
             return
-        for line in path.read_text().splitlines():
+        for lineno, line in enumerate(path.read_text().splitlines(), start=1):
             if not line.strip():
                 continue
+            # Skip-with-warning per line: one malformed record (torn tail
+            # from a crashed process, a non-dict JSON value, hand edits)
+            # must not take down service startup — the remaining
+            # subscriptions still replay.
             try:
                 rec = json.loads(line)
-            except ValueError:
-                continue  # torn tail write from a crashed process
-            topic, url = rec.get("topic"), rec.get("url")
+                topic, url = rec.get("topic"), rec.get("url")
+                action = rec.get("action")
+            except Exception as e:  # noqa: BLE001 — any bad record, not just bad JSON
+                log.warning(
+                    "skipping malformed subscription record %s:%d (%s: %s)",
+                    path, lineno, type(e).__name__, e,
+                )
+                continue
             if not topic or not url:
                 continue
             subs = self._subs.setdefault(topic, [])
-            if rec.get("action") == "unsubscribe":
+            if action == "unsubscribe":
                 if url in subs:
                     subs.remove(url)
             elif url not in subs:
@@ -123,8 +204,137 @@ class EventBus:
     def has_subscribers(self, topic: str, exclude: Collection[Handler] = ()) -> bool:
         return any(s not in exclude for s in self._subs.get(topic, []))
 
+    # --- circuit breaker (URL subscribers) -----------------------------
+
+    def _breaker_state(self, url: str) -> dict:
+        return self._breakers.setdefault(
+            url, {"state": "closed", "fails": 0, "opened_at": 0.0, "probing": False}
+        )
+
+    def _set_breaker(self, br: dict, to: str) -> None:
+        """ONE definition of a breaker transition: state, transition
+        counter and open-breaker gauge move together. Caller holds
+        ``_breaker_lock``."""
+        if br["state"] == to:
+            return
+        br["state"] = to
+        n_open = sum(1 for b in self._breakers.values() if b["state"] == "open")
+        self._m_breaker_trans.labels(to=to).inc()
+        self._m_breaker_open.set(n_open)
+        log.warning("bus breaker -> %s (%d open)", to, n_open)
+
+    def _breaker_allow(self, url: str) -> bool:
+        """May a delivery to ``url`` proceed? Open breakers short-circuit
+        until the cooldown elapses, then admit exactly ONE half-open probe
+        at a time (success closes, failure reopens)."""
+        with self._breaker_lock:
+            br = self._breaker_state(url)
+            if br["state"] == "closed":
+                return True
+            if br["state"] == "open":
+                if time.monotonic() - br["opened_at"] < self._breaker_cooldown:
+                    return False
+                self._set_breaker(br, "half_open")
+                br["probing"] = True
+                return True
+            if not br["probing"]:  # half_open, probe slot free
+                br["probing"] = True
+                return True
+            return False
+
+    def _breaker_result(self, url: str, ok: bool) -> None:
+        with self._breaker_lock:
+            br = self._breaker_state(url)
+            br["probing"] = False
+            if ok:
+                br["fails"] = 0
+                self._set_breaker(br, "closed")
+                return
+            if br["state"] == "half_open":
+                br["opened_at"] = time.monotonic()
+                self._set_breaker(br, "open")
+                return
+            br["fails"] += 1
+            if br["fails"] >= self._breaker_threshold and br["state"] == "closed":
+                br["opened_at"] = time.monotonic()
+                self._set_breaker(br, "open")
+
+    def breaker_states(self) -> Dict[str, str]:
+        """url -> breaker state, for /topics-style introspection and tests."""
+        with self._breaker_lock:
+            return {u: b["state"] for u, b in self._breakers.items()}
+
+    # --- dead-letter queue ---------------------------------------------
+
+    def _dead_letter(
+        self, topic: str, url: str, event: dict, error: str, attempts: int
+    ) -> None:
+        self._m_dlq.inc()
+        if self._dlq_path is None:
+            return
+        rec = {
+            "ts": time.time(), "topic": topic, "url": url, "event": event,
+            "error": error, "attempts": attempts,
+        }
+        try:
+            with self._dlq_lock:
+                self._dlq_path.parent.mkdir(parents=True, exist_ok=True)
+                with self._dlq_path.open("a", encoding="utf-8") as f:
+                    f.write(json.dumps(rec, ensure_ascii=False) + "\n")
+        except OSError as e:
+            log.error("dead-letter append failed (event dropped): %s", e)
+
+    def replay_dlq(self, timeout: Optional[float] = None) -> dict:
+        """Re-deliver every dead-lettered event (sync POSTs) and rewrite the
+        DLQ with what still fails. URLs that accepted a replay get their
+        breaker closed — a successful replay is the operator's evidence the
+        endpoint recovered, no need to wait out the cooldown."""
+        if self._dlq_path is None:
+            return {"replayed": 0, "failed": 0, "path": None}
+        with self._dlq_lock:
+            out = replay_dlq_file(
+                self._dlq_path, timeout=timeout or self.delivery_timeout
+            )
+        with self._breaker_lock:
+            for url in out.get("replayed_urls", ()):
+                br = self._breakers.get(url)
+                if br is not None:
+                    br["fails"] = 0
+                    br["probing"] = False
+                    self._set_breaker(br, "closed")
+        return out
+
+    # --- delivery -------------------------------------------------------
+
+    async def _deliver_url(self, topic: str, url: str, event: dict, client=None) -> bool:
+        """At-least-once URL delivery: breaker gate, bounded retries with
+        exponential backoff + jitter, dead-letter on exhaustion."""
+        if not self._breaker_allow(url):
+            self._m_att_short.inc()
+            self._dead_letter(topic, url, event, "circuit breaker open", 0)
+            return False
+        for attempt in range(self._retries):
+            ok = await self._deliver(url, event, client=client)
+            if ok:
+                self._m_att_ok.inc()
+                self._breaker_result(url, True)
+                return True
+            if attempt + 1 < self._retries:
+                self._m_att_retry.inc()
+                await asyncio.sleep(
+                    self._retry_base * (2 ** attempt) * (0.5 + random.random())
+                )
+        self._m_att_failed.inc()
+        self._breaker_result(url, False)
+        self._dead_letter(
+            topic, url, event,
+            f"delivery failed after {self._retries} attempt(s)", self._retries,
+        )
+        return False
+
     async def _deliver(self, sub: Union[Handler, str], event: dict, client=None) -> bool:
         try:
+            self._fault_deliver.fire()
             if isinstance(sub, str):
                 if client is not None:
                     await client.post(sub, json=event)
@@ -156,9 +366,12 @@ class EventBus:
     # events).
     MAX_CONCURRENT_DELIVERIES = 32
 
-    async def _fan_out(self, pairs: List[tuple]) -> int:
+    async def _fan_out(self, topic: str, pairs: List[tuple]) -> int:
         """Deliver (subscriber, event) pairs with bounded concurrency and one
-        shared pooled HTTP client for all URL deliveries."""
+        shared pooled HTTP client for all URL deliveries. URL subscribers go
+        through the at-least-once policy (retry → breaker → DLQ, which needs
+        the topic for the dead-letter record); local handlers stay
+        single-attempt."""
         sem = asyncio.Semaphore(self.MAX_CONCURRENT_DELIVERIES)
         needs_http = any(isinstance(s, str) for s, _ in pairs)
         client = None
@@ -174,6 +387,8 @@ class EventBus:
             async with sem:
                 self._m_inflight.inc()
                 try:
+                    if isinstance(sub, str):
+                        return await self._deliver_url(topic, sub, event, client=client)
                     return await self._deliver(sub, event, client=client)
                 finally:
                     self._m_inflight.dec()
@@ -200,7 +415,7 @@ class EventBus:
         subs = [s for s in self._subs.get(topic, []) if s not in exclude]
         if not subs:
             return 0
-        return await self._fan_out([(s, event) for s in subs])
+        return await self._fan_out(topic, [(s, event) for s in subs])
 
     async def publish_many(
         self, topic: str, events: List[dict], exclude: Collection[Handler] = ()
@@ -211,8 +426,56 @@ class EventBus:
         subs = [s for s in self._subs.get(topic, []) if s not in exclude]
         if not subs or not events:
             return 0
-        return await self._fan_out([(s, e) for e in events for s in subs])
+        return await self._fan_out(topic, [(s, e) for e in events for s in subs])
 
     def publish_sync(self, topic: str, event: dict) -> int:
         """Publish from synchronous code (spins a private loop)."""
         return asyncio.run(self.publish(topic, event))
+
+
+def replay_dlq_file(path: str | Path, timeout: float = 5.0) -> dict:
+    """Re-deliver every event in a dead-letter JSONL (one sync POST each,
+    the same HTTP contract the fan-out speaks) and atomically rewrite the
+    file with what still fails — the ``kakveda-tpu dlq replay`` verb and
+    :meth:`EventBus.replay_dlq` both land here. Malformed lines are kept
+    in place (skip-with-warning), never silently dropped."""
+    import httpx
+
+    path = Path(path)
+    if not path.exists():
+        return {"replayed": 0, "failed": 0, "path": str(path), "replayed_urls": []}
+    remaining: List[str] = []
+    replayed = 0
+    replayed_urls: set = set()
+    for lineno, line in enumerate(path.read_text(encoding="utf-8").splitlines(), 1):
+        if not line.strip():
+            continue
+        try:
+            rec = json.loads(line)
+            url, event = rec["url"], rec["event"]
+        except Exception as e:  # noqa: BLE001 — keep the record for a human
+            log.warning(
+                "dlq replay: keeping malformed record %s:%d (%s)", path, lineno, e
+            )
+            remaining.append(line)
+            continue
+        try:
+            r = httpx.post(url, json=event, timeout=timeout)
+            r.raise_for_status()
+            replayed += 1
+            replayed_urls.add(url)
+        except Exception as e:  # noqa: BLE001 — still undeliverable, keep it
+            rec["error"] = f"{type(e).__name__}: {e}"
+            rec["attempts"] = int(rec.get("attempts", 0)) + 1
+            remaining.append(json.dumps(rec, ensure_ascii=False))
+    tmp = path.with_name(path.name + ".tmp")
+    tmp.write_text(
+        "".join(ln + "\n" for ln in remaining), encoding="utf-8"
+    )
+    os.replace(tmp, path)
+    return {
+        "replayed": replayed,
+        "failed": len(remaining),
+        "path": str(path),
+        "replayed_urls": sorted(replayed_urls),
+    }

@@ -30,6 +30,7 @@ Deliberate deviations from the reference, both documented here:
 from __future__ import annotations
 
 import json
+import logging
 import os
 import threading
 from pathlib import Path
@@ -39,7 +40,10 @@ import numpy as np
 from jax.sharding import Mesh
 
 from kakveda_tpu import native
+from kakveda_tpu.core import faults as _faults
 from kakveda_tpu.core import profiling
+
+log = logging.getLogger("kakveda.gfkb")
 from kakveda_tpu.core.schemas import (
     CanonicalFailureRecord,
     FailureMatch,
@@ -135,6 +139,16 @@ class GFKB:
         # open+write+close per record (the reference's pattern,
         # services/gfkb/app.py:49-51).
         self._logs: Dict[Path, "native.AppendLog"] = {}
+        # Crash-safe replay: a torn FINAL line (a crash mid-append) is
+        # tolerated at startup — replay warns, remembers the offset here,
+        # and the next append truncates the file back to it before
+        # writing, so the torn bytes never corrupt a later record.
+        # Mid-file corruption still raises (that is data loss, not a torn
+        # tail, and must not be silently truncated away).
+        self._truncate_pending: Dict[Path, int] = {}
+        # Chaos-harness sites (core/faults.py), resolved once.
+        self._fault_append = _faults.site("gfkb.append")
+        self._fault_snapshot = _faults.site("gfkb.snapshot")
         # Published immutable view for lock-free matching: a tuple swap is
         # atomic under the GIL, so match_batch never takes the data lock —
         # see match_batch for the consistency argument.
@@ -161,10 +175,26 @@ class GFKB:
         encoder entirely."""
         if not self.persist:
             return
-        log = self._logs.get(path)
-        if log is None:
-            log = self._logs[path] = native.AppendLog(path)
-        log.append((line + "\n").encode("utf-8"))
+        self._fault_append.fire()
+        pend = self._truncate_pending.pop(path, None)
+        if pend is not None:
+            # First append since a torn tail was tolerated at replay:
+            # truncate the file back to the last complete record before
+            # anything new lands after the torn bytes.
+            lg = self._logs.pop(path, None)
+            if lg is not None:
+                lg.close()
+            try:
+                os.truncate(path, pend)
+                log.warning("truncated torn tail of %s to %d bytes", path, pend)
+            except OSError as e:
+                log.error("could not truncate torn tail of %s: %s", path, e)
+                self._truncate_pending[path] = pend
+                raise
+        alog = self._logs.get(path)
+        if alog is None:
+            alog = self._logs[path] = native.AppendLog(path)
+        alog.append((line + "\n").encode("utf-8"))
 
     def _flush_logs(self) -> None:
         for log in self._logs.values():
@@ -176,31 +206,65 @@ class GFKB:
             log.close()
         self._logs.clear()
 
+    def _iter_log_lines(self, path: Path, offset: int, parse):
+        """Yield ``parse(line)`` for each JSONL line of ``path`` from byte
+        ``offset``, tolerating exactly one torn FINAL line: a record that
+        fails to decode/parse with nothing but whitespace after it is a
+        crash mid-append — warn, schedule truncate-on-next-append
+        (``_truncate_pending``) and stop. A bad record with more data
+        after it is mid-file corruption and raises."""
+        with path.open("rb") as f:
+            if offset:
+                f.seek(offset)
+            pos = f.tell()
+            for raw in f:
+                line_start = pos
+                pos += len(raw)
+                try:
+                    text = raw.decode("utf-8").strip()
+                    if not text:
+                        continue
+                    parsed = parse(text)
+                except Exception as e:  # noqa: BLE001 — decode OR parse failure
+                    rest = f.read()
+                    if rest.strip():
+                        raise ValueError(
+                            f"corrupt record mid-file in {path} at byte "
+                            f"{line_start} ({type(e).__name__}: {e}); refusing "
+                            "to replay past it"
+                        ) from e
+                    log.warning(
+                        "tolerating torn final line of %s at byte %d (%s); "
+                        "will truncate on next append",
+                        path, line_start, type(e).__name__,
+                    )
+                    self._truncate_pending[path] = line_start
+                    return
+                yield parsed
+
     def _replay(self) -> None:
         """Rebuild host metadata + device index from the append logs,
         fast-forwarding through a snapshot when one is valid (startup at
-        1M rows is dominated by re-embedding + re-parsing otherwise)."""
+        1M rows is dominated by re-embedding + re-parsing otherwise).
+        Both logs tolerate one torn final line (see _iter_log_lines)."""
         if self.failures_path.exists():
             tail_offset = self._restore_snapshot()
             latest: Dict[Tuple[str, str], CanonicalFailureRecord] = {}
             order: List[Tuple[str, str]] = []
-            with self.failures_path.open("r", encoding="utf-8") as f:
-                if tail_offset:
-                    f.seek(tail_offset)
-                for line in f:
-                    if not line.strip():
-                        continue
-                    rec = CanonicalFailureRecord.model_validate(json.loads(line))
-                    key = (rec.failure_type, rec.signature_text)
-                    if key in self._slot_by_key:  # snapshot row updated in tail
-                        self._records[self._slot_by_key[key]] = rec
-                        self._apps_by_type.setdefault(rec.failure_type, set()).update(
-                            rec.affected_apps
-                        )
-                        continue
-                    if key not in latest:
-                        order.append(key)
-                    latest[key] = rec
+            for rec in self._iter_log_lines(
+                self.failures_path, tail_offset,
+                lambda t: CanonicalFailureRecord.model_validate(json.loads(t)),
+            ):
+                key = (rec.failure_type, rec.signature_text)
+                if key in self._slot_by_key:  # snapshot row updated in tail
+                    self._records[self._slot_by_key[key]] = rec
+                    self._apps_by_type.setdefault(rec.failure_type, set()).update(
+                        rec.affected_apps
+                    )
+                    continue
+                if key not in latest:
+                    order.append(key)
+                latest[key] = rec
             if order:
                 base = len(self._records)
                 self._records.extend(latest[k] for k in order)
@@ -224,19 +288,37 @@ class GFKB:
                 )
 
         if self.patterns_path.exists():
-            for line in self.patterns_path.read_text(encoding="utf-8").splitlines():
-                if not line.strip():
-                    continue
-                p = PatternEntity.model_validate(json.loads(line))
+            for p in self._iter_log_lines(
+                self.patterns_path, 0,
+                lambda t: PatternEntity.model_validate(json.loads(t)),
+            ):
                 self._merge_pattern_line(p)
 
     # --- snapshot / restore --------------------------------------------
 
     # v2: embeddings persist as sparse (idx, val) pairs (~16× smaller,
-    # no re-sparsify on restore). v1 dense snapshots fall back to full
-    # replay — acceptable one-time cost, no migration path needed.
-    _SNAPSHOT_VERSION = 2
+    # no re-sparsify on restore). v3 adds a content checksum over the
+    # snapshot payload to the manifest, so a corrupted snapshot (bad disk,
+    # partial copy) degrades to full replay instead of restoring garbage
+    # vectors. Older snapshots fall back to full replay — acceptable
+    # one-time cost, no migration path needed.
+    _SNAPSHOT_VERSION = 3
     _TAIL_HASH_BYTES = 4096
+    _SNAPSHOT_PAYLOAD = ("sparse_idx.npy", "sparse_val.npy", "records.jsonl")
+
+    @classmethod
+    def _snapshot_checksum(cls, sd: Path) -> str:
+        """sha256 over the snapshot payload files, in manifest order — THE
+        content checksum both snapshot() and _restore_snapshot() compute."""
+        import hashlib
+
+        h = hashlib.sha256()
+        for name in cls._SNAPSHOT_PAYLOAD:
+            with (sd / name).open("rb") as f:
+                for chunk in iter(lambda: f.read(1 << 20), b""):
+                    h.update(chunk)
+            h.update(b"\x00")
+        return h.hexdigest()
 
     def _snapshot_dir(self) -> Path:
         return self.data_dir / "snapshot"
@@ -314,6 +396,10 @@ class GFKB:
                 np.save(tmp / "sparse_val.npy", sp_val)
                 with (tmp / "records.jsonl").open("w", encoding="utf-8") as f:
                     f.writelines(r.model_dump_json() + "\n" for r in records)
+                # Chaos site: a snapshot-write failure here exercises the
+                # except path below — tmp is removed and the previous
+                # snapshot (if any) stays installed.
+                self._fault_snapshot.fire()
                 (tmp / "manifest.json").write_text(
                     json.dumps(
                         {
@@ -322,6 +408,9 @@ class GFKB:
                             "dim": knn.dim,
                             "log_offset": offset,
                             "log_hash": log_hash,
+                            # Content checksum: restore verifies it and
+                            # degrades to full replay on any mismatch.
+                            "checksum": self._snapshot_checksum(tmp),
                         }
                     )
                 )
@@ -363,6 +452,16 @@ class GFKB:
                 return 0  # log truncated/rewritten since the snapshot
             if offset and self._log_prefix_hash(offset) != manifest.get("log_hash"):
                 return 0  # log rewritten in place (e.g. purge) — full replay
+            if self._snapshot_checksum(sd) != manifest.get("checksum"):
+                # Payload doesn't match what snapshot() wrote (bit rot,
+                # partial copy, hand edits): restoring would install
+                # garbage vectors the warn path then trusts — degrade to
+                # full replay from the append log instead.
+                log.warning(
+                    "snapshot at %s fails its content checksum; ignoring it "
+                    "and replaying the full log", sd,
+                )
+                return 0
             n = int(manifest["n"])
             records = []
             with (sd / "records.jsonl").open("r", encoding="utf-8") as f:
@@ -458,6 +557,9 @@ class GFKB:
             self._pattern_state = {}
             self._ids_by_type = {}
             self._apps_by_type = {}
+            # The rewrite replaced the files; any torn-tail truncation
+            # scheduled against the OLD files must not fire on the new ones.
+            self._truncate_pending = {}
             if self.persist:
                 self._replay()
             self._publish()

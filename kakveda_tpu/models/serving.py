@@ -68,6 +68,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from kakveda_tpu.core import faults as _faults
 from kakveda_tpu.core import metrics as _metrics
 from kakveda_tpu.models.llama import (
     LlamaConfig,
@@ -81,6 +82,31 @@ from kakveda_tpu.models.speculative import NgramIndex, copy_run
 log = logging.getLogger("kakveda.serving")
 
 _GATE_STATES = ("disabled", "warmup", "on", "off")
+
+
+class EngineRetryableError(RuntimeError):
+    """An in-flight request was lost to a serving-engine loop death. The
+    request's slot state is gone but the supervisor is rebuilding the
+    engine — resubmitting is safe (no tokens were delivered to the
+    Future). RuntimeError subclass so existing solo-fallback callers
+    (LlamaRuntime.generate*) handle it without changes."""
+
+
+class EngineDeadError(RuntimeError):
+    """The serving engine is permanently dead: the supervisor's restart
+    budget (KAKVEDA_SERVE_RESTARTS) is exhausted, or the rebuild itself
+    failed. submit()/register_prefix() raise this IMMEDIATELY — fail fast
+    instead of enqueueing into a queue nobody drains."""
+
+
+class DeadlineExceededError(RuntimeError):
+    """A request's ``deadline_s`` expired before it completed. Carries the
+    tokens decoded so far in ``.tokens`` (possibly empty — the request may
+    have expired while still queued)."""
+
+    def __init__(self, message: str, tokens: Optional[List[int]] = None):
+        super().__init__(message)
+        self.tokens: List[int] = list(tokens or [])
 
 
 @partial(jax.jit, static_argnames=("cfg",), donate_argnums=(2,))
@@ -532,6 +558,14 @@ class ContinuousBatcher:
         # one trace), so the first sample per shape is dropped.
         self._spec_widths_warm: set = set()
         self._plain_warm = False
+        # Chaos-harness sites, resolved once (core/faults.py): a bare
+        # attribute check per chunk when unarmed. Dispatch fires before
+        # the device program is launched, fetch before a handle's results
+        # are consumed — both escape to the engine loop, whose supervisor
+        # rebuilds this batcher wholesale (mid-flight state is discarded,
+        # so a fault can never leave it half-mutated in service).
+        self._fault_dispatch = _faults.site("engine.dispatch")
+        self._fault_fetch = _faults.site("engine.fetch")
         self.eos_id = eos_id
         self.cache = init_cache(cfg, batch=batch_slots, max_len=max_len)
         self.last = jnp.full((batch_slots, cfg.vocab_size), -1e30, jnp.float32)
@@ -773,6 +807,7 @@ class ContinuousBatcher:
             raise RuntimeError(
                 "step_async() with a speculative chunk in flight; process_spec_chunk first"
             )
+        self._fault_dispatch.fire()
         # A plain chunk moves the frontier through the host mirrors; any
         # previously threaded device slot_pos is stale from here on.
         self._spec_pos_dev = None
@@ -804,6 +839,7 @@ class ContinuousBatcher:
         returns req_ids completed by that chunk."""
         if handle is None:
             return []
+        self._fault_fetch.fire()
         toks, snapshot, t_dispatch = handle
         toks_h = np.asarray(toks)
         # Gate denominator: dispatch→process is the chunk's EFFECTIVE
@@ -996,6 +1032,7 @@ class ContinuousBatcher:
         otherwise)."""
         if not self.slots:
             return None
+        self._fault_dispatch.fire()
         t_dispatch = time.perf_counter()  # drafting is part of the chunk's cost
         k = self._pool_k()
         pipelined = self._spec_pending > 0
@@ -1045,6 +1082,7 @@ class ContinuousBatcher:
         auto-gate; returns req_ids completed by that chunk."""
         if handle is None:
             return []
+        self._fault_fetch.fire()
         toks, counts, snapshot, k, kmap, pmap, t_dispatch = handle
         toks_h = np.asarray(toks)
         counts_h = np.asarray(counts).astype(np.int32)
@@ -1295,11 +1333,27 @@ class ServingEngine:
         # The flight recorder: request timelines + gate/k transitions,
         # dumped via GET /flightrecorder and automatically on loop death.
         self.recorder = _metrics.FlightRecorder(f"serving/{self.name}")
-        self.cb = ContinuousBatcher(
-            params, cfg, batch_slots=batch_slots, max_len=max_len,
-            chunk_steps=chunk_steps, eos_id=eos_id, rng=rng, spec_k=spec_k,
-            name=self.name, recorder=self.recorder,
+        # Everything the supervisor needs to rebuild the batcher after a
+        # loop death — the rebuild constructs a FRESH ContinuousBatcher
+        # (cache slabs re-zeroed by init_cache) from these.
+        self._params, self._cfg = params, cfg
+        self._cb_kw = dict(
+            batch_slots=batch_slots, max_len=max_len, chunk_steps=chunk_steps,
+            eos_id=eos_id, rng=rng, spec_k=spec_k,
         )
+        self.cb = ContinuousBatcher(
+            params, cfg, name=self.name, recorder=self.recorder, **self._cb_kw
+        )
+        # Supervisor state: restart budget (read once — the supervisor must
+        # not change behavior mid-life because the env moved), restarts
+        # consumed, and the terminal-death latch (submit fails fast on it).
+        self._restart_budget = int(os.environ.get("KAKVEDA_SERVE_RESTARTS", "2"))
+        self._restarts = 0
+        self._dead = threading.Event()
+        # Prefixes successfully registered on the live batcher, in order —
+        # the supervisor re-registers them on the rebuilt batcher so a
+        # restart doesn't silently lose the prefix-cache hit rate.
+        self._prefix_ids: List[Tuple[int, ...]] = []
         reg = _metrics.get_registry()
         el = {"engine": self.name}
         self._m_requests = reg.counter(
@@ -1334,8 +1388,15 @@ class ServingEngine:
                 "Serving-engine loop deaths (flight recorder dumped on "
                 "each)", ("engine",),
             ).labels(**el),
+            "restarts": reg.counter(
+                "kakveda_serving_engine_restarts_total",
+                "Supervisor restarts of a serving-engine loop after a "
+                "crash (bounded by KAKVEDA_SERVE_RESTARTS)", ("engine",),
+            ).labels(**el),
         }
-        self._q: "queue.Queue[Tuple[List[int], int, float, object, float, Future]]" = queue.Queue()
+        # Generation items: (ids, max_new, temp, on_tokens, t_submit,
+        # deadline_abs_or_None, fut); control items: ("cancel"|"prefix", …, fut).
+        self._q: "queue.Queue[tuple]" = queue.Queue()
         self._closed = threading.Event()
         self._submit_lock = threading.Lock()  # closes the submit/close race
         self._pend: Dict[int, Future] = {}  # loop-owned; close() fails leftovers
@@ -1354,6 +1415,8 @@ class ServingEngine:
             snap = dict(self._stats)
             snap["spec"] = copy.deepcopy(self.cb.spec_stats)
             snap["prefix"] = dict(self.cb.prefix_stats)
+        snap["restarts"] = self._restarts
+        snap["dead"] = self._dead.is_set()
         return snap
 
     def _bump(self, key: str, v: int = 1) -> None:
@@ -1410,22 +1473,36 @@ class ServingEngine:
         max_new_tokens: int = 64,
         temperature: float = 0.0,
         on_tokens=None,
+        deadline_s: Optional[float] = None,
     ) -> Future:
         """Enqueue a request; the Future resolves to the generated id list.
 
         ``on_tokens(new_ids, done)`` (optional) streams each decode chunk's
         accepted tokens as they land — called on the engine loop thread, so
-        it must be non-blocking (push to a queue and return)."""
+        it must be non-blocking (push to a queue and return).
+
+        ``deadline_s`` (optional) bounds submit-to-completion wall time:
+        past it, the request retires at the next chunk boundary through
+        the cancel_request done-flag path (safe under pipelining) and its
+        Future fails with :class:`DeadlineExceededError` carrying the
+        partial tokens."""
         with self._submit_lock:
             # Atomic with close()'s drain: without the lock a put landing
             # between close()'s _closed.set() and its queue drain would
             # enqueue into a dead loop and hang its caller forever.
+            if self._dead.is_set():
+                raise EngineDeadError(
+                    f"ServingEngine {self.name!r} is dead (restart budget "
+                    f"exhausted after {self._restarts} restart(s))"
+                )
             if self._closed.is_set():
                 raise RuntimeError("ServingEngine is closed")
+            t0 = time.perf_counter()
+            deadline = t0 + deadline_s if deadline_s is not None else None
             fut: Future = Future()
             self._q.put(
                 (list(prompt_ids), max_new_tokens, temperature, on_tokens,
-                 time.perf_counter(), fut)
+                 t0, deadline, fut)
             )
             self._bump("submitted")
             return fut
@@ -1457,6 +1534,11 @@ class ServingEngine:
         race a decode chunk's donated cache). Blocking; returns whether the
         prefix was accepted (see ContinuousBatcher.register_prefix)."""
         with self._submit_lock:
+            if self._dead.is_set():
+                raise EngineDeadError(
+                    f"ServingEngine {self.name!r} is dead (restart budget "
+                    f"exhausted after {self._restarts} restart(s))"
+                )
             if self._closed.is_set():
                 raise RuntimeError("ServingEngine is closed")
             fut: Future = Future()
@@ -1498,6 +1580,99 @@ class ServingEngine:
             self._pend.clear()
             self._track.clear()
 
+    def _fail_inflight(self, err: BaseException) -> None:
+        """Fail ONLY requests already admitted into the (now dead) batcher —
+        their slot state is unrecoverable. Queued/waiting items are left in
+        place: the supervisor's rebuilt loop re-admits them."""
+        with self._submit_lock:
+            for fut in list(self._pend.values()):
+                self._fail(fut, err)
+            self._pend.clear()
+            self._track.clear()
+
+    def _rebuild(self) -> None:
+        """Rebuild the batcher after a loop death: a FRESH ContinuousBatcher
+        (cache slabs re-zeroed by init_cache; gate/k/pipeline/adaptive state
+        back to construction defaults — the constructor publishes the full
+        gate-gauge vector, the same single-definition family
+        ``_set_gate_state`` moves), then re-register every previously
+        accepted prefix so a restart doesn't silently lose the prefix-cache
+        hit rate. Supervisor-thread only."""
+        self.cb = ContinuousBatcher(
+            self._params, self._cfg, name=self.name, recorder=self.recorder,
+            **self._cb_kw,
+        )
+        for ids in list(self._prefix_ids):
+            try:
+                self.cb.register_prefix(list(ids))
+            except Exception as e:  # noqa: BLE001 — prefix reuse is an optimization
+                log.warning(
+                    "prefix re-registration failed after engine restart: %s", e
+                )
+
+    def _finish_rids(self, rids: List[int]) -> None:
+        """Resolve completed requests' Futures (telemetry rides along) —
+        THE completion path, shared by the serve loop and deadline sweep."""
+        for rid in rids:
+            self._bump("completed")
+            fut = self._pend.pop(rid, None)
+            toks = self.cb.results.pop(rid, [])
+            tl = self._finish_telemetry(rid, len(toks))
+            if fut is not None:
+                if tl is not None:
+                    fut.timeline = tl  # read back by LlamaRuntime.generate
+                if not fut.done():
+                    try:
+                        fut.set_result(toks)
+                    except Exception:  # noqa: BLE001 — close() won the race
+                        pass
+
+    def _expire_item(self, fut: Future, tokens: List[int], where: str) -> None:
+        """Fail one request's Future with the typed deadline error (outcome
+        counter + flight-recorder event ride along). Loop-thread only."""
+        self._m_requests.labels(engine=self.name, outcome="deadline").inc()
+        if self.recorder is not None:
+            self.recorder.record("deadline", tokens=len(tokens), where=where)
+        self._fail(
+            fut,
+            DeadlineExceededError(
+                f"deadline exceeded {where} ({len(tokens)} tokens decoded)",
+                tokens,
+            ),
+        )
+
+    def _expire_deadlines(self) -> None:
+        """Retire every request whose deadline passed. Admitted requests go
+        through ``ContinuousBatcher.cancel_request`` — the done-flag-first
+        retirement path, so a stale pipelined (plain OR verify) snapshot
+        skips the freed slot as overshoot; requests still waiting for a
+        slot fail without occupying one. Loop-thread only."""
+        now = time.perf_counter()
+        for rid, tr in list(self._track.items()):
+            dl = tr.get("deadline")
+            if dl is None or now < dl:
+                continue
+            toks = self.cb.cancel_request(rid)
+            if toks is None:
+                if rid in self.cb.results:
+                    # Finished between chunks before the sweep saw it:
+                    # deliver the completed result, not a deadline error.
+                    self._finish_rids([rid])
+                continue
+            fut = self._pend.pop(rid, None)
+            self._track.pop(rid, None)
+            if fut is not None:
+                self._expire_item(fut, toks, "mid-decode")
+        with self._submit_lock:
+            still = []
+            for item in self._waiting:
+                dl = item[5]
+                if dl is not None and now >= dl:
+                    self._expire_item(item[-1], [], "while queued")
+                else:
+                    still.append(item)
+            self._waiting[:] = still
+
     def close(self) -> None:
         with self._submit_lock:
             self._closed.set()
@@ -1533,11 +1708,21 @@ class ServingEngine:
             if not fut.set_running_or_notify_cancel():
                 return
             try:
-                fut.set_result(self.cb.register_prefix(ids))
+                ok = self.cb.register_prefix(ids)
+                if ok:
+                    # Remember accepted prefixes so a supervisor rebuild
+                    # re-registers them on the fresh batcher.
+                    key = tuple(int(t) for t in ids)
+                    if key not in self._prefix_ids:
+                        self._prefix_ids.append(key)
+                fut.set_result(ok)
             except Exception as e:  # noqa: BLE001 — registration errors belong to the caller
                 self._fail(fut, e)
             return
-        ids, max_new, temp, on_tokens, t_submit, fut = item
+        ids, max_new, temp, on_tokens, t_submit, deadline, fut = item
+        if deadline is not None and time.perf_counter() >= deadline:
+            self._expire_item(fut, [], "expired before admission")
+            return
         if not fut.set_running_or_notify_cancel():
             return
         t_admit = time.perf_counter()
@@ -1546,7 +1731,10 @@ class ServingEngine:
         # wrapper sees each chunk's accepted tokens on the loop thread
         # (TTFT + token counts with no extra bookkeeping in the batcher),
         # then forwards to the caller's callback if any.
-        track = {"submit": t_submit, "admit": t_admit, "first": None, "tokens": 0}
+        track = {
+            "submit": t_submit, "admit": t_admit, "first": None, "tokens": 0,
+            "deadline": deadline,
+        }
         mx_ttft = self._mx["ttft"]
 
         def _on_tokens(new, done, _orig=on_tokens, _tr=track):
@@ -1571,6 +1759,91 @@ class ServingEngine:
         self._pend[rid] = fut
 
     def _loop(self) -> None:
+        """Supervise the serve loop: on a crash, fail the in-flight futures
+        with a typed RETRYABLE error, rebuild the batcher (cache slabs
+        re-zeroed, prefixes re-registered, gate/k state reset), and restart
+        under a bounded exponential-backoff budget (KAKVEDA_SERVE_RESTARTS).
+        Past the budget the engine is terminally dead: everything pending
+        fails with EngineDeadError and submit() fails fast from then on.
+        Queued / waiting-for-a-slot requests survive a restart — the rebuilt
+        loop re-admits them."""
+        backoff = 0.1
+        while True:
+            try:
+                self._serve()
+                break  # clean close() exit
+            except BaseException as e:  # noqa: BLE001 — a dead loop must not strand callers
+                # A device/runtime error escaping a chunk would otherwise
+                # kill this thread silently: every pending Future would
+                # hang forever. The flight recorder dumps here — the "why"
+                # of a stochastic 500 is one log line / one /flightrecorder
+                # fetch, not log archaeology.
+                self._mx["errors"].inc()
+                if self.recorder is not None:
+                    self.recorder.record(
+                        "engine_error", error=f"{type(e).__name__}: {e}"
+                    )
+                    try:
+                        log.error(
+                            "serving engine %s loop died (%s: %s); flight recorder dump: %s",
+                            self.name, type(e).__name__, e, self.recorder.dump_json(),
+                        )
+                    except Exception:  # noqa: BLE001 — telemetry must not mask the death
+                        pass
+                if self._closed.is_set():
+                    # Crash racing close(): plain shutdown semantics.
+                    self._fail_all(RuntimeError(
+                        f"ServingEngine closed (loop died during shutdown: {e})"
+                    ))
+                    return
+                if self._restarts >= self._restart_budget:
+                    self._die(e)
+                    return
+                self._restarts += 1
+                self._mx["restarts"].inc()
+                self._fail_inflight(EngineRetryableError(
+                    f"ServingEngine loop died mid-decode "
+                    f"({type(e).__name__}: {e}); restarting — safe to resubmit"
+                ))
+                try:
+                    self._rebuild()
+                except BaseException as rebuild_err:  # noqa: BLE001
+                    log.error(
+                        "serving engine %s rebuild failed: %s", self.name, rebuild_err
+                    )
+                    self._die(rebuild_err)
+                    return
+                if self.recorder is not None:
+                    self.recorder.record(
+                        "engine_restart", attempt=self._restarts,
+                        budget=self._restart_budget, backoff_s=round(backoff, 3),
+                    )
+                log.warning(
+                    "serving engine %s restarted (%d/%d) after %s: %s; "
+                    "re-admitting queued requests",
+                    self.name, self._restarts, self._restart_budget,
+                    type(e).__name__, e,
+                )
+                if self._closed.wait(backoff):
+                    break  # closed during backoff: fall through to the drain
+                backoff = min(backoff * 2.0, 5.0)
+        # Normal shutdown: anything still queued/waiting/mid-decode at this
+        # point — including items this thread moved AFTER close()'s own
+        # drain — must fail rather than hang its caller.
+        self._fail_all(RuntimeError("ServingEngine closed"))
+
+    def _die(self, cause: BaseException) -> None:
+        """Terminal death: latch ``_dead`` (submit/register_prefix fail
+        fast with EngineDeadError) and fail everything pending."""
+        with self._submit_lock:
+            self._dead.set()
+            self._closed.set()
+        self._fail_all(EngineDeadError(
+            f"ServingEngine loop died terminally after {self._restarts} "
+            f"restart(s): {type(cause).__name__}: {cause}"
+        ))
+
+    def _serve(self) -> None:
         # Chunk pipelining (KAKVEDA_SERVE_PIPELINE=0 opts out): dispatch
         # chunk i+1 BEFORE fetching chunk i's tokens, so the fixed
         # device→host RTT of each token fetch (~70-90 ms on tunneled TPUs,
@@ -1625,112 +1898,72 @@ class ServingEngine:
             finish(self.cb.process_spec_chunk(pending_spec))
             pending_spec = None
 
-        def finish(rids: List[int]) -> None:
-            for rid in rids:
-                self._bump("completed")
-                fut = self._pend.pop(rid, None)
-                toks = self.cb.results.pop(rid, [])
-                tl = self._finish_telemetry(rid, len(toks))
-                if fut is not None:
-                    if tl is not None:
-                        fut.timeline = tl  # read back by LlamaRuntime.generate
-                    if not fut.done():
-                        try:
-                            fut.set_result(toks)
-                        except Exception:  # noqa: BLE001 — close() won the race
-                            pass
+        finish = self._finish_rids
 
-        try:
-            while not self._closed.is_set():
-                # Idle: block briefly for the next arrival (bounded so
-                # close() is prompt) instead of spinning on an empty pool.
-                pump_queue(
-                    block=not self.cb.slots
-                    and pending_handle is None
-                    and pending_spec is None
-                    and not self._waiting
-                )
-                if self.cb.spec_ready():
-                    # Flavor switch plain→spec: drain the plain handle so
-                    # the verify dispatch sees authoritative positions.
-                    finish(self.cb.process_chunk(pending_handle))
-                    pending_handle = None
-                    if self.cb.slots:
-                        self._note_active()
-                        if (
-                            pipelined
-                            and pending_spec is not None
-                            and self.cb.spec_pipeline_ready()
-                        ):
-                            # Full-accept regime: dispatch verify chunk
-                            # i+1 (cursor drafts), THEN fetch chunk i —
-                            # the draft/accept host work and the fetch
-                            # RTT ride under the device's verify time.
-                            nxt = self.cb.step_spec_async()
-                            drain_spec()
-                            pending_spec = nxt
-                            self._bump("chunks")
-                        else:
-                            # Acceptance-preserving sync order: fetch and
-                            # re-anchor on real history before drafting.
-                            if pending_spec is not None:
-                                drain_spec()
-                            if self.cb.slots and self.cb.spec_ready():
-                                h = self.cb.step_spec_async()
-                                if pipelined:
-                                    pending_spec = h
-                                else:
-                                    finish(self.cb.process_spec_chunk(h))
-                                self._bump("chunks")
-                    elif pending_spec is not None:
-                        drain_spec()
-                elif self.cb.slots:
-                    # Flavor switch spec→plain (gate closed, or a sampled
-                    # request joined): drain the verify handle first.
-                    if pending_spec is not None:
-                        drain_spec()
-                    if not self.cb.slots:
-                        continue  # the drain retired the whole pool
+        while not self._closed.is_set():
+            # Idle: block briefly for the next arrival (bounded so
+            # close() is prompt) instead of spinning on an empty pool.
+            pump_queue(
+                block=not self.cb.slots
+                and pending_handle is None
+                and pending_spec is None
+                and not self._waiting
+            )
+            # Deadline sweep between chunks: expired requests retire via
+            # the cancel_request done-flag path (safe while a pipelined
+            # plain or verify handle is still in flight).
+            self._expire_deadlines()
+            if self.cb.spec_ready():
+                # Flavor switch plain→spec: drain the plain handle so
+                # the verify dispatch sees authoritative positions.
+                finish(self.cb.process_chunk(pending_handle))
+                pending_handle = None
+                if self.cb.slots:
                     self._note_active()
-                    handle = self.cb.step_async()
-                    self._bump("chunks")
-                    if not pipelined:
-                        finish(self.cb.process_chunk(handle))
+                    if (
+                        pipelined
+                        and pending_spec is not None
+                        and self.cb.spec_pipeline_ready()
+                    ):
+                        # Full-accept regime: dispatch verify chunk
+                        # i+1 (cursor drafts), THEN fetch chunk i —
+                        # the draft/accept host work and the fetch
+                        # RTT ride under the device's verify time.
+                        nxt = self.cb.step_spec_async()
+                        drain_spec()
+                        pending_spec = nxt
+                        self._bump("chunks")
                     else:
-                        finish(self.cb.process_chunk(pending_handle))
-                        pending_handle = handle
+                        # Acceptance-preserving sync order: fetch and
+                        # re-anchor on real history before drafting.
+                        if pending_spec is not None:
+                            drain_spec()
+                        if self.cb.slots and self.cb.spec_ready():
+                            h = self.cb.step_spec_async()
+                            if pipelined:
+                                pending_spec = h
+                            else:
+                                finish(self.cb.process_spec_chunk(h))
+                            self._bump("chunks")
+                elif pending_spec is not None:
+                    drain_spec()
+            elif self.cb.slots:
+                # Flavor switch spec→plain (gate closed, or a sampled
+                # request joined): drain the verify handle first.
+                if pending_spec is not None:
+                    drain_spec()
+                if not self.cb.slots:
+                    continue  # the drain retired the whole pool
+                self._note_active()
+                handle = self.cb.step_async()
+                self._bump("chunks")
+                if not pipelined:
+                    finish(self.cb.process_chunk(handle))
                 else:
                     finish(self.cb.process_chunk(pending_handle))
-                    pending_handle = None
-                    if pending_spec is not None:
-                        drain_spec()
-        except BaseException as e:  # noqa: BLE001 — a dead loop must not strand callers
-            # A device/runtime error escaping cb.step() would otherwise
-            # kill this thread silently: every pending Future would hang
-            # forever and later submits would enqueue into a dead loop.
-            # Mark closed (new submits raise) and fail everything pending.
-            # The flight recorder dumps automatically here — the "why" of
-            # a stochastic 500 is one log line / one /flightrecorder
-            # fetch, not log archaeology.
-            self._mx["errors"].inc()
-            if self.recorder is not None:
-                self.recorder.record(
-                    "engine_error", error=f"{type(e).__name__}: {e}"
-                )
-                try:
-                    log.error(
-                        "serving engine %s loop died (%s: %s); flight recorder dump: %s",
-                        self.name, type(e).__name__, e, self.recorder.dump_json(),
-                    )
-                except Exception:  # noqa: BLE001 — telemetry must not mask the death
-                    pass
-            with self._submit_lock:
-                self._closed.set()
-            self._fail_all(RuntimeError(f"ServingEngine loop died: {type(e).__name__}: {e}"))
-        finally:
-            # Normal shutdown: the loop only exits the while when closed,
-            # and anything still queued/waiting/mid-decode at that point —
-            # including items this thread moved AFTER close()'s own drain
-            # — must fail rather than hang its caller.
-            if self._closed.is_set():
-                self._fail_all(RuntimeError("ServingEngine closed"))
+                    pending_handle = handle
+            else:
+                finish(self.cb.process_chunk(pending_handle))
+                pending_handle = None
+                if pending_spec is not None:
+                    drain_spec()

@@ -31,6 +31,7 @@ from typing import Optional
 from aiohttp import web
 from pydantic import ValidationError
 
+from kakveda_tpu.core import faults as _faults
 from kakveda_tpu.core.runtime import ensure_request_id, get_runtime_config
 from kakveda_tpu.core.schemas import (
     FailureMatchRequest,
@@ -46,6 +47,11 @@ log = logging.getLogger("kakveda.service")
 
 PLATFORM_KEY: web.AppKey[Platform] = web.AppKey("platform", Platform)
 WARN_BATCHER_KEY: web.AppKey[MicroBatcher] = web.AppKey("warn_batcher", MicroBatcher)
+
+# Chaos site for the HTTP tier, resolved once at import: an armed
+# service.handler fault turns a request into a clean 500 before its
+# handler runs — proving callers survive the platform's own API failing.
+_FAULT_HANDLER = _faults.site("service.handler")
 
 
 def _json_error(status: int, message: str) -> web.Response:
@@ -94,7 +100,10 @@ async def request_context_middleware(request: web.Request, handler):
     )
     started = time.perf_counter()
     try:
+        _FAULT_HANDLER.fire()
         response = await handler(request)
+    except _faults.FaultInjected as e:
+        response = _json_error(500, str(e))
     except web.HTTPException as e:
         e.headers[cfg.request_id_header] = rid
         raise

@@ -1,17 +1,21 @@
 #!/usr/bin/env python
 """Static check: every ``KAKVEDA_*`` env knob the code reads must be
-documented.
+documented — and every documented knob must still be read (dead-knob
+drift).
 
 An undocumented knob is an outage waiting for an operator: the serving
 levers (KAKVEDA_SERVE_*), the bench sweep controls and the metrics-plane
 sizing all change production behavior, and the only discoverable surface
-is the docs. This script greps the *code* tree for knob references and the
+is the docs. The converse rots just as fast: a knob the docs still teach
+but the code no longer reads sends an operator tuning a no-op mid-
+incident. This script greps the *code* tree for knob references and the
 *docs* corpus (CLAUDE.md, README.md, TROUBLESHOOTING.md, BASELINE.md,
-docs/**/*.md) for mentions; anything referenced but never documented fails
-the check. Runs in tier-1 via tests/test_knobs.py.
+docs/**/*.md) for mentions; anything referenced-but-undocumented OR
+documented-but-unreferenced fails the check. Runs in tier-1 via
+tests/test_knobs.py.
 
 Usage: ``python scripts/check_knobs.py [repo_root]`` — exits nonzero and
-lists the undocumented knobs on stdout.
+lists the offending knobs on stdout.
 """
 
 from __future__ import annotations
@@ -30,6 +34,15 @@ DOC_PATHS = ("CLAUDE.md", "README.md", "TROUBLESHOOTING.md", "BASELINE.md", "doc
 # Internal/cross-process plumbing set by our own launchers, not operators.
 ALLOWLIST = frozenset({
     "KAKVEDA_PROCESS_ID",  # set per-process by the multihost launcher
+    "KAKVEDA_TEST_PLATFORM",  # test-suite lever (tests/conftest.py), named here
+})
+
+# Knobs the docs legitimately mention without the scanned code tree reading
+# them — test-surface levers (tests/ is excluded from CODE_PATHS on
+# purpose) and docs-about-the-docs. Anything else documented-but-unread is
+# dead-knob drift and fails.
+DOC_ONLY_ALLOWLIST = frozenset({
+    "KAKVEDA_TEST_PLATFORM",  # tests/conftest.py: run the suite on real TPU
 })
 
 
@@ -88,17 +101,39 @@ def undocumented_knobs(root: Path) -> dict:
     }
 
 
+def dead_knobs(root: Path) -> list:
+    """Documented knobs the code no longer references — dead-knob drift."""
+    refs = referenced_knobs(root)
+    docs = documented_knobs(root)
+    return sorted(
+        k for k in docs
+        if k not in refs
+        and k not in DOC_ONLY_ALLOWLIST
+        and k.rstrip("_") == k and k != "KAKVEDA_"
+    )
+
+
 def main(argv: list) -> int:
     root = Path(argv[1]).resolve() if len(argv) > 1 else Path(__file__).resolve().parents[1]
     missing = undocumented_knobs(root)
-    if not missing:
-        print(f"check_knobs: all {len(referenced_knobs(root))} KAKVEDA_* knobs documented")
+    dead = dead_knobs(root)
+    if not missing and not dead:
+        print(f"check_knobs: all {len(referenced_knobs(root))} KAKVEDA_* knobs "
+              "documented, none dead")
         return 0
-    print(f"check_knobs: {len(missing)} undocumented KAKVEDA_* knob(s):")
-    for knob, files in missing.items():
-        print(f"  {knob}  (referenced by {', '.join(files[:3])}"
-              f"{', …' if len(files) > 3 else ''})")
-    print("document them in CLAUDE.md or docs/ (see docs/observability.md knob registry)")
+    if missing:
+        print(f"check_knobs: {len(missing)} undocumented KAKVEDA_* knob(s):")
+        for knob, files in missing.items():
+            print(f"  {knob}  (referenced by {', '.join(files[:3])}"
+                  f"{', …' if len(files) > 3 else ''})")
+        print("document them in CLAUDE.md or docs/ (see docs/observability.md knob registry)")
+    if dead:
+        print(f"check_knobs: {len(dead)} dead KAKVEDA_* knob(s) (documented but "
+              "no longer read by any code):")
+        for knob in dead:
+            print(f"  {knob}")
+        print("remove them from the docs, or add to DOC_ONLY_ALLOWLIST if "
+              "deliberately doc-only")
     return 1
 
 

@@ -47,6 +47,45 @@ def test_init_and_status_and_reset(tmp_path, capsys):
     assert not (tmp_path / "data").exists()
 
 
+def test_dlq_list_and_replay(tmp_path, capsys, monkeypatch):
+    """`dlq list` summarizes per-(topic, url) without event bodies;
+    `dlq replay` re-POSTs and rewrites the file with what still fails."""
+    dlq = tmp_path / "data" / "dlq.jsonl"
+    dlq.parent.mkdir(parents=True)
+
+    # empty: list reports zero events
+    assert main(["dlq", "--dir", str(tmp_path)]) == 0
+    assert json.loads(capsys.readouterr().out)["events"] == 0
+
+    dlq.write_text(
+        json.dumps({"ts": 1.0, "topic": "t", "url": "http://a/h",
+                    "event": {"n": 1}, "error": "boom", "attempts": 3}) + "\n"
+        + json.dumps({"ts": 2.0, "topic": "t", "url": "http://a/h",
+                      "event": {"n": 2}, "error": "later", "attempts": 3}) + "\n"
+    )
+    assert main(["dlq", "list", "--dir", str(tmp_path)]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["events"] == 2
+    assert out["entries"][0]["count"] == 2 and out["entries"][0]["last_error"] == "later"
+
+    import httpx
+
+    sent = []
+
+    class _OK:
+        def raise_for_status(self):
+            return None
+
+    monkeypatch.setattr(
+        httpx, "post", lambda u, json=None, timeout=None: (sent.append(json), _OK())[1]
+    )
+    assert main(["dlq", "replay", "--dir", str(tmp_path)]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["replayed"] == 2 and out["failed"] == 0
+    assert [e["n"] for e in sent] == [1, 2]
+    assert dlq.read_text() == ""
+
+
 def test_status_counts_rows(tmp_path, capsys):
     data = tmp_path / "data"
     data.mkdir(parents=True)

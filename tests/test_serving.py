@@ -237,15 +237,18 @@ def test_runtime_generate_routes_through_engine(monkeypatch):
         rt2._engine.close()
 
 
-def test_serving_engine_loop_death_fails_futures_not_hangs():
-    """If the decode loop dies (device error mid-chunk), pending futures
-    must FAIL — callers blocked on result() would otherwise hang forever —
-    and later submits must raise instead of enqueueing into a dead loop.
-    The runtime layer then falls back to the solo decode path."""
+def test_serving_engine_loop_death_fails_futures_not_hangs(monkeypatch):
+    """If the decode loop dies (device error mid-chunk) with the restart
+    budget exhausted, pending futures must FAIL — callers blocked on
+    result() would otherwise hang forever — and later submits must raise
+    EngineDeadError IMMEDIATELY instead of enqueueing into a queue nobody
+    drains. The runtime layer then falls back to the solo decode path.
+    (Restart/recovery semantics under a non-zero budget: tests/test_chaos.py.)"""
     import pytest
 
-    from kakveda_tpu.models.serving import ServingEngine
+    from kakveda_tpu.models.serving import EngineDeadError, ServingEngine
 
+    monkeypatch.setenv("KAKVEDA_SERVE_RESTARTS", "0")
     params = init_params(jax.random.PRNGKey(0), CFG)
     eng = ServingEngine(params, CFG, batch_slots=2, max_len=64, chunk_steps=4)
 
@@ -254,16 +257,18 @@ def test_serving_engine_loop_death_fails_futures_not_hangs():
 
     eng.cb.step_async = boom  # next chunk dispatch kills the loop
     fut = eng.submit([5, 6, 7], max_new_tokens=8)
-    with pytest.raises(RuntimeError, match="loop died"):
+    with pytest.raises(EngineDeadError, match="died terminally"):
         fut.result(timeout=30)
     import time as _t
 
-    for _ in range(50):  # loop marks itself closed promptly
-        if eng._closed.is_set():
+    for _ in range(50):  # loop marks itself dead promptly
+        if eng._dead.is_set():
             break
         _t.sleep(0.1)
-    with pytest.raises(RuntimeError):
+    with pytest.raises(EngineDeadError):
         eng.submit([5], max_new_tokens=2)
+    with pytest.raises(EngineDeadError):
+        eng.register_prefix(list(range(16)))
 
 
 def test_runtime_masks_padded_vocab_for_byte_tokenizer():
