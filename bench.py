@@ -1036,21 +1036,83 @@ def _measure_mine(n: int, dim: int, n_templates: int) -> dict:
     labels = cluster_embeddings(v_dev, threshold=0.6)
     t_mine = time.perf_counter() - t0
 
-    # Purity: majority template per label.
-    order = np.argsort(labels, kind="stable")
-    sl, st = labels[order], template_ids[order]
-    bounds = np.flatnonzero(np.r_[True, sl[1:] != sl[:-1], True])
-    correct = 0
-    for a, b in zip(bounds[:-1], bounds[1:]):
-        _, counts = np.unique(st[a:b], return_counts=True)
-        correct += int(counts.max())
-    purity = correct / n
+    def _purity(lab: np.ndarray, tmpl: np.ndarray) -> float:
+        """Majority-template share per cluster label."""
+        order = np.argsort(lab, kind="stable")
+        sl, st = lab[order], tmpl[order]
+        bounds = np.flatnonzero(np.r_[True, sl[1:] != sl[:-1], True])
+        correct = 0
+        for a, b in zip(bounds[:-1], bounds[1:]):
+            _, counts = np.unique(st[a:b], return_counts=True)
+            correct += int(counts.max())
+        return correct / len(lab)
+
+    purity = _purity(labels, template_ids)
+
+    # --- incremental streaming arm --------------------------------------
+    # The same corpus streamed through ingest-time attachment
+    # (ops/incremental.py): per batch, ONE delta top-k against the rows
+    # inserted so far + host union-find updates — O(ΔN·N) per batch,
+    # amortized over the stream — then "refresh" = materialize labels
+    # from the live state, which is what mine_patterns pays per call
+    # instead of the full O(N²) sweep. Parity vs the full-mine oracle is
+    # asserted EXACTLY (same packed-label convention), purity against the
+    # generating templates like the full arm.
+    from kakveda_tpu.ops.clustering import _KNN_K, _corpus_pad
+    from kakveda_tpu.ops.incremental import ClusterState, delta_topk_dense, unpack_topk
+
+    n_inc = min(n, int(os.environ.get("KAKVEDA_BENCH_MINE_INC_N", 20_000)))
+    inc_bs = 1 << max(4, int(os.environ.get("KAKVEDA_BENCH_MINE_INC_BATCH", 512)).bit_length() - 1)
+    thr = 0.6
+    if n_inc == n:
+        labels_sub, full_wall_sub = labels, t_mine
+    else:
+        t0 = time.perf_counter()
+        labels_sub = cluster_embeddings(v_dev[:n_inc], threshold=thr)
+        full_wall_sub = time.perf_counter() - t0
+    P = _corpus_pad(n_inc)
+    v_pad = (
+        jnp.concatenate([v_dev[:n_inc], jnp.zeros((P - n_inc, dim), jnp.float32)])
+        if P != n_inc
+        else v_dev[:n_inc]
+    )
+    state = ClusterState(threshold=thr, k=_KNN_K)
+    # warm the single compiled delta program off-clock
+    jax.block_until_ready(delta_topk_dense(v_pad[:inc_bs], v_pad, inc_bs, _KNN_K + 1))
+    t_stream = 0.0
+    for s in range(0, n_inc, inc_bs):
+        e = min(s + inc_bs, n_inc)
+        t0 = time.perf_counter()
+        packed = delta_topk_dense(v_pad[s : s + inc_bs], v_pad, e, _KNN_K + 1)
+        sims, idx = unpack_topk(packed, e - s)
+        for r in range(e - s):
+            state.add_row(s + r)
+        for r in range(e - s):
+            state.attach(s + r, idx[r], sims[r])
+        t_stream += time.perf_counter() - t0
+    t0 = time.perf_counter()
+    inc_labels = state.labels()
+    t_refresh = time.perf_counter() - t0
+    inc = {
+        "n": n_inc,
+        "stream_wall_s": t_stream,
+        "amortized_ms_per_row": t_stream * 1000.0 / n_inc,
+        "refresh_wall_s": t_refresh,
+        "full_wall_s": full_wall_sub,
+        "refresh_speedup": full_wall_sub / max(t_refresh, 1e-9),
+        "parity": bool(np.array_equal(inc_labels, labels_sub)),
+        "purity": _purity(inc_labels, template_ids[:n_inc]),
+        "clusters": state.n_clusters,
+        "batch": inc_bs,
+    }
+
     return {
         "n": n,
         "wall_s": t_mine,
         "embed_s": t_embed,
         "clusters": int(len(np.unique(labels))),
         "purity": purity,
+        "incremental": inc,
     }
 
 
@@ -1628,15 +1690,37 @@ def _bench_mine(backend: str) -> dict:
         f"({r['clusters']} clusters, purity {r['purity']:.3f}; host embed {r['embed_s']:.1f}s)",
         file=sys.stderr,
     )
+    inc = r["incremental"]
+    print(
+        f"bench[mine]: incremental — streamed {inc['n']:,} rows at "
+        f"{inc['amortized_ms_per_row']:.3f} ms/row amortized "
+        f"(batch {inc['batch']}); cluster refresh {inc['refresh_wall_s']*1000:.1f} ms "
+        f"vs full sweep {inc['full_wall_s']:.2f}s "
+        f"({inc['refresh_speedup']:.0f}x), parity={inc['parity']}, "
+        f"purity {inc['purity']:.3f}",
+        file=sys.stderr,
+    )
     # Self-certifying: a wall time whose clustering is wrong is not a
     # result. Purity is computed on THIS run's labels (not a calibration
     # run at another scale); below the floor the metric FAILS rather than
-    # reporting a meaningless speed.
+    # reporting a meaningless speed. The incremental arm must ALSO match
+    # the full-mine oracle's partition exactly and clear the same purity
+    # floor — a fast refresh with different clusters is not a result.
     min_purity = float(os.environ.get("KAKVEDA_BENCH_MINE_MIN_PURITY", 0.99))
     if r["purity"] < min_purity:
         raise AssertionError(
             f"mine purity {r['purity']:.4f} below the {min_purity} floor at "
             f"{r['n']:,} rows ({r['clusters']} clusters) — wall time not reportable"
+        )
+    if not inc["parity"]:
+        raise AssertionError(
+            f"incremental mine diverged from the full-mine partition at "
+            f"{inc['n']:,} rows — refresh speed not reportable"
+        )
+    if inc["purity"] < min_purity:
+        raise AssertionError(
+            f"incremental mine purity {inc['purity']:.4f} below the "
+            f"{min_purity} floor at {inc['n']:,} rows"
         )
     return {
         "metric": f"mine_wall_s_at_{n}_gfkb",
@@ -1646,6 +1730,17 @@ def _bench_mine(backend: str) -> dict:
         "clusters": r["clusters"],
         "purity": round(r["purity"], 4),
         "min_purity": min_purity,
+        "incremental": {
+            "n": inc["n"],
+            "amortized_ms_per_row": round(inc["amortized_ms_per_row"], 4),
+            "stream_wall_s": round(inc["stream_wall_s"], 3),
+            "refresh_wall_s": round(inc["refresh_wall_s"], 4),
+            "full_wall_s": round(inc["full_wall_s"], 3),
+            "refresh_speedup": round(inc["refresh_speedup"], 1),
+            "parity": inc["parity"],
+            "purity": round(inc["purity"], 4),
+            "clusters": inc["clusters"],
+        },
     }
 
 

@@ -404,6 +404,17 @@ _CORE_FAMILIES = (
      "Classify+embed+insert wall per ingest batch", (), None),
     ("counter", "kakveda_warn_requests_total",
      "Pre-flight warn verdicts by action", ("action",), None),
+    ("histogram", "kakveda_mine_update_seconds",
+     "Incremental cluster-state update wall per drained delta batch", (), None),
+    ("gauge", "kakveda_mine_clusters",
+     "Live clusters in the incremental mining state", (), None),
+    ("counter", "kakveda_mine_attach_total",
+     "Rows attached to the incremental cluster state by neighbor source",
+     ("source",), None),
+    ("counter", "kakveda_mine_merges_total",
+     "Cluster merges performed by incremental attachment", (), None),
+    ("counter", "kakveda_mine_sweeps_total",
+     "Pattern-mining sweeps by mode", ("mode",), None),
     ("histogram", "kakveda_warn_batch_seconds",
      "Device kNN match wall per warn batch", (), None),
     ("counter", "kakveda_bus_events_published_total",

@@ -33,6 +33,8 @@ import json
 import logging
 import os
 import threading
+import time
+from collections import OrderedDict, deque
 from pathlib import Path
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -41,6 +43,7 @@ from jax.sharding import Mesh
 
 from kakveda_tpu import native
 from kakveda_tpu.core import faults as _faults
+from kakveda_tpu.core import metrics as _metrics
 from kakveda_tpu.core import profiling
 
 log = logging.getLogger("kakveda.gfkb")
@@ -61,14 +64,25 @@ class SnapshotError(RuntimeError):
     a caller-side condition, distinct from device/runtime failures."""
 
 
+def _iso(ts: str):
+    """Parse our own model_dump_json timestamps. Pydantic writes tz-aware
+    UTC as '…Z', which datetime.fromisoformat only learned in Python 3.11
+    — on 3.10 the bare call raised and the blanket corruption-fallback in
+    _restore_snapshot silently degraded EVERY restore to a full log
+    replay (the snapshot fast path never actually ran)."""
+    from datetime import datetime
+
+    if ts.endswith("Z"):
+        ts = ts[:-1] + "+00:00"
+    return datetime.fromisoformat(ts)
+
+
 def _record_from_snapshot(obj: dict) -> dict:
     """Snapshot rows are our own model_dump_json output: re-hydrate the two
     non-JSON-native field types for model_construct (which skips the
     validators that would otherwise do this)."""
-    from datetime import datetime
-
-    obj["created_at"] = datetime.fromisoformat(obj["created_at"])
-    obj["updated_at"] = datetime.fromisoformat(obj["updated_at"])
+    obj["created_at"] = _iso(obj["created_at"])
+    obj["updated_at"] = _iso(obj["updated_at"])
     obj["impact_severity"] = Severity(obj["impact_severity"])
     return obj
 
@@ -149,6 +163,54 @@ class GFKB:
         # Chaos-harness sites (core/faults.py), resolved once.
         self._fault_append = _faults.site("gfkb.append")
         self._fault_snapshot = _faults.site("gfkb.snapshot")
+        self._fault_mine = _faults.site("gfkb.mine_state")
+
+        # Incremental mining state (KAKVEDA_MINE_INCREMENTAL=0 restores
+        # the full-sweep-only behavior bit-for-bit: no state, no cache, no
+        # extra device dispatches). The union-find + aggregates live on
+        # host; each ingest batch gets ONE delta top-k dispatch against
+        # the resident index (ops/incremental.py) whose packed result is
+        # drained lazily — or zero dispatches when a recent warn match for
+        # the same signature already fetched the neighbors.
+        self._mine_enabled = os.environ.get("KAKVEDA_MINE_INCREMENTAL", "1") != "0"
+        self._mine = None
+        # pending delta results: (knn, slots np.int32, packed, generation)
+        self._mine_pending: deque = deque()
+        self._mine_pending_max = int(os.environ.get("KAKVEDA_MINE_PENDING_MAX", "256"))
+        # signature_text -> (scores, slots, generation): the warn path's
+        # already-fetched neighbors, reused for free attachment at ingest.
+        self._match_cache: "OrderedDict[str, tuple]" = OrderedDict()
+        self._match_cache_max = int(os.environ.get("KAKVEDA_MINE_MATCH_CACHE", "4096"))
+        self.mine_delta_dispatches = 0  # observability + reuse tests
+        self._mine_merges_seen = 0
+        if self._mine_enabled:
+            from kakveda_tpu.ops.incremental import ClusterState
+
+            self._mine = ClusterState(
+                threshold=float(os.environ.get("KAKVEDA_MINE_THRESHOLD", "0.6")),
+                k=int(os.environ.get("KAKVEDA_MINE_K", "32")),
+            )
+        reg = _metrics.get_registry()
+        self._m_mine_update = reg.histogram(
+            "kakveda_mine_update_seconds",
+            "Incremental cluster-state update wall per drained delta batch",
+        )
+        self._m_mine_clusters = reg.gauge(
+            "kakveda_mine_clusters",
+            "Live clusters in the incremental mining state",
+        )
+        _attach = reg.counter(
+            "kakveda_mine_attach_total",
+            "Rows attached to the incremental cluster state by neighbor source",
+            ("source",),
+        )
+        self._m_mine_attach = {
+            s: _attach.labels(source=s) for s in ("delta", "reused")
+        }
+        self._m_mine_merges = reg.counter(
+            "kakveda_mine_merges_total",
+            "Cluster merges performed by incremental attachment",
+        )
         # Published immutable view for lock-free matching: a tuple swap is
         # atomic under the GIL, so match_batch never takes the data lock —
         # see match_batch for the consistency argument.
@@ -156,6 +218,7 @@ class GFKB:
 
         if persist:
             self._replay()
+        self._mine_after_replay()
         self._publish()
 
     # ------------------------------------------------------------------
@@ -261,6 +324,12 @@ class GFKB:
                     self._apps_by_type.setdefault(rec.failure_type, set()).update(
                         rec.affected_apps
                     )
+                    if self._mine is not None:
+                        # Membership is unchanged by a version update, but
+                        # the cluster's app span may have widened.
+                        self._mine.note_apps(
+                            self._slot_by_key[key], rec.affected_apps
+                        )
                     continue
                 if key not in latest:
                     order.append(key)
@@ -300,9 +369,13 @@ class GFKB:
     # no re-sparsify on restore). v3 adds a content checksum over the
     # snapshot payload to the manifest, so a corrupted snapshot (bad disk,
     # partial copy) degrades to full replay instead of restoring garbage
-    # vectors. Older snapshots fall back to full replay — acceptable
-    # one-time cost, no migration path needed.
-    _SNAPSHOT_VERSION = 3
+    # vectors. v4 adds the incremental-mining cluster labels
+    # (clusters.npy) with their OWN manifest checksum: a bad cluster file
+    # degrades to one full re-mine (state marked stale), never to full
+    # log replay and never to restoring unverified labels. Older
+    # snapshots fall back to full replay — acceptable one-time cost, no
+    # migration path needed.
+    _SNAPSHOT_VERSION = 4
     _TAIL_HASH_BYTES = 4096
     _SNAPSHOT_PAYLOAD = ("sparse_idx.npy", "sparse_val.npy", "records.jsonl")
 
@@ -364,9 +437,23 @@ class GFKB:
         with self._snapshot_write_lock:
             with self._lock:
                 self._drain_pending_embeds()
+                # Fold every pending delta attach into the union-find so
+                # the persisted labels cover exactly the persisted rows —
+                # a pending-at-snapshot edge would otherwise be lost on
+                # restore (desynced labels, the thing v4 must never do).
+                self._mine_drain_locked()
                 self._flush_logs()
                 records = list(self._records)
                 n = len(records)
+                mine_labels = None
+                mine_threshold = None
+                if (
+                    self._mine is not None
+                    and not self._mine.stale
+                    and self._mine.n_rows == n
+                ):
+                    mine_labels = self._mine.labels()
+                    mine_threshold = self._mine.threshold
                 offset = self.failures_path.stat().st_size if self.failures_path.exists() else 0
                 # Capture the knn alongside the buffer: a concurrent growth
                 # re-shard swaps self._knn and would decode emb_copy's
@@ -400,20 +487,31 @@ class GFKB:
                 # except path below — tmp is removed and the previous
                 # snapshot (if any) stays installed.
                 self._fault_snapshot.fire()
-                (tmp / "manifest.json").write_text(
-                    json.dumps(
-                        {
-                            "version": self._SNAPSHOT_VERSION,
-                            "n": n,
-                            "dim": knn.dim,
-                            "log_offset": offset,
-                            "log_hash": log_hash,
-                            # Content checksum: restore verifies it and
-                            # degrades to full replay on any mismatch.
-                            "checksum": self._snapshot_checksum(tmp),
-                        }
-                    )
-                )
+                manifest = {
+                    "version": self._SNAPSHOT_VERSION,
+                    "n": n,
+                    "dim": knn.dim,
+                    "log_offset": offset,
+                    "log_hash": log_hash,
+                    # Content checksum: restore verifies it and
+                    # degrades to full replay on any mismatch.
+                    "checksum": self._snapshot_checksum(tmp),
+                }
+                if mine_labels is not None:
+                    import hashlib
+
+                    np.save(tmp / "clusters.npy", mine_labels.astype(np.int32))
+                    manifest["mine"] = {
+                        "n": n,
+                        "threshold": mine_threshold,
+                        # Own checksum (not part of the main payload
+                        # tuple): a rotted cluster file costs one full
+                        # re-mine, not a full log replay.
+                        "checksum": hashlib.sha256(
+                            (tmp / "clusters.npy").read_bytes()
+                        ).hexdigest(),
+                    }
+                (tmp / "manifest.json").write_text(json.dumps(manifest))
                 # Swap via renames under the data lock: serialized with
                 # reload(), and a crash mid-swap leaves at worst no snapshot
                 # (full replay fallback), never a half-written one.
@@ -505,7 +603,52 @@ class GFKB:
                 np.arange(n, dtype=np.int32),
                 tids,
             )
+        self._mine_restore(sd, manifest)
         return offset
+
+    def _mine_restore(self, sd: Path, manifest: dict) -> None:
+        """Seed the incremental cluster state from a snapshot's labels.
+        NEVER installs unverified labels: any missing/mismatched field,
+        checksum failure or injected fault leaves the state stale, which
+        costs exactly one full re-mine on the next mine_patterns call."""
+        m = self._mine
+        if m is None:
+            return
+        try:
+            self._fault_mine.fire()
+            mf = manifest.get("mine")
+            if not mf:
+                m.mark_stale("snapshot carries no cluster state")
+                return
+            import hashlib
+
+            raw = (sd / "clusters.npy").read_bytes()
+            if hashlib.sha256(raw).hexdigest() != mf.get("checksum"):
+                raise ValueError("cluster-state checksum mismatch")
+            import io
+
+            labels = np.load(io.BytesIO(raw))
+            if (
+                labels.shape != (len(self._records),)
+                or labels.dtype != np.int32
+                or int(mf.get("n", -1)) != len(self._records)
+            ):
+                raise ValueError("cluster-state shape mismatch")
+            if float(mf.get("threshold", -1.0)) != m.threshold:
+                # Config changed since the snapshot: labels were built for
+                # a different graph — full re-mine, don't reinterpret.
+                m.mark_stale("snapshot threshold differs from configured")
+                return
+            m.seed(
+                labels,
+                [(r.failure_type, r.failure_id, r.affected_apps) for r in self._records],
+            )
+        except Exception as e:  # noqa: BLE001 — degrade, never desync
+            log.warning(
+                "cluster-state restore failed (%s: %s); first mine will run "
+                "a full sweep", type(e).__name__, e,
+            )
+            m.mark_stale(f"restore failed: {type(e).__name__}")
 
     def _bulk_insert_chunked(self, sparsify, slots: np.ndarray, tids: np.ndarray) -> None:
         """Bulk insert in bounded 64k chunks: insert inputs are replicated
@@ -560,9 +703,33 @@ class GFKB:
             # The rewrite replaced the files; any torn-tail truncation
             # scheduled against the OLD files must not fire on the new ones.
             self._truncate_pending = {}
+            if self._mine is not None:
+                from kakveda_tpu.ops.incremental import ClusterState
+
+                self._mine = ClusterState(
+                    threshold=self._mine.threshold, k=self._mine.k
+                )
+            self._mine_pending.clear()
+            self._match_cache.clear()
+            self._mine_merges_seen = 0
             if self.persist:
                 self._replay()
+            self._mine_after_replay()
             self._publish()
+
+    def _mine_after_replay(self) -> None:
+        """Post-replay invariant: the cluster state must cover exactly the
+        replayed rows or be stale. A snapshot restore seeds it; a full log
+        replay (or a log tail with rows the snapshot never saw) leaves a
+        gap that only a full re-mine can close."""
+        m = self._mine
+        if m is None:
+            return
+        if len(self._records) and m.n_rows != len(self._records):
+            m.mark_stale("replayed rows not covered by restored cluster state")
+        nc = m.n_clusters_cached()
+        if nc is not None:
+            self._m_mine_clusters.set(nc)
 
     # ------------------------------------------------------------------
     # failures
@@ -761,6 +928,8 @@ class GFKB:
                 self._slot_by_id[rec.failure_id] = slot
                 self._ids_by_type.setdefault(failure_type, []).append(rec.failure_id)
                 self._apps_by_type.setdefault(failure_type, set()).add(app_id)
+                if self._mine is not None and not self._mine.stale:
+                    self._mine.add_row(slot, failure_type, rec.failure_id, [app_id])
             else:
                 created = False
                 old = self._records[slot]
@@ -771,6 +940,8 @@ class GFKB:
                 if app_id not in rec.affected_apps:
                     rec.affected_apps.append(app_id)
                 self._apps_by_type.setdefault(failure_type, set()).add(app_id)
+                if self._mine is not None:
+                    self._mine.note_apps(slot, [app_id])
                 rec.root_cause = root_cause or rec.root_cause
                 rec.resolution = resolution or rec.resolution
                 rec.context_signature = context_signature or rec.context_signature
@@ -824,6 +995,10 @@ class GFKB:
                     self._slot_by_id[rec.failure_id] = slot
                     self._ids_by_type.setdefault(rec.failure_type, []).append(rec.failure_id)
                     self._apps_by_type.setdefault(rec.failure_type, set()).add(item["app_id"])
+                    if self._mine is not None and not self._mine.stale:
+                        self._mine.add_row(
+                            slot, rec.failure_type, rec.failure_id, [item["app_id"]]
+                        )
                     new_slots.append(slot)
                     new_texts.append(rec.signature_text)
                     new_tids.append(self._type_id(rec.failure_type))
@@ -837,6 +1012,8 @@ class GFKB:
                     if item["app_id"] not in rec.affected_apps:
                         rec.affected_apps.append(item["app_id"])
                     self._apps_by_type.setdefault(rec.failure_type, set()).add(item["app_id"])
+                    if self._mine is not None:
+                        self._mine.note_apps(slot, [item["app_id"]])
                     rec.root_cause = item.get("root_cause") or rec.root_cause
                     rec.resolution = item.get("resolution") or rec.resolution
                     rec.context_signature = item.get("context_signature") or rec.context_signature
@@ -867,6 +1044,7 @@ class GFKB:
         try:
             if len(self._records) > self._knn.capacity:
                 self._grow_and_reembed()
+                self._mine_attach_new(slots, texts, None, None, gen)
                 return
             # Sparse path: hashed-ngram rows are ~98% zeros; shipping (idx,
             # val) pairs instead of dense [B, dim] keeps streaming ingest off
@@ -889,10 +1067,203 @@ class GFKB:
                     self._publish()
             if need_growth:
                 self._grow_and_reembed()
+            self._mine_attach_new(slots, texts, sp_idx, sp_val, gen)
         finally:
             with self._lock:
                 self._pending_embeds -= 1
                 self._embeds_cv.notify_all()
+
+    # ------------------------------------------------------------------
+    # incremental mining state
+    # ------------------------------------------------------------------
+
+    def _mine_attach_new(self, slots, texts, sp_idx, sp_val, gen) -> None:
+        """Queue attach-neighbors for freshly inserted rows.
+
+        Rows whose signature a recent warn match already scored reuse
+        those neighbors outright (zero device work); the rest share ONE
+        delta top-k dispatch against the resident index — O(ΔN·N) per
+        batch. The packed result's host copy starts immediately but is
+        consumed lazily (mine_patterns drains it), so the ingest path
+        never pays a device→host fetch RTT here. Any failure degrades the
+        state to stale (one full re-mine) — mining is derived state and
+        must never fail an ingest."""
+        m = self._mine
+        if m is None or m.stale:
+            return
+        try:
+            self._fault_mine.fire()
+            reused = []  # (slot, neigh_slots, sims)
+            delta_rows: List[int] = []
+            with self._lock:
+                if self._generation != gen:
+                    return
+                for i, (s, t) in enumerate(zip(slots, texts)):
+                    hit = self._match_cache.get(t)
+                    if hit is not None and hit[2] == gen:
+                        reused.append((s, hit[1], hit[0]))
+                    else:
+                        delta_rows.append(i)
+                if delta_rows:
+                    if sp_idx is None:
+                        sub_texts = [texts[i] for i in delta_rows]
+                        d_idx, d_val = self.featurizer.encode_batch_sparse(sub_texts)
+                    else:
+                        d_idx = sp_idx[delta_rows]
+                        d_val = sp_val[delta_rows]
+                    from kakveda_tpu.ops.incremental import delta_topk_sparse
+
+                    # Dispatch under the data lock (PJRT buffer-hold rule,
+                    # same as match_batch); +1 neighbor: each row's top-1
+                    # against the post-insert index is itself.
+                    with profiling.annotate("gfkb.mine.delta"):
+                        packed = delta_topk_sparse(
+                            self._emb, self._valid, d_idx, d_val, m.k + 1
+                        )
+                    self.mine_delta_dispatches += 1
+                    self._mine_pending.append(
+                        (
+                            self._knn,
+                            np.asarray([slots[i] for i in delta_rows], np.int32),
+                            packed,
+                            gen,
+                        )
+                    )
+            for s, nslots, nsims in reused:
+                m.attach(int(s), nslots, nsims)
+                self._m_mine_attach["reused"].inc()
+            if len(self._mine_pending) > self._mine_pending_max:
+                with self._lock:
+                    self._mine_drain_locked()
+        except Exception as e:  # noqa: BLE001 — degrade, never fail ingest
+            log.warning(
+                "incremental mining attach failed (%s: %s); state marked "
+                "stale — next mine_patterns runs a full sweep",
+                type(e).__name__, e,
+            )
+            m.mark_stale(f"attach failed: {type(e).__name__}")
+            self._mine_pending.clear()
+
+    def _mine_drain_locked(self) -> int:
+        """Fold every pending delta top-k result into the union-find
+        (call with the data lock held). Packed buffers started their host
+        copy at dispatch, so the fetch here is normally a no-wait read."""
+        m = self._mine
+        if m is None:
+            return 0
+        drained = 0
+        while self._mine_pending:
+            knn, d_slots, packed, gen = self._mine_pending.popleft()
+            if gen != self._generation or m.stale:
+                continue
+            t0 = time.perf_counter()
+            try:
+                self._fault_mine.fire()
+                from kakveda_tpu.ops.incremental import unpack_topk
+                from kakveda_tpu.ops.knn import physical_to_slot
+
+                sims, phys = unpack_topk(packed, len(d_slots))
+                for row in range(len(d_slots)):
+                    keep = np.isfinite(sims[row]) & (sims[row] >= m.threshold)
+                    keep &= phys[row] < knn.capacity
+                    p = phys[row][keep]
+                    sl = (
+                        p
+                        if knn.single_device
+                        else physical_to_slot(p, knn.n_shards, knn.rows_per_shard)
+                    )
+                    m.attach(int(d_slots[row]), sl, sims[row][keep])
+                    self._m_mine_attach["delta"].inc()
+                drained += len(d_slots)
+            except Exception as e:  # noqa: BLE001 — degrade, never desync
+                log.warning(
+                    "incremental mining drain failed (%s: %s); state marked "
+                    "stale — next mine_patterns runs a full sweep",
+                    type(e).__name__, e,
+                )
+                m.mark_stale(f"drain failed: {type(e).__name__}")
+                self._mine_pending.clear()
+                break
+            self._m_mine_update.observe(time.perf_counter() - t0)
+        nc = m.n_clusters_cached()
+        if nc is not None:
+            self._m_mine_clusters.set(nc)
+        return drained
+
+    def mine_drain(self) -> int:
+        """Public drain: apply pending incremental deltas, return the
+        number of rows attached."""
+        with self._lock:
+            return self._mine_drain_locked()
+
+    def mine_state_info(self) -> dict:
+        """Freshness view of the incremental state (service/mine endpoint
+        + tests): enabled flag, row/cluster/dirty counts, staleness and
+        the pending (not yet drained) delta batches."""
+        with self._lock:
+            if self._mine is None:
+                return {"enabled": False}
+            info = self._mine.info()
+            info.update(
+                enabled=True,
+                pending=len(self._mine_pending),
+                covers_all_rows=self._mine.n_rows == len(self._records),
+                delta_dispatches=self.mine_delta_dispatches,
+            )
+            return info
+
+    def mine_pop_dirty(self) -> List[dict]:
+        """Aggregate snapshots of clusters touched since the last call
+        (drains pending deltas first so 'dirty' is current)."""
+        with self._lock:
+            self._mine_drain_locked()
+            m = self._mine
+            if m is None or m.stale:
+                return []
+            out = m.pop_dirty()
+            self._m_mine_merges.inc(m.merges - self._mine_merges_seen)
+            self._mine_merges_seen = m.merges
+            nc = m.n_clusters_cached()
+            if nc is not None:
+                self._m_mine_clusters.set(nc)
+            return out
+
+    def mine_usable(self, threshold: float) -> bool:
+        """Can mine_patterns serve this call incrementally? Requires the
+        state to be enabled, non-stale, covering every record, and built
+        for the same threshold (a different threshold is a different
+        graph — full sweep)."""
+        with self._lock:
+            m = self._mine
+            return (
+                m is not None
+                and not m.stale
+                and m.n_rows == len(self._records)
+                and m.threshold == float(threshold)
+            )
+
+    def mine_reseed(self, labels: np.ndarray, threshold: float, n_records: int) -> bool:
+        """Install a full-sweep result as the new incremental baseline.
+        ``n_records`` is the record count the sweep covered; rows appended
+        during the sweep leave the state stale (the next sweep catches
+        them) rather than silently uncovered."""
+        with self._lock:
+            m = self._mine
+            if m is None:
+                return False
+            self._mine_pending.clear()
+            if n_records != len(self._records) or len(labels) != n_records:
+                m.mark_stale("records changed during the full sweep")
+                return False
+            m.seed(
+                labels,
+                [(r.failure_type, r.failure_id, r.affected_apps) for r in self._records],
+                threshold=threshold,
+            )
+            nc = m.n_clusters_cached()
+            if nc is not None:
+                self._m_mine_clusters.set(nc)
+            return True
 
     def _drain_pending_embeds(self) -> None:
         """Wait (holding the lock via the condition) until no appended
@@ -964,6 +1335,21 @@ class GFKB:
                 packed = knn.topk_async_sparse(emb, valid, q_idx, q_val)
         with profiling.annotate("gfkb.match.fetch"):
             scores, slots = knn.topk_result(packed)
+
+        if self._mine is not None and self._match_cache_max > 0 and failure_type is None:
+            # Remember the fetched neighbors per signature: a pre-flight
+            # warn is usually followed by the SAME signature being
+            # ingested when the trace fails, and these rows make its
+            # cluster attachment free (no extra device dispatch).
+            with self._lock:
+                gen_now = self._generation
+                for i in range(b):
+                    self._match_cache[signature_texts[i]] = (
+                        scores[i], slots[i], gen_now
+                    )
+                    self._match_cache.move_to_end(signature_texts[i])
+                while len(self._match_cache) > self._match_cache_max:
+                    self._match_cache.popitem(last=False)
 
         out: List[List[FailureMatch]] = []
         for i in range(b):

@@ -554,7 +554,11 @@ class LlamaRuntime:
         quant = os.environ.get("KAKVEDA_QUANT") or None
         if quant not in (None, "none", "int8"):
             raise ValueError(f"unknown KAKVEDA_QUANT={quant!r} (int8|none)")
-        hf_ckpt = os.environ.get("KAKVEDA_HF_CKPT")
+        # KAKVEDA_HF_DIR is the documented operator-facing alias (VERDICT
+        # item 8: one env var from proven real-weight parity on any
+        # machine with a local HF checkpoint); KAKVEDA_HF_CKPT predates it
+        # and wins when both are set.
+        hf_ckpt = os.environ.get("KAKVEDA_HF_CKPT") or os.environ.get("KAKVEDA_HF_DIR")
         if hf_ckpt:
             return cls.from_hf(hf_ckpt, quant=quant)
         preset = os.environ.get("KAKVEDA_LLAMA_PRESET", "tiny").lower()

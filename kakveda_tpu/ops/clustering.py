@@ -114,6 +114,18 @@ def _project(v: jax.Array, out_dim: int) -> jax.Array:
     return p / jnp.maximum(jnp.linalg.norm(p, axis=1, keepdims=True), 1e-12)
 
 
+def _corpus_pad(n: int) -> int:
+    """Padded corpus length for the blocked sweep: the next power of two
+    (≥ _BLOCK). Padding only to the next _BLOCK multiple re-specializes
+    ``_block_topk`` on every 1024-row boundary the GFKB crosses — O(N)
+    compiles over a growing corpus; pow2 buckets make it O(log N), and the
+    pad rows are valid-masked so results are identical."""
+    p = _BLOCK
+    while p < n:
+        p <<= 1
+    return p
+
+
 def build_knn_edges(
     vecs: np.ndarray, *, k: int = _KNN_K, threshold: float = 0.6,
     force_projection: bool = False,
@@ -133,7 +145,7 @@ def build_knn_edges(
     )
     vc = v if exact else _project(v, _MINE_DIM)
 
-    pad = (-n) % _BLOCK
+    pad = _corpus_pad(n) - n
     if pad:
         vc_p = jnp.concatenate([vc, jnp.zeros((pad, vc.shape[1]), vc.dtype)], axis=0)
     else:

@@ -1,0 +1,422 @@
+"""Incremental streaming cluster state for pattern mining.
+
+``ops/clustering.py`` answers "what are the clusters?" with one O(N²·d)
+blocked sweep over the whole corpus — correct, but every
+``mine_patterns()`` call re-pays the full corpus even though the GFKB is
+append-only and the device already streams every new row through a top-k
+for the warn path. This module makes clustering pay for the *delta*:
+
+* :func:`delta_topk_sparse` / :func:`delta_topk_dense` — ONE device
+  dispatch of a new batch against the resident index, reusing
+  ``ops.clustering._block_topk`` with the batch as queries: O(ΔN·N·d)
+  per batch instead of O(N²·d) per mine. The packed result is host-copied
+  asynchronously; attachment drains later, so ingest never waits on a
+  device→host fetch.
+* :class:`ClusterState` — the host-side streaming mirror of the sweep's
+  union-kNN graph: per-row top-k above-threshold neighbor lists,
+  maintained under insertion (a new row stores its candidates AND is
+  offered to each neighbor's list, evicting that list's worst entry).
+  Unions are LAZY: ``refresh()`` runs connected components over the
+  maintained edge set (+ the seeded base partition), so an early
+  candidate that later rows crowd out never merges anything — eager
+  unions would freeze prefix-view mistakes into the partition forever.
+  Labels follow ``cluster_embeddings``' convention (smallest member
+  index), so a refresh is directly comparable to a full sweep.
+
+Graph equivalence: whenever every row's above-threshold degree is ≤ k,
+no list ever evicts, every above-threshold pair (i, j) is recorded when
+the later row arrives (i is necessarily in j's prefix top-k), and the
+maintained graph IS the threshold graph — the incremental partition
+equals the full sweep's exactly (property-tested in
+tests/test_mine_incremental.py; bench.py asserts the same parity on its
+20k-template corpus). Rows with more neighbors keep their k best — the
+same degree-cap semantics ``cluster_embeddings`` applies in both of its
+tiers. One monotonicity caveat: after a :meth:`seed`, the base partition
+is carried as edges, so components never split until the next full sweep
+(``mode="full"`` — the periodic audit) re-derives them; the pattern
+store's union-merge semantics are monotone in the same way.
+
+The class is dependency-free (numpy only) and thread-safe via one RLock;
+metrics and fault sites live in the caller (index/gfkb.py) so this stays
+importable from bench.py without the platform stack.
+"""
+
+from __future__ import annotations
+
+import threading
+from functools import partial
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kakveda_tpu.ops.clustering import _BLOCK, _block_topk, _sparse_components
+
+__all__ = ["ClusterState", "delta_topk_sparse", "delta_topk_dense", "unpack_topk"]
+
+
+# ---------------------------------------------------------------------------
+# delta top-k dispatch (device)
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=("k",))
+def _delta_topk_sparse_jit(emb, valid, idx, val, k):
+    """Densify sparse (idx, val) queries on device and run the blocked
+    top-k against the resident index buffer. The corpus side is padded to
+    a _BLOCK multiple inside the program (compile-time shapes), so the
+    index capacity never has to be block-aligned."""
+    b = idx.shape[0]
+    dim = emb.shape[1]
+    q = jnp.zeros((b, dim), jnp.float32).at[jnp.arange(b)[:, None], idx].add(
+        val, mode="drop"
+    )
+    q = q.astype(emb.dtype)
+    pad = (-emb.shape[0]) % _BLOCK
+    if pad:
+        emb = jnp.concatenate([emb, jnp.zeros((pad, dim), emb.dtype)])
+        valid = jnp.concatenate([valid, jnp.zeros((pad,), jnp.bool_)])
+    return _block_topk(q, emb, valid, k)
+
+
+@partial(jax.jit, static_argnames=("k",))
+def _delta_topk_dense_jit(q, v, n_valid, k):
+    """Dense-query variant for pre-resident corpora (bench streaming arm):
+    rows [0, n_valid) are live, the rest are padding. ``n_valid`` is a
+    traced scalar so the growing stream reuses ONE compiled program."""
+    valid = jnp.arange(v.shape[0]) < n_valid
+    return _block_topk(q.astype(v.dtype), v, valid, k)
+
+
+def _bucket(b: int) -> int:
+    from kakveda_tpu.ops.knn import batch_bucket
+
+    return batch_bucket(max(b, 1))
+
+
+def delta_topk_sparse(
+    emb: jax.Array, valid: jax.Array, idx: np.ndarray, val: np.ndarray, k: int
+) -> jax.Array:
+    """Dispatch one delta top-k of a sparse-encoded batch against the
+    index; returns the packed [B, 2k'] device buffer with the host copy
+    already started (fetch with :func:`unpack_topk`). Batch pads to a
+    power-of-two bucket so ragged ingest batches never retrace."""
+    b = idx.shape[0]
+    bb = _bucket(b)
+    if b != bb:
+        pad_i = np.full((bb, idx.shape[1]), emb.shape[1], np.int32)
+        pad_v = np.zeros((bb, val.shape[1]), np.float32)
+        pad_i[:b] = idx
+        pad_v[:b] = val
+        idx, val = pad_i, pad_v
+    packed = _delta_topk_sparse_jit(
+        emb, valid, jnp.asarray(np.ascontiguousarray(idx)),
+        jnp.asarray(np.ascontiguousarray(val)), k
+    )
+    packed.copy_to_host_async()
+    return packed
+
+
+def delta_topk_dense(q: jax.Array, v: jax.Array, n_valid: int, k: int) -> jax.Array:
+    """Dense-query delta dispatch (bench streaming arm). ``v`` must be
+    pre-padded to a _BLOCK multiple; ``q`` to a constant batch shape."""
+    packed = _delta_topk_dense_jit(q, v, jnp.asarray(n_valid, jnp.int32), k)
+    packed.copy_to_host_async()
+    return packed
+
+
+def unpack_topk(packed, b: int) -> Tuple[np.ndarray, np.ndarray]:
+    """(scores [b, k'], row-indices [b, k'] int64) from a packed buffer.
+    Indices are raw rows of the queried buffer (physical rows for a
+    sharded index — the caller maps them to logical slots)."""
+    host = np.asarray(packed)[:b]
+    kk = host.shape[1] // 2
+    return host[:, :kk], host[:, kk:].astype(np.int64)
+
+
+# ---------------------------------------------------------------------------
+# streaming cluster state (host)
+# ---------------------------------------------------------------------------
+
+
+class ClusterState:
+    """Streaming mirror of the union-kNN clustering graph.
+
+    Per-row state: the k best above-threshold neighbors seen so far
+    (ids + sims, evict-worst on overflow) plus optional pattern metadata
+    (failure type / id / apps). ``refresh()`` materializes labels by
+    running connected components over every stored edge plus the seeded
+    base partition, caches them, and tracks which clusters changed since
+    the last :meth:`pop_dirty` — the set ``mine_patterns`` re-emits.
+
+    ``stale`` latches when the state can no longer be trusted (failed
+    restore, attach fault, replay tail with unseen rows) — the owner
+    falls back to ONE full sweep and re-seeds via :meth:`seed`. Never
+    serve labels from a stale state.
+    """
+
+    _GROW = 1024
+
+    def __init__(self, threshold: float = 0.6, k: int = 32):
+        self.threshold = float(threshold)
+        self.k = int(k)
+        self._lock = threading.RLock()
+        self._n = 0
+        self._ids = np.full((0, self.k), -1, np.int64)
+        self._sims = np.full((0, self.k), -np.inf, np.float32)
+        # Base partition from the last full sweep / restore: rows
+        # [0, len) carry an implicit edge to their base label.
+        self._base = np.zeros(0, np.int32)
+        # Optional per-row pattern metadata (None for bench-style rows).
+        self._ftype: List[Optional[str]] = []
+        self._fid: List[Optional[str]] = []
+        self._apps: List[set] = []
+        self._touched: set = set()
+        self._dirty_labels: set = set()
+        self._cached_labels: Optional[np.ndarray] = None
+        self._prev_labels = np.zeros(0, np.int32)
+        self.stale = False
+        self.stale_reason: Optional[str] = None
+        self.attached = 0
+        self.evictions = 0
+        self.merges = 0
+
+    # --- mutation --------------------------------------------------------
+
+    @property
+    def n_rows(self) -> int:
+        return self._n
+
+    @property
+    def n_clusters(self) -> int:
+        with self._lock:
+            return int(len(np.unique(self._labels_locked())))
+
+    def mark_stale(self, reason: str) -> None:
+        with self._lock:
+            self.stale = True
+            self.stale_reason = reason
+
+    def _grow_to(self, n: int) -> None:
+        if n <= len(self._ids):
+            return
+        cap = max(n, len(self._ids) + self._GROW, 2 * len(self._ids))
+        ids = np.full((cap, self.k), -1, np.int64)
+        sims = np.full((cap, self.k), -np.inf, np.float32)
+        ids[: len(self._ids)] = self._ids
+        sims[: len(self._sims)] = self._sims
+        self._ids, self._sims = ids, sims
+
+    def add_row(
+        self,
+        slot: int,
+        failure_type: Optional[str] = None,
+        failure_id: Optional[str] = None,
+        apps: Iterable[str] = (),
+    ) -> None:
+        """Register a new slot. Slots must arrive in order (GFKB appends
+        them densely); a gap means the caller missed rows and the state
+        is no longer trustworthy."""
+        with self._lock:
+            if slot < self._n:
+                return  # idempotent re-add
+            if slot != self._n:
+                self.mark_stale(f"non-contiguous slot {slot} (have {self._n})")
+                return
+            self._grow_to(slot + 1)
+            self._n = slot + 1
+            self._ftype.append(failure_type)
+            self._fid.append(failure_id)
+            self._apps.append(set(apps))
+            self._touched.add(slot)
+            self._cached_labels = None
+
+    def note_apps(self, slot: int, apps: Iterable[str]) -> None:
+        """A version update widened a record's affected apps — membership
+        is unchanged, the cluster aggregate isn't."""
+        with self._lock:
+            if slot >= self._n:
+                return
+            new = set(apps) - self._apps[slot]
+            if new:
+                self._apps[slot] |= new
+                self._touched.add(slot)
+
+    def attach(self, slot: int, neigh: Sequence[int], sims: Sequence[float]) -> int:
+        """Record ``slot``'s above-threshold candidates (its delta top-k,
+        best-first): they become its neighbor list, and ``slot`` is
+        offered to each neighbor's list (replacing that list's worst
+        entry when better — the streaming analogue of the full sweep's
+        per-row degree cap). Returns edges stored."""
+        stored = 0
+        with self._lock:
+            if slot >= self._n:
+                return 0
+            ids, sims_a = self._ids, self._sims
+            row_i, row_s = ids[slot], sims_a[slot]
+            for j, s in zip(neigh, sims):
+                j = int(j)
+                s = float(s)
+                if j == slot or j < 0 or j >= self._n:
+                    continue
+                if not np.isfinite(s) or s < self.threshold:
+                    continue
+                # slot's own list (candidates arrive best-first)
+                w = int(np.argmin(row_s))
+                if s > row_s[w]:
+                    if row_i[w] >= 0:
+                        self.evictions += 1
+                    row_i[w], row_s[w] = j, s
+                    stored += 1
+                # reverse offer into j's list
+                nb_i, nb_s = ids[j], sims_a[j]
+                w = int(np.argmin(nb_s))
+                if s > nb_s[w]:
+                    if nb_i[w] >= 0:
+                        self.evictions += 1
+                        self._touched.add(j)
+                    nb_i[w], nb_s[w] = slot, s
+            self.attached += 1
+            self._touched.add(slot)
+            self._cached_labels = None
+        return stored
+
+    def seed(
+        self,
+        labels: np.ndarray,
+        meta: Optional[Sequence[Tuple[str, str, Iterable[str]]]] = None,
+        threshold: Optional[float] = None,
+    ) -> None:
+        """Reset the state from a full-sweep result: the labels become the
+        base partition (carried as edges), neighbor lists clear, dirty
+        clears — a full sweep just emitted everything."""
+        labels = np.asarray(labels, np.int32)
+        with self._lock:
+            n = len(labels)
+            self._n = n
+            self._ids = np.full((n, self.k), -1, np.int64)
+            self._sims = np.full((n, self.k), -np.inf, np.float32)
+            self._base = labels.copy()
+            self._ftype = [None] * n
+            self._fid = [None] * n
+            self._apps = [set() for _ in range(n)]
+            if meta is not None:
+                for i, (ftype, fid, apps) in enumerate(meta):
+                    self._ftype[i] = ftype
+                    self._fid[i] = fid
+                    self._apps[i] = set(apps)
+            self._touched = set()
+            self._dirty_labels = set()
+            self._prev_labels = labels.copy()
+            self._cached_labels = labels.copy()
+            if threshold is not None:
+                self.threshold = float(threshold)
+            self.stale = False
+            self.stale_reason = None
+
+    # --- refresh / read --------------------------------------------------
+
+    def _labels_locked(self) -> np.ndarray:
+        if self._cached_labels is not None:
+            return self._cached_labels
+        n = self._n
+        live = self._ids[:n]
+        mask = live >= 0
+        rows = np.broadcast_to(np.arange(n, dtype=np.int64)[:, None], live.shape)[mask]
+        cols = live[mask]
+        nb = len(self._base)
+        if nb:
+            rows = np.concatenate([rows, np.arange(nb, dtype=np.int64)])
+            cols = np.concatenate([cols, self._base.astype(np.int64)])
+        labels = _sparse_components(n, rows, cols)
+        # dirty = clusters holding any touched row, under BOTH the old and
+        # the new labeling (a merge dirties the surviving cluster; rows
+        # whose label flipped dirty their new home)
+        m = min(len(self._prev_labels), n)
+        changed = set(int(r) for r in self._touched if r < n)
+        if m:
+            changed.update(int(r) for r in np.flatnonzero(labels[:m] != self._prev_labels[:m]))
+            # clusters whose old root lost its identity merged into another
+            prev_roots = np.unique(self._prev_labels[:m])
+            self.merges += int(np.count_nonzero(labels[prev_roots] != prev_roots))
+        self._dirty_labels.update(int(labels[r]) for r in changed)
+        self._prev_labels = labels
+        self._touched = set()
+        self._cached_labels = labels
+        return labels
+
+    def labels(self) -> np.ndarray:
+        """Materialized int32 labels [n_rows], min-member convention —
+        byte-comparable with ``cluster_embeddings`` output. Cached until
+        the next mutation; the refresh is one vectorized
+        connected-components pass over O(N·k) edges, never a device
+        sweep."""
+        with self._lock:
+            return self._labels_locked().copy()
+
+    def pop_dirty(self) -> List[dict]:
+        """Aggregate snapshots (apps / type counts / failure ids / member
+        count) of every cluster touched since the last call; clears the
+        dirty set. Aggregates are built only for dirty clusters — O(dirty
+        members), not O(N)."""
+        with self._lock:
+            labels = self._labels_locked()
+            dirty = sorted(
+                d for d in self._dirty_labels if d < self._n and labels[d] == d
+            )
+            self._dirty_labels = set()
+            if not dirty:
+                return []
+            sel = np.flatnonzero(np.isin(labels, np.asarray(dirty, labels.dtype)))
+            groups: Dict[int, List[int]] = {}
+            for r in sel:
+                groups.setdefault(int(labels[r]), []).append(int(r))
+            out = []
+            for lbl in dirty:
+                members = groups.get(lbl)
+                if not members:
+                    continue
+                apps: set = set()
+                types: Dict[str, int] = {}
+                fids: set = set()
+                for r in members:
+                    apps |= self._apps[r]
+                    ft = self._ftype[r]
+                    if ft is not None:
+                        types[ft] = types.get(ft, 0) + 1
+                    if self._fid[r]:
+                        fids.add(self._fid[r])
+                out.append(
+                    {
+                        "label": lbl,
+                        "apps": sorted(apps),
+                        "types": types,
+                        "fids": sorted(fids),
+                        "n": len(members),
+                    }
+                )
+            return out
+
+    def n_clusters_cached(self) -> Optional[int]:
+        """Cluster count without forcing a refresh (None when labels are
+        not currently cached) — for cheap gauge updates on hot paths."""
+        with self._lock:
+            if self._cached_labels is None:
+                return None
+            return int(len(np.unique(self._cached_labels)))
+
+    def info(self) -> dict:
+        with self._lock:
+            return {
+                "rows": self._n,
+                "clusters": self.n_clusters_cached(),
+                "dirty": len(self._dirty_labels) + len(self._touched),
+                "attached": self.attached,
+                "evictions": self.evictions,
+                "merges": self.merges,
+                "stale": self.stale,
+                "stale_reason": self.stale_reason,
+                "threshold": self.threshold,
+                "k": self.k,
+            }

@@ -5,18 +5,26 @@ Parity tier mirrors the reference's reactor
 hallucination failure, group GFKB failures by type and upsert the named
 pattern once ≥2 apps are affected.
 
-Beyond parity, ``mine_patterns`` runs device-side clustering over the full
-GFKB embedding matrix (threshold cosine graph → connected components via
-iterative label propagation, kakveda_tpu.ops.clustering) and surfaces
-clusters that span multiple apps as discovered patterns — the batch job the
-reference never had.
+Beyond parity, ``mine_patterns`` surfaces clusters of similar failures
+spanning multiple apps as discovered patterns — the batch job the reference
+never had. It is INCREMENTAL by default: the GFKB streams every inserted
+row into a persistent union-find cluster state (ops/incremental.py), so a
+mine call drains pending deltas and re-emits only dirty clusters in
+milliseconds; the O(N²·d) device sweep (kakveda_tpu.ops.clustering) remains
+as ``mode="full"`` — the compaction/audit path, and the automatic fallback
+whenever the streaming state can't serve a call (threshold change, stale
+state, KAKVEDA_MINE_INCREMENTAL=0).
 """
 
 from __future__ import annotations
 
+import time
 from collections import defaultdict
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
+import numpy as np
+
+from kakveda_tpu.core import metrics as _metrics
 from kakveda_tpu.core.schemas import FailureSignal, PatternEntity
 from kakveda_tpu.index.gfkb import GFKB
 from kakveda_tpu.pipeline.classifier import HALLUCINATION_CITATION
@@ -30,6 +38,9 @@ class PatternDetector:
     def __init__(self, gfkb: GFKB, min_apps: int = 2):
         self.gfkb = gfkb
         self.min_apps = min_apps
+        self._m_sweeps = _metrics.get_registry().counter(
+            "kakveda_mine_sweeps_total", "Pattern-mining sweeps by mode", ("mode",)
+        )
 
     def on_failure(self, failure: FailureSignal) -> Optional[PatternEntity]:
         """Reactor invoked on every failure.detected event."""
@@ -64,15 +75,89 @@ class PatternDetector:
             out.append(pattern)
         return out
 
-    def mine_patterns(self, threshold: float = 0.6) -> List[PatternEntity]:
-        """Batch pattern mining over the whole GFKB via device clustering.
+    @staticmethod
+    def _pattern_fields(types_count: Dict[str, int], n_members: int):
+        """(name, description) from a cluster's failure-type counts —
+        shared by the full-sweep and incremental emission paths so both
+        produce byte-identical pattern records."""
+        types = sorted(types_count)
+        dominant = max(types, key=lambda t: types_count[t])
+        name = (
+            _CITATION_PATTERN_NAME
+            if dominant == HALLUCINATION_CITATION
+            else f"Recurring {dominant.lower().replace('_', ' ')}"
+        )
+        desc = f"Cluster of {n_members} similar failures ({', '.join(types)})"
+        return name, desc
 
-        Clusters canonical failures by embedding similarity; any cluster
-        whose members span ≥min_apps apps becomes (or refreshes) a pattern
-        named after its dominant failure type. (Member count is NOT a
-        criterion: identical signatures canonicalize into one record, so a
-        singleton cluster can represent a failure recurring across apps.)
+    def mine_patterns(
+        self, threshold: float = 0.6, mode: str = "auto"
+    ) -> List[PatternEntity]:
+        return self.mine_patterns_ex(threshold, mode)[0]
+
+    def mine_patterns_ex(
+        self, threshold: float = 0.6, mode: str = "auto"
+    ) -> Tuple[List[PatternEntity], dict]:
+        """Pattern mining over the GFKB; returns (patterns, freshness info).
+
+        ``mode``:
+          * ``"auto"`` (default) — incremental when the streaming cluster
+            state can serve this call (enabled, non-stale, covers every
+            record, same threshold): drain pending delta top-ks and
+            re-emit patterns ONLY for dirty clusters — milliseconds,
+            independent of corpus size. Otherwise one full sweep which
+            also re-seeds the incremental baseline.
+          * ``"full"`` — force the O(N²·d) device sweep (periodic audit /
+            threshold changes). Re-seeds the incremental state.
+          * ``"incremental"`` — like auto but reports (rather than hides)
+            the fallback reason when a full sweep was required.
+
+        Clusters whose members span ≥min_apps apps become (or refresh) a
+        pattern named after the dominant failure type; member count is NOT
+        a criterion (identical signatures canonicalize into one record, so
+        a singleton cluster can represent a cross-app recurrence).
         """
+        if mode not in ("auto", "full", "incremental"):
+            raise ValueError(f"unknown mine mode {mode!r} (auto|full|incremental)")
+        t0 = time.perf_counter()
+        if mode != "full" and self.gfkb.mine_usable(threshold):
+            out, info = self._mine_incremental()
+        else:
+            out, info = self._mine_full(threshold)
+            if mode == "incremental":
+                st = self.gfkb.mine_state_info()
+                info["fallback"] = (
+                    "disabled" if not st.get("enabled")
+                    else st.get("stale_reason") or "state not usable at this threshold"
+                )
+        self._m_sweeps.labels(mode=info["mode"]).inc()
+        info["wall_ms"] = round((time.perf_counter() - t0) * 1000.0, 3)
+        info.update(self.gfkb.mine_state_info())
+        return out, info
+
+    def _mine_incremental(self) -> Tuple[List[PatternEntity], dict]:
+        """Drain pending deltas, re-emit only dirty clusters — the
+        pattern log's delta-append semantics make this equivalent to a
+        full emission (clean clusters would no-op their upsert)."""
+        drained = self.gfkb.mine_drain()
+        dirty = self.gfkb.mine_pop_dirty()
+        out: List[PatternEntity] = []
+        for cl in dirty:
+            if len(cl["apps"]) < self.min_apps:
+                continue
+            name, desc = self._pattern_fields(cl["types"], cl["n"])
+            pattern, _ = self.gfkb.upsert_pattern(
+                name=name,
+                failure_ids=cl["fids"],
+                affected_apps=cl["apps"],
+                description=desc,
+            )
+            out.append(pattern)
+        return out, {"mode": "incremental", "drained": drained, "dirty_clusters": len(dirty)}
+
+    def _mine_full(self, threshold: float) -> Tuple[List[PatternEntity], dict]:
+        """The original whole-corpus device sweep; also re-seeds the
+        incremental baseline so later calls pay only for their deltas."""
         from kakveda_tpu.ops.clustering import cluster_embeddings
 
         # Reuse the device-resident index rows (one gather) instead of
@@ -82,7 +167,8 @@ class PatternDetector:
         # rows with records.
         records, vecs = self.gfkb.records_and_embeddings()
         if not records:
-            return []
+            self.gfkb.mine_reseed(np.zeros(0, np.int32), threshold, 0)
+            return [], {"mode": "full", "dirty_clusters": 0}
         labels = cluster_embeddings(vecs, threshold=threshold)
 
         groups: Dict[int, List[int]] = defaultdict(list)
@@ -99,18 +185,16 @@ class PatternDetector:
             # exactly the recurring cross-app failure a pattern describes.
             if len(apps) < self.min_apps:
                 continue
-            types = sorted({r.failure_type for r in recs})
-            dominant = max(types, key=lambda t: sum(1 for r in recs if r.failure_type == t))
-            name = (
-                _CITATION_PATTERN_NAME
-                if dominant == HALLUCINATION_CITATION
-                else f"Recurring {dominant.lower().replace('_', ' ')}"
-            )
+            types_count: Dict[str, int] = {}
+            for r in recs:
+                types_count[r.failure_type] = types_count.get(r.failure_type, 0) + 1
+            name, desc = self._pattern_fields(types_count, len(recs))
             pattern, _ = self.gfkb.upsert_pattern(
                 name=name,
                 failure_ids=sorted({r.failure_id for r in recs}),
                 affected_apps=apps,
-                description=f"Cluster of {len(recs)} similar failures ({', '.join(types)})",
+                description=desc,
             )
             out.append(pattern)
-        return out
+        self.gfkb.mine_reseed(labels, threshold, len(records))
+        return out, {"mode": "full", "dirty_clusters": len(groups)}

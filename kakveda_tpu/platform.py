@@ -222,6 +222,13 @@ class Platform:
     def patterns_list(self) -> List[PatternEntity]:
         return self.gfkb.list_patterns()
 
+    def mine(self, threshold: float = 0.6, mode: str = "auto"):
+        """Pattern mining with freshness info: incremental (drain the
+        streaming cluster state, re-emit dirty clusters) when possible,
+        full device sweep otherwise or on ``mode="full"``. Returns
+        (patterns, info) — see PatternDetector.mine_patterns_ex."""
+        return self.patterns.mine_patterns_ex(threshold, mode)
+
     def health_history(self, app_id: str, limit: int = 50) -> List[dict]:
         return self.health.history(app_id, limit)
 

@@ -273,9 +273,13 @@ def make_app(platform: Optional[Platform] = None, **platform_kw) -> web.Applicat
         return web.json_response({"ok": True, "path": str(path), "entries": plat.gfkb.count})
 
     async def mine_patterns(request):
-        """Batch pattern mining: device-side clustering over the full GFKB
-        embedding matrix (the batch job the reference never had). Body:
-        {"threshold": 0.6} optional."""
+        """Pattern mining over the GFKB. Body (all optional):
+        {"threshold": 0.6, "mode": "auto"|"full"|"incremental"}.
+        ``auto`` serves from the streaming cluster state when possible
+        (drain deltas, re-emit dirty clusters — milliseconds); ``full``
+        forces the whole-corpus device sweep (compaction/audit). The
+        response carries freshness fields: the mode actually used, rows
+        drained, dirty/total cluster counts, staleness and wall time."""
         try:
             body = await request.json()
         except Exception:  # noqa: BLE001 — empty body is fine
@@ -284,12 +288,19 @@ def make_app(platform: Optional[Platform] = None, **platform_kw) -> web.Applicat
             threshold = float(body.get("threshold", 0.6))
         except (TypeError, ValueError, AttributeError):
             return _json_error(422, "threshold must be a number")
+        mode = body.get("mode", "auto") if isinstance(body, dict) else "auto"
+        if mode not in ("auto", "full", "incremental"):
+            return _json_error(422, "mode must be auto|full|incremental")
         import asyncio as _asyncio
 
         loop = _asyncio.get_running_loop()
-        found = await loop.run_in_executor(None, plat.patterns.mine_patterns, threshold)
+        found, info = await loop.run_in_executor(None, plat.mine, threshold, mode)
         return web.json_response(
-            {"ok": True, "patterns": [p.model_dump(mode="json") for p in found]}
+            {
+                "ok": True,
+                "patterns": [p.model_dump(mode="json") for p in found],
+                "mining": info,
+            }
         )
 
     async def unsubscribe(request):

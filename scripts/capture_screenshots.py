@@ -16,6 +16,13 @@ Requires a Chrome/Chromium binary (``--chrome`` or $CHROME). The CI image
 this repo is developed in has no browser — run this wherever Chrome
 exists; the capture itself is fully automated (login + cookie handling
 included).
+
+Text-mode fallback (``--html``, VERDICT item 9): when no browser is
+reachable, render the same page set as SERVED HTML through the running
+server (login + session cookie over plain urllib) into
+``docs/screenshots/*.html`` — the dashboard's parity surface stays
+inspectable without Chrome. The PNG path remains the preferred artifact
+wherever a browser exists.
 """
 
 from __future__ import annotations
@@ -42,7 +49,7 @@ PAGES = [
     ("prompts", "/prompts", True),
     ("experiments", "/experiments", True),
     ("datasets", "/datasets", True),
-    ("health", "/health", True),
+    ("health", "/health-page", True),
     ("admin_rbac", "/admin/users", True),
     ("admin_serving", "/admin/serving", True),
 ]
@@ -73,6 +80,45 @@ def cdp(port: int, ws, method: str, params: dict, _id=[0]):
             return msg.get("result", {})
 
 
+def capture_html(args) -> int:
+    """Browser-free capture: log in with plain urllib (cookie jar), GET
+    each page and commit the served HTML. Pages that need a login are
+    fetched with the session cookie, exactly like the CDP path."""
+    import http.cookiejar
+    import urllib.parse
+
+    jar = http.cookiejar.CookieJar()
+    opener = urllib.request.build_opener(
+        urllib.request.HTTPCookieProcessor(jar)
+    )
+    out = Path(args.out)
+    out.mkdir(parents=True, exist_ok=True)
+
+    def fetch(path: str) -> str:
+        with opener.open(args.base + path, timeout=30) as r:
+            return r.read().decode("utf-8", errors="replace")
+
+    # anonymous login page first, then authenticate (302 sets the cookie)
+    (out / "login.html").write_text(fetch("/login"), encoding="utf-8")
+    print("captured login.html")
+    form = urllib.parse.urlencode(
+        {"email": args.email, "password": args.password, "next": "/"}
+    ).encode()
+    opener.open(args.base + "/login", data=form, timeout=30)
+    if not any(c for c in jar):
+        sys.exit("login did not set a session cookie — wrong credentials?")
+
+    for name, path, needs_login in PAGES:
+        if name == "login":
+            continue
+        try:
+            (out / f"{name}.html").write_text(fetch(path), encoding="utf-8")
+            print(f"captured {name}.html")
+        except Exception as e:  # noqa: BLE001 — capture the rest regardless
+            print(f"FAILED {name} ({path}): {e}", file=sys.stderr)
+    return 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--base", default="http://127.0.0.1:8110")
@@ -80,7 +126,14 @@ def main() -> int:
     ap.add_argument("--chrome", default=None)
     ap.add_argument("--email", default="admin@local")
     ap.add_argument("--password", default="admin123")
+    ap.add_argument(
+        "--html", action="store_true",
+        help="no-browser fallback: save served HTML instead of PNGs",
+    )
     args = ap.parse_args()
+
+    if args.html:
+        return capture_html(args)
 
     try:
         from websocket import create_connection  # websocket-client

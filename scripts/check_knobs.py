@@ -43,6 +43,10 @@ ALLOWLIST = frozenset({
 # dead-knob drift and fails.
 DOC_ONLY_ALLOWLIST = frozenset({
     "KAKVEDA_TEST_PLATFORM",  # tests/conftest.py: run the suite on real TPU
+    # tests/test_hf_integration.py: prompt/expectation for the real-weight
+    # integration test (tests/ is outside the code scan)
+    "KAKVEDA_HF_PROMPT",
+    "KAKVEDA_HF_EXPECT",
 })
 
 

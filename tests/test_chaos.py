@@ -337,8 +337,10 @@ def test_snapshot_checksum_corruption_degrades_to_full_replay(tmp_path):
     sd = g.snapshot()
     pre = g.match("intent:citations | doc 2 fabricated references")
     g.close()
+    from kakveda_tpu.index.gfkb import GFKB
+
     manifest = json.loads((sd / "manifest.json").read_text())
-    assert manifest["version"] == 3 and manifest["checksum"]
+    assert manifest["version"] == GFKB._SNAPSHOT_VERSION and manifest["checksum"]
 
     val = np.load(sd / "sparse_val.npy")
     np.save(sd / "sparse_val.npy", val + 1.0)  # same shape/dtype, wrong bytes

@@ -1,0 +1,69 @@
+"""Real-weights integration (VERDICT item 8): with ``KAKVEDA_HF_DIR``
+pointing at a local HF checkpoint directory, prove the whole chain —
+convert → serve through the shared engine → one greedy generation with
+the expected continuation — on any machine that has weights. Skipped
+(not failed) when no checkpoint is available: the CI image ships none.
+
+The hermetic half (no weights needed) pins the env wiring itself, so the
+documented knob can't silently stop being read.
+"""
+
+import os
+
+import pytest
+
+
+def test_from_env_reads_hf_dir(monkeypatch):
+    """KAKVEDA_HF_DIR routes from_env to the HF conversion path (alias of
+    KAKVEDA_HF_CKPT, which wins when both are set)."""
+    from kakveda_tpu.models.generate import LlamaRuntime
+
+    calls = []
+
+    @classmethod
+    def fake_from_hf(cls, path, *, mesh=None, quant=None):
+        calls.append((path, quant))
+        return "sentinel"
+
+    monkeypatch.setattr(LlamaRuntime, "from_hf", fake_from_hf)
+    monkeypatch.delenv("KAKVEDA_HF_CKPT", raising=False)
+    monkeypatch.setenv("KAKVEDA_HF_DIR", "/ckpts/some-model")
+    assert LlamaRuntime.from_env() == "sentinel"
+    assert calls == [("/ckpts/some-model", None)]
+
+    monkeypatch.setenv("KAKVEDA_HF_CKPT", "/ckpts/other-model")
+    LlamaRuntime.from_env()
+    assert calls[-1][0] == "/ckpts/other-model"  # explicit ckpt wins
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(
+    not os.environ.get("KAKVEDA_HF_DIR"),
+    reason="KAKVEDA_HF_DIR not set (needs a local HF checkpoint directory)",
+)
+def test_hf_dir_convert_serve_greedy_continuation():
+    """convert → serve → greedy generation with an expected continuation.
+
+    Any real language model completes the pangram; the engine path must
+    also agree token-for-token with the offline fused decode (greedy
+    parity — the Ollama-parity claim, proven on real weights)."""
+    from kakveda_tpu.models.generate import LlamaRuntime, generate_tokens_fused
+
+    rt = LlamaRuntime.from_env()
+    prompt = os.environ.get(
+        "KAKVEDA_HF_PROMPT", "The quick brown fox jumps over the lazy"
+    )
+    expect = os.environ.get("KAKVEDA_HF_EXPECT", "dog")
+
+    res = rt.generate(prompt, max_tokens=8)
+    assert res.meta["provider"] == "tpu"
+    assert expect.lower() in res.text.lower(), (
+        f"greedy continuation {res.text!r} does not contain {expect!r} — "
+        "conversion or decode is wrong for this checkpoint"
+    )
+
+    # Engine (continuous batching) vs offline fused decode: same tokens.
+    ids = rt.tokenizer.encode(prompt)
+    offline = generate_tokens_fused(rt.params, rt.cfg, [ids], max_new_tokens=8)[0]
+    offline_text = rt.tokenizer.decode(offline)
+    assert res.text == offline_text, "engine decode diverged from offline greedy"

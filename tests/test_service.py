@@ -256,6 +256,17 @@ def test_patterns_mine_endpoint(tmp_path):
             assert body["ok"]
             names = [p["name"] for p in body["patterns"]]
             assert any("itation" in n for n in names), names
+            # freshness fields: first call at a non-default threshold is a
+            # full sweep that re-seeds the incremental baseline...
+            assert body["mining"]["mode"] == "full"
+            assert body["mining"]["wall_ms"] >= 0
+            # ...so the second call is served from the streaming state.
+            r = await c.post("/patterns/mine", json={"threshold": 0.5})
+            assert (await r.json())["mining"]["mode"] == "incremental"
+            r = await c.post(
+                "/patterns/mine", json={"threshold": 0.5, "mode": "bogus"}
+            )
+            assert r.status == 422
         finally:
             await c.close()
 
