@@ -26,7 +26,13 @@ Design (mirrors the metrics plane's resolve-once pattern):
   the same /metrics plane as the recovery they exercise.
 
 The fault-site catalog lives in docs/robustness.md; adding a site means
-adding it there.
+adding it there — scripts/check_knobs.py (tier-1) fails when a ``site("…")``
+registered in code is missing from that catalog. ``device.unavailable`` is
+the one deliberately *shared* site: GFKB match dispatch and the
+device-health recovery probe resolve the same object, so arming it
+simulates a whole-chip outage (warn falls back to the host index,
+generation fails fast) and DISARMING it is what lets the probe un-latch —
+the same shape as a real outage ending (core/admission.py).
 """
 
 from __future__ import annotations

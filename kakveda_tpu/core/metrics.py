@@ -435,6 +435,34 @@ _CORE_FAMILIES = (
      "short-circuited", (), None),
     ("counter", "kakveda_faults_injected_total",
      "Injected faults by site (KAKVEDA_FAULTS chaos harness)", ("site",), None),
+    ("gauge", "kakveda_admission_inflight",
+     "In-flight (admitted, not yet released) requests per admission class",
+     ("klass",), None),
+    ("counter", "kakveda_admission_admitted_total",
+     "Requests admitted per admission class", ("klass",), None),
+    ("counter", "kakveda_admission_shed_total",
+     "Requests shed by admission control, by class and reason "
+     "(queue_full|brownout|deadline|degraded|ratelimit)",
+     ("klass", "reason"), None),
+    ("histogram", "kakveda_admission_wait_seconds",
+     "Observed downstream queue wait per admission class (feeds "
+     "deadline-aware shedding)", ("klass",), None),
+    ("gauge", "kakveda_brownout_state",
+     "1 on the brownout ladder's current step "
+     "(normal|no_spec|clamped|shed_background|shed_interactive)",
+     ("state",), None),
+    ("counter", "kakveda_brownout_transitions_total",
+     "Brownout ladder step transitions", ("from", "to"), None),
+    ("gauge", "kakveda_device_degraded",
+     "1 while the accelerator backend is latched DEGRADED (device-loss "
+     "mode: host-fallback warn, fail-fast generation)", (), None),
+    ("counter", "kakveda_device_degraded_transitions_total",
+     "Degraded-mode latch transitions", ("to",), None),
+    ("counter", "kakveda_device_probe_total",
+     "Backend recovery-probe attempts by result", ("result",), None),
+    ("counter", "kakveda_warn_fallback_total",
+     "Warn verdicts served by the host-side fallback index while the "
+     "backend is degraded", (), None),
     ("gauge", "kakveda_microbatch_queue_depth",
      "Requests waiting in a micro-batcher queue", ("batcher",), None),
     ("histogram", "kakveda_microbatch_batch_size",

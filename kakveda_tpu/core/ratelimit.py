@@ -89,3 +89,50 @@ class RateLimiter:
             loop = asyncio.get_running_loop()
             return await loop.run_in_executor(None, self.allow, key, limit, window_s)
         return self.allow(key, limit, window_s)
+
+
+class TokenBucket:
+    """Per-key token bucket — the smooth-rate tier the ingest and
+    playground routes use (``KAKVEDA_RATELIMIT_RPS``).
+
+    The fixed-window :class:`RateLimiter` above admits a full window's
+    burst at the window edge; a token bucket refills continuously (``rps``
+    tokens/second up to ``burst``), so a client that exceeds its rate is
+    told exactly how long until the next token — the ``retry_after``
+    second element of :meth:`allow`, which the HTTP tier echoes as a 429
+    ``Retry-After`` header in the same shape the admission controller
+    sheds with (docs/robustness.md). In-memory only by design: per-client
+    smoothing is a node-local concern; cross-fleet quotas stay on the
+    Redis fixed-window tier.
+    """
+
+    _SWEEP_EVERY = 1024
+
+    def __init__(self, rps: float, burst: Optional[float] = None):
+        if rps <= 0:
+            raise ValueError(f"rps must be positive, got {rps}")
+        self.rps = float(rps)
+        self.burst = float(burst) if burst is not None else max(1.0, 2.0 * rps)
+        self._buckets: Dict[str, Tuple[float, float]] = {}  # key -> (tokens, last_ts)
+        self._calls = 0
+
+    def allow(self, key: str, now: Optional[float] = None) -> Tuple[bool, float]:
+        """(admitted, retry_after_s). ``retry_after`` is 0 when admitted,
+        else the time until one full token has refilled."""
+        if now is None:
+            now = time.monotonic()
+        self._calls += 1
+        if self._calls % self._SWEEP_EVERY == 0:
+            # Drop keys whose bucket has fully refilled — idle clients
+            # (IP-derived keys on unauthenticated routes) must not leak.
+            full_age = self.burst / self.rps
+            self._buckets = {
+                k: v for k, v in self._buckets.items() if now - v[1] < full_age
+            }
+        tokens, last = self._buckets.get(key, (self.burst, now))
+        tokens = min(self.burst, tokens + (now - last) * self.rps)
+        if tokens >= 1.0:
+            self._buckets[key] = (tokens - 1.0, now)
+            return True, 0.0
+        self._buckets[key] = (tokens, now)
+        return False, (1.0 - tokens) / self.rps

@@ -144,6 +144,10 @@ class WarningResponse(BaseModel):
     pattern_id: Optional[str] = None
     references: List[FailureMatch] = Field(default_factory=list)
     message: str
+    # True when the verdict was served by the host-side fallback index
+    # because the accelerator backend is latched DEGRADED (device-loss
+    # mode, docs/robustness.md) — still a real verdict, just slower.
+    degraded: bool = False
 
 
 class HealthPoint(BaseModel):

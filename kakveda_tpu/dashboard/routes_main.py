@@ -14,6 +14,8 @@ from aiohttp import web
 
 import asyncio
 
+from kakveda_tpu.core import admission as _admission
+from kakveda_tpu.core.admission import DeviceUnavailableError, OverloadError
 from kakveda_tpu.core.schemas import TracePayload, WarningRequest
 from kakveda_tpu.dashboard.core import CTX_KEY, require_login, require_roles
 from kakveda_tpu.dashboard.db import new_trace_id
@@ -25,6 +27,44 @@ async def off_loop(fn, *args, **kwargs):
     can't stall the shared event loop serving /warn and /healthz."""
     loop = asyncio.get_running_loop()
     return await loop.run_in_executor(None, lambda: fn(*args, **kwargs))
+
+
+# Optional per-client token bucket on the playground routes
+# (KAKVEDA_RATELIMIT_RPS, same shape as the service /ingest limiter:
+# 429 + Retry-After). Lazy module-level singleton — the env is read once,
+# like every other serving lever.
+_PLAYGROUND_BUCKET = None
+_PLAYGROUND_BUCKET_INIT = False
+
+
+def _playground_ratelimit(request) -> None:
+    global _PLAYGROUND_BUCKET, _PLAYGROUND_BUCKET_INIT
+    if not _PLAYGROUND_BUCKET_INIT:
+        _PLAYGROUND_BUCKET_INIT = True
+        rps = float(os.environ.get("KAKVEDA_RATELIMIT_RPS", "0") or 0)
+        if rps > 0:
+            from kakveda_tpu.core.ratelimit import TokenBucket
+
+            burst = os.environ.get("KAKVEDA_RATELIMIT_BURST")
+            _PLAYGROUND_BUCKET = TokenBucket(rps, float(burst) if burst else None)
+    if _PLAYGROUND_BUCKET is None:
+        return
+    ok, ra = _PLAYGROUND_BUCKET.allow(request.remote or "anon")
+    if not ok:
+        _admission.get_admission().note_shed("interactive", "ratelimit", retry_after=ra)
+        raise OverloadError(
+            "per-client rate limit exceeded", retry_after=ra,
+            klass="interactive", reason="ratelimit",
+        )
+
+
+def _retry_after_http(e) -> "web.HTTPException":
+    """Map a typed shed/degraded error to the playground's HTTP answer:
+    429 (overload) or 503 (device loss), both with Retry-After."""
+    headers = {"Retry-After": str(max(1, int(round(e.retry_after))))}
+    if isinstance(e, OverloadError):
+        return web.HTTPTooManyRequests(text=str(e), headers=headers)
+    return web.HTTPServiceUnavailable(text=str(e), headers=headers)
 
 TOKEN_PRICE_MICRO_USD_IN = 15  # per 1k tokens — env-tunable in the runtime config
 TOKEN_PRICE_MICRO_USD_OUT = 75
@@ -595,6 +635,7 @@ def setup(app: web.Application) -> None:
         prompt = str(form.get("prompt") or "")
         if not prompt:
             raise web.HTTPBadRequest(text="prompt required")
+        _playground_ratelimit(request)
         chosen_target = str(form.get("target") or "model")
         chosen = (
             chosen_target.split(":", 1)[1] if chosen_target.startswith("model:") else None
@@ -642,6 +683,18 @@ def setup(app: web.Application) -> None:
                     parts.append(gen.text)
                     loop.call_soon_threadsafe(ch.put_nowait, ("delta", gen.text))
                 loop.call_soon_threadsafe(ch.put_nowait, ("done", "".join(parts)))
+            except (OverloadError, DeviceUnavailableError) as e:
+                # Shed/brownout/degraded rejection: the terminal error
+                # frame carries the RETRY HINT so an EventSource client
+                # can back off and resubmit instead of guessing.
+                loop.call_soon_threadsafe(
+                    ch.put_nowait,
+                    ("error", {
+                        "error": f"{type(e).__name__}: {e}",
+                        "retry_after": round(e.retry_after, 2),
+                        "retryable": True,
+                    }),
+                )
             except Exception as e:  # noqa: BLE001 — surface in-stream, not a 500 mid-SSE
                 loop.call_soon_threadsafe(ch.put_nowait, ("error", f"{type(e).__name__}: {e}"))
 
@@ -676,14 +729,16 @@ def setup(app: web.Application) -> None:
                     )
                 elif kind == "error":
                     # Terminal error frame (engine died mid-stream, model
-                    # raised): a typed `event: error` so EventSource
-                    # clients get an addressable event, plus the error in
-                    # the data payload for raw line parsers — then the
-                    # stream CLOSES instead of going silent until the
-                    # client times out.
+                    # raised, request shed by admission/brownout): a typed
+                    # `event: error` so EventSource clients get an
+                    # addressable event, plus the error in the data
+                    # payload for raw line parsers — then the stream
+                    # CLOSES instead of going silent until the client
+                    # times out. Shed payloads arrive as dicts carrying
+                    # the retry_after hint; plain failures as strings.
+                    body = payload if isinstance(payload, dict) else {"error": payload}
                     await resp.write(
-                        b"event: error\ndata: "
-                        + json.dumps({"error": payload}).encode() + b"\n\n"
+                        b"event: error\ndata: " + json.dumps(body).encode() + b"\n\n"
                     )
                     break
                 else:
@@ -735,6 +790,7 @@ def setup(app: web.Application) -> None:
         experiment = str(form.get("experiment") or "")
         if not prompt:
             raise web.HTTPBadRequest(text="prompt required")
+        _playground_ratelimit(request)
         trace_id = new_trace_id()
         t0 = time.time()
         if target.startswith("agent:"):
@@ -765,6 +821,11 @@ def setup(app: web.Application) -> None:
             try:
                 gen = await off_loop(lambda: ctx.model.generate(prompt, model=chosen))
                 text, meta = gen.text, gen.meta
+            except (OverloadError, DeviceUnavailableError) as e:
+                # Shed by admission/brownout (429) or device-loss degraded
+                # mode (503): retryable by contract, Retry-After attached
+                # — never rendered as a fake model answer.
+                raise _retry_after_http(e)
             except UnknownModelError as e:
                 # Stale/hand-crafted model label (multi-model runtimes
                 # reject unknown labels): surface in the UI, not a 500.

@@ -164,6 +164,31 @@ class GFKB:
         self._fault_append = _faults.site("gfkb.append")
         self._fault_snapshot = _faults.site("gfkb.snapshot")
         self._fault_mine = _faults.site("gfkb.mine_state")
+        # Device-loss drill site, SHARED with the device-health probe
+        # (core/admission.py): armed, every match dispatch fails exactly
+        # like a wedged backend — and the probe keeps failing until it is
+        # disarmed, which is what un-latches degraded mode.
+        self._fault_device = _faults.site("device.unavailable")
+
+        # Device-loss degraded mode: a host-side mirror of every row's
+        # sparse (idx, val) embedding, kept slot-aligned so the warn path
+        # can still answer "has this failed before?" with a numpy cosine
+        # top-k when the chip is gone (match_batch_host). ~100s of bytes
+        # per row (hashed-ngram rows are ~98% zeros). The inverted index
+        # over the mirror is built lazily on the FIRST degraded query and
+        # extended incrementally as rows land. KAKVEDA_HOST_FALLBACK=0
+        # opts out (no mirror, no fallback — degraded warn then errors).
+        self._host_fallback = os.environ.get("KAKVEDA_HOST_FALLBACK", "1") != "0"
+        self._host_rows: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}
+        # feature idx -> ([slots], [vals]) lists; covered slot count rides
+        # alongside so incremental extension knows where to resume.
+        self._host_index: Optional[dict] = None
+        self._host_index_n = 0
+        self._m_warn_fallback = _metrics.get_registry().counter(
+            "kakveda_warn_fallback_total",
+            "Warn verdicts served by the host-side fallback index while "
+            "the backend is degraded",
+        )
 
         # Incremental mining state (KAKVEDA_MINE_INCREMENTAL=0 restores
         # the full-sweep-only behavior bit-for-bit: no state, no cache, no
@@ -661,6 +686,7 @@ class GFKB:
         for i in range(0, len(slots), chunk):
             j = min(i + chunk, len(slots))
             sp_i, sp_v = sparsify(i, j)
+            self._store_host_rows(slots[i:j], sp_i, sp_v)
             self._emb, self._valid, self._types = self._knn.insert_sparse(
                 self._emb, self._valid, self._types, sp_i, sp_v, slots[i:j], tids[i:j]
             )
@@ -703,6 +729,9 @@ class GFKB:
             # The rewrite replaced the files; any torn-tail truncation
             # scheduled against the OLD files must not fire on the new ones.
             self._truncate_pending = {}
+            self._host_rows = {}
+            self._host_index = None
+            self._host_index_n = 0
             if self._mine is not None:
                 from kakveda_tpu.ops.incremental import ClusterState
 
@@ -1043,6 +1072,11 @@ class GFKB:
         finally block releases snapshot()/records_and_embeddings() waiters."""
         try:
             if len(self._records) > self._knn.capacity:
+                if self._host_fallback:
+                    h_idx, h_val = self.featurizer.encode_batch_sparse(texts)
+                    with self._lock:
+                        if self._generation == gen:
+                            self._store_host_rows(np.asarray(slots), h_idx, h_val)
                 self._grow_and_reembed()
                 self._mine_attach_new(slots, texts, None, None, gen)
                 return
@@ -1056,6 +1090,9 @@ class GFKB:
             with self._lock:
                 if self._generation != gen:
                     return  # reloaded since append; replay covered these rows
+                # Host mirror first: a device scatter that dies on a wedged
+                # backend must still leave degraded-mode matching complete.
+                self._store_host_rows(arr_slots, sp_idx, sp_val)
                 if len(self._records) > self._knn.capacity:
                     need_growth = True
                 else:
@@ -1273,6 +1310,113 @@ class GFKB:
             self._embeds_cv.wait(timeout=30.0)
 
     # ------------------------------------------------------------------
+    # host fallback (device-loss degraded mode)
+    # ------------------------------------------------------------------
+
+    def _store_host_rows(self, slots, sp_idx: np.ndarray, sp_val: np.ndarray) -> None:
+        """Mirror freshly embedded rows on host (sparse, trimmed of the
+        pad sentinel) so degraded-mode matching has something to read.
+        Rows land BEFORE the device scatter, so a scatter that dies on a
+        wedged backend still leaves the host mirror complete."""
+        if not self._host_fallback:
+            return
+        dim = self.featurizer.dim
+        for r, slot in enumerate(np.asarray(slots).tolist()):
+            keep = sp_idx[r] < dim  # pad idx == dim (the scatter drop sentinel)
+            self._host_rows[int(slot)] = (
+                sp_idx[r][keep].astype(np.int32, copy=True),
+                sp_val[r][keep].astype(np.float32, copy=True),
+            )
+
+    def _host_index_extend_locked(self) -> Optional[dict]:
+        """Build/extend the inverted index over the host mirror (call with
+        the data lock held). Incremental: only slots past the covered
+        watermark are folded in, so steady-state degraded queries pay
+        O(new rows), not O(N), per call."""
+        if not self._host_fallback:
+            return None
+        n = len(self._records)
+        if self._host_index is None:
+            self._host_index = {}
+            self._host_index_n = 0
+        idx = self._host_index
+        slot = self._host_index_n
+        while slot < n:
+            row = self._host_rows.get(slot)
+            if row is None:
+                # Embed still pending for this slot: stop here so the
+                # watermark never advances past an unmirrored row (it
+                # would otherwise be invisible to every later query).
+                break
+            for f, v in zip(row[0].tolist(), row[1].tolist()):
+                ent = idx.get(f)
+                if ent is None:
+                    ent = idx[f] = ([], [])
+                ent[0].append(slot)
+                ent[1].append(v)
+            slot += 1
+        self._host_index_n = slot
+        return idx
+
+    def match_batch_host(
+        self,
+        signature_texts: Sequence[str],
+        failure_type: Optional[str] = None,
+    ) -> List[List[FailureMatch]]:
+        """Degraded-mode top-k: numpy cosine over the host sparse mirror —
+        no device touch anywhere. Rows and queries are L2-normalized by
+        the featurizer, so the sparse dot IS the cosine score; scoring is
+        one inverted-index walk per query (O(query nnz · postings)).
+        Slower than the compiled device path but ALIVE, which is the whole
+        contract of degraded mode. ``failure_type`` keeps the default
+        post-truncation filter semantics of :meth:`match_batch`."""
+        if not self._host_fallback:
+            raise RuntimeError(
+                "host fallback disabled (KAKVEDA_HOST_FALLBACK=0)"
+            )
+        q_idx, q_val = self.featurizer.encode_batch_sparse(list(signature_texts))
+        dim = self.featurizer.dim
+        with self._lock:
+            records = self._records
+            n = len(records)
+            if n == 0:
+                return [[] for _ in signature_texts]
+            inv = self._host_index_extend_locked()
+            scores_rows = []
+            for r in range(q_idx.shape[0]):
+                scores = np.zeros(n, np.float32)
+                keep = q_idx[r] < dim
+                for f, v in zip(q_idx[r][keep].tolist(), q_val[r][keep].tolist()):
+                    ent = inv.get(f)
+                    if ent is not None:
+                        scores[np.asarray(ent[0])] += v * np.asarray(ent[1], np.float32)
+                scores_rows.append(scores)
+            self._m_warn_fallback.inc(len(signature_texts))
+        out: List[List[FailureMatch]] = []
+        k = self.top_k
+        for scores in scores_rows:
+            order = np.argsort(-scores)[: max(k, 1)]
+            row: List[FailureMatch] = []
+            for slot in order.tolist():
+                s = float(scores[slot])
+                if s <= 0.0:
+                    continue
+                rec = records[slot]
+                if failure_type and rec.failure_type != failure_type:
+                    continue
+                row.append(
+                    FailureMatch(
+                        failure_id=rec.failure_id,
+                        version=rec.version,
+                        score=min(1.0, max(-1.0, s)),
+                        failure_type=rec.failure_type,
+                        suggested_mitigation=rec.resolution,
+                    )
+                )
+            out.append(row)
+        return out
+
+    # ------------------------------------------------------------------
     # match
     # ------------------------------------------------------------------
 
@@ -1330,6 +1474,10 @@ class GFKB:
                 if tid is None:
                     return [[] for _ in signature_texts]
             with profiling.annotate("gfkb.match.dispatch"):
+                # Device-loss drill point: armed, the dispatch dies the way
+                # a wedged backend does, and the warn path's degraded-mode
+                # fallback (WarningPolicy → match_batch_host) takes over.
+                self._fault_device.fire()
                 if tid is not None:
                     valid = knn.mask_valid(valid, types, tid)
                 packed = knn.topk_async_sparse(emb, valid, q_idx, q_val)

@@ -763,6 +763,13 @@ class LlamaRuntime:
         """Batched generation: one decode stream for the whole list, exact
         per-sequence parity with generate()."""
         started = time.perf_counter()
+        # Device-loss fail-fast: while the backend is latched DEGRADED,
+        # every decode path (engine AND solo) would dispatch into a wedged
+        # chip and hang — raise the typed retryable error in microseconds
+        # instead (shed-never-hang, docs/robustness.md).
+        from kakveda_tpu.core import admission as _admission
+
+        _admission.get_device_health().check()
         ids = [self.tokenizer.encode(p)[-self.cfg.max_seq_len // 2 :] for p in prompts]
         from kakveda_tpu.core import profiling
 
@@ -841,6 +848,9 @@ class LlamaRuntime:
         queued or mid-prefill cancels promptly, not only after its first
         token arrives). Closing the generator has the same effect.
         """
+        from kakveda_tpu.core import admission as _admission
+
+        _admission.get_device_health().check()  # degraded: fail fast, never hang
         ids = self.tokenizer.encode(prompt)[-self.cfg.max_seq_len // 2 :]
 
         def deltas(all_ids: list, done: bool, prev: str) -> tuple:
@@ -925,6 +935,9 @@ class LlamaRuntime:
 
     def generate(self, prompt: str, *, model: Optional[str] = None, max_tokens: int = 64) -> GenerateResult:
         started = time.perf_counter()
+        from kakveda_tpu.core import admission as _admission
+
+        _admission.get_device_health().check()  # degraded: fail fast, never hang
         ids = self.tokenizer.encode(prompt)[-self.cfg.max_seq_len // 2 :]
         from kakveda_tpu.core import profiling
 

@@ -68,8 +68,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from kakveda_tpu.core import admission as _admission
 from kakveda_tpu.core import faults as _faults
 from kakveda_tpu.core import metrics as _metrics
+from kakveda_tpu.core.admission import DeviceUnavailableError, OverloadError
 from kakveda_tpu.models.llama import (
     LlamaConfig,
     Params,
@@ -1255,13 +1257,18 @@ class ContinuousBatcher:
         pool run plain so break-even is measured, not assumed), and every
         active slot greedy. THE predicate for both step() and the engine
         loop (which needs it separately to drain its pipelined handle
-        before switching chunk flavors)."""
+        before switching chunk flavors). The brownout ladder's FIRST step
+        (core/admission.py) vetoes speculation here — under pressure the
+        verify-width FLOPs go back to plain decode; the gate's own state
+        machine is untouched, so stepping back down resumes where the
+        gate left off."""
         return bool(
             self.spec_k
             and self.slots
             and self.spec_stats["gate_state"] != "off"
             and len(self._plain_walls) >= self._gate_calib
             and all(self._temp_np[s] <= 0.0 for s in self.slots)
+            and _admission.get_admission().brownout.spec_allowed()
         )
 
     def step(self) -> List[int]:
@@ -1394,6 +1401,11 @@ class ServingEngine:
                 "crash (bounded by KAKVEDA_SERVE_RESTARTS)", ("engine",),
             ).labels(**el),
         }
+        # Overload protection (core/admission.py): the submit-side backlog
+        # bound. Past it, submit() SHEDS with a typed OverloadError instead
+        # of growing a queue nobody will drain before callers time out —
+        # the HTTP tier surfaces it as 429 + Retry-After.
+        self._admit_queue = int(os.environ.get("KAKVEDA_ADMIT_QUEUE", "64"))
         # Generation items: (ids, max_new, temp, on_tokens, t_submit,
         # deadline_abs_or_None, fut); control items: ("cancel"|"prefix", …, fut).
         self._q: "queue.Queue[tuple]" = queue.Queue()
@@ -1474,6 +1486,7 @@ class ServingEngine:
         temperature: float = 0.0,
         on_tokens=None,
         deadline_s: Optional[float] = None,
+        klass: str = "interactive",
     ) -> Future:
         """Enqueue a request; the Future resolves to the generated id list.
 
@@ -1485,7 +1498,47 @@ class ServingEngine:
         past it, the request retires at the next chunk boundary through
         the cancel_request done-flag path (safe under pipelining) and its
         Future fails with :class:`DeadlineExceededError` carrying the
-        partial tokens."""
+        partial tokens.
+
+        ``klass`` is the admission class (``interactive`` default,
+        ``background`` for batch/eval work). Overload protection runs
+        BEFORE anything enqueues: a degraded backend fails fast with
+        :class:`DeviceUnavailableError`; the brownout ladder may shed the
+        class outright or clamp ``max_new_tokens``; a backlog past
+        ``KAKVEDA_ADMIT_QUEUE`` sheds with :class:`OverloadError`; and a
+        ``deadline_s`` the live queue-wait history says cannot be met is
+        rejected NOW instead of burning a slot and expiring anyway.
+        Neither error is a RuntimeError — shed work must surface as 429,
+        never silently take the solo-decode fallback path."""
+        _admission.get_device_health().check()
+        adm = _admission.get_admission()
+        if adm.enabled:
+            if adm.brownout.class_shed(klass):
+                self._m_requests.labels(engine=self.name, outcome="shed").inc()
+                adm.shed(klass, "brownout")
+            with self._submit_lock:
+                backlog = self._q.qsize() + len(self._waiting)
+            if backlog >= self._admit_queue:
+                self._m_requests.labels(engine=self.name, outcome="shed").inc()
+                adm.shed(
+                    klass, "queue_full",
+                    detail=f"engine backlog {backlog} >= {self._admit_queue}",
+                )
+            if deadline_s is not None and backlog > 0:
+                # Deadline-aware shed: only with a LIVE backlog — an empty
+                # queue means the wait history describes some past storm,
+                # not this request's fate.
+                predicted = adm.predicted_wait(klass)
+                if predicted > deadline_s:
+                    self._m_requests.labels(engine=self.name, outcome="shed").inc()
+                    adm.shed(
+                        klass, "deadline",
+                        detail=f"predicted queue wait {predicted:.2f}s exceeds "
+                               f"deadline {deadline_s:.2f}s",
+                    )
+            cap = adm.brownout.token_cap()
+            if cap is not None:
+                max_new_tokens = min(max_new_tokens, cap)
         with self._submit_lock:
             # Atomic with close()'s drain: without the lock a put landing
             # between close()'s _closed.set() and its queue drain would
@@ -1727,6 +1780,10 @@ class ServingEngine:
             return
         t_admit = time.perf_counter()
         self._mx["queue_wait"].observe(t_admit - t_submit)
+        # Feed the admission controller's live queue-wait history — the
+        # input deadline-aware shedding reads (submit rejects a deadline
+        # the observed waits say cannot be met).
+        _admission.get_admission().note_wait("interactive", t_admit - t_submit)
         # Lifecycle tracking rides the slot's own streaming callback: the
         # wrapper sees each chunk's accepted tokens on the loop thread
         # (TTFT + token counts with no extra bookkeeping in the batcher),
@@ -1779,6 +1836,12 @@ class ServingEngine:
                 # of a stochastic 500 is one log line / one /flightrecorder
                 # fetch, not log archaeology.
                 self._mx["errors"].inc()
+                # Real backend-error detection: a loop death whose cause
+                # looks like the chip going away (vs a software bug or an
+                # injected engine.* fault) latches device-loss DEGRADED
+                # mode — generation fails fast from then on and the probe
+                # owns recovery (core/admission.py).
+                _admission.get_device_health().note_failure(e, where="engine.loop")
                 if self.recorder is not None:
                     self.recorder.record(
                         "engine_error", error=f"{type(e).__name__}: {e}"

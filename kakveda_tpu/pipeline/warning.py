@@ -52,7 +52,29 @@ class WarningPolicy:
         default_action = self.config.default_action()
 
         sigs = [signature_text(r.prompt, r.tools, r.env) for r in reqs]
-        all_matches = self.gfkb.match_batch(sigs)
+        # Device-loss degraded mode (core/admission.py): while the backend
+        # is latched DEGRADED we never even dispatch (a wedged chip hangs,
+        # it doesn't error) — the host-side numpy cosine over the GFKB's
+        # sparse mirror answers instead, flagged `degraded=true`. A fresh
+        # backend failure here latches the mode and takes the same
+        # fallback, so the request that DISCOVERS the outage still gets a
+        # verdict. The pre-flight check is the product; it must not die
+        # with the chip.
+        from kakveda_tpu.core import admission as _admission
+
+        health = _admission.get_device_health()
+        degraded = False
+        if health.degraded:
+            all_matches = self.gfkb.match_batch_host(sigs)
+            degraded = True
+        else:
+            try:
+                all_matches = self.gfkb.match_batch(sigs)
+            except Exception as e:  # noqa: BLE001 — classify, maybe degrade
+                if not health.note_failure(e, where="gfkb.match"):
+                    raise  # a real software bug, not a device loss
+                all_matches = self.gfkb.match_batch_host(sigs)
+                degraded = True
         self._m_batch.observe(time.perf_counter() - t0)
         patterns = self.gfkb.list_patterns()
 
@@ -80,6 +102,7 @@ class WarningPolicy:
                             f"(failure_id={best.failure_id}, similarity={score:.2f}). "
                             f"Suggested mitigation: {best.suggested_mitigation or 'n/a'}"
                         ),
+                        degraded=degraded,
                     )
                 )
             else:
@@ -90,6 +113,7 @@ class WarningPolicy:
                         pattern_id=pattern_id,
                         references=[],
                         message="No high-similarity match found in GFKB.",
+                        degraded=degraded,
                     )
                 )
         for r in out:

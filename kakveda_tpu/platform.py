@@ -137,25 +137,38 @@ class Platform:
     async def _classify_and_record(self, traces: Sequence[TracePayload]) -> List[FailureSignal]:
         t0 = time.perf_counter()
         self._m_traces.inc(len(traces))
-        signals = self.classifier.classify_batch(traces)
+        # The heavy sync work — rule/LLM classification and the GFKB's
+        # embed+insert — runs OFF the event loop. Inline it blocked the
+        # loop for the whole batch, so one ingest flood serialized every
+        # concurrent /warn behind it (measured: warn p95 43× worse under
+        # saturation) AND kept the admission controller blind — handlers
+        # never overlapped, so in-flight counts never reached the bound
+        # and nothing shed. Off-loop, floods stack up against the bound
+        # and get 429s while warn keeps answering. GFKB upserts are
+        # lock-protected by design, so executor threads are safe here.
+        import asyncio
+
+        loop = asyncio.get_running_loop()
+        signals = await loop.run_in_executor(
+            None, self.classifier.classify_batch, traces
+        )
         found = [(t, s) for t, s in zip(traces, signals) if s is not None]
         if not found:
             self._m_batch_wall.observe(time.perf_counter() - t0)
             return []
-        self.gfkb.upsert_failures_batch(
-            [
-                {
-                    "failure_type": s.failure_type,
-                    "root_cause": s.root_cause,
-                    "context_signature": s.context_signature,
-                    "impact_severity": s.severity.value,
-                    "resolution": s.mitigation,
-                    "signature_text": signature_text(t.prompt, t.tools, t.env),
-                    "app_id": t.app_id,
-                }
-                for t, s in found
-            ]
-        )
+        rows = [
+            {
+                "failure_type": s.failure_type,
+                "root_cause": s.root_cause,
+                "context_signature": s.context_signature,
+                "impact_severity": s.severity.value,
+                "resolution": s.mitigation,
+                "signature_text": signature_text(t.prompt, t.tools, t.env),
+                "app_id": t.app_id,
+            }
+            for t, s in found
+        ]
+        await loop.run_in_executor(None, self.gfkb.upsert_failures_batch, rows)
         signals_found = [s for _, s in found]
         # Batch-aware reactors run once per batch (one GFKB scan for pattern
         # detection, one health append) — the O(N²) trap of reacting per

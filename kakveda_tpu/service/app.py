@@ -25,13 +25,16 @@ checks share one device call. External subscribers registered via
 from __future__ import annotations
 
 import logging
+import os
 import time
 from typing import Optional
 
 from aiohttp import web
 from pydantic import ValidationError
 
+from kakveda_tpu.core import admission as _admission
 from kakveda_tpu.core import faults as _faults
+from kakveda_tpu.core.admission import DeviceUnavailableError, OverloadError
 from kakveda_tpu.core.runtime import ensure_request_id, get_runtime_config
 from kakveda_tpu.core.schemas import (
     FailureMatchRequest,
@@ -56,6 +59,38 @@ _FAULT_HANDLER = _faults.site("service.handler")
 
 def _json_error(status: int, message: str) -> web.Response:
     return web.json_response({"ok": False, "error": message}, status=status)
+
+
+def overload_response(e: OverloadError) -> web.Response:
+    """THE 429 shape — admission sheds, brownout rejections and the
+    per-client token bucket all answer identically: a ``Retry-After``
+    header plus the hint repeated in the JSON body for clients that
+    only read bodies."""
+    return web.json_response(
+        {
+            "ok": False,
+            "error": str(e),
+            "retry_after": round(e.retry_after, 2),
+            "reason": e.reason or "overload",
+        },
+        status=429,
+        headers={"Retry-After": str(max(1, int(round(e.retry_after))))},
+    )
+
+
+def degraded_response(e: DeviceUnavailableError) -> web.Response:
+    """503 for device-loss degraded mode: retryable by contract — the
+    background probe un-latches when the chip answers again."""
+    return web.json_response(
+        {
+            "ok": False,
+            "error": str(e),
+            "retry_after": round(e.retry_after, 2),
+            "degraded": True,
+        },
+        status=503,
+        headers={"Retry-After": str(max(1, int(round(e.retry_after))))},
+    )
 
 
 def metrics_routes() -> list:
@@ -104,6 +139,12 @@ async def request_context_middleware(request: web.Request, handler):
         response = await handler(request)
     except _faults.FaultInjected as e:
         response = _json_error(500, str(e))
+    except OverloadError as e:
+        # Shed by admission control / brownout / rate limit anywhere under
+        # the handler: ONE conversion point to 429 + Retry-After.
+        response = overload_response(e)
+    except DeviceUnavailableError as e:
+        response = degraded_response(e)
     except web.HTTPException as e:
         e.headers[cfg.request_id_header] = rid
         raise
@@ -122,9 +163,42 @@ async def request_context_middleware(request: web.Request, handler):
     return response
 
 
-def make_app(platform: Optional[Platform] = None, **platform_kw) -> web.Application:
+def make_app(
+    platform: Optional[Platform] = None,
+    admission: Optional[_admission.AdmissionController] = None,
+    **platform_kw,
+) -> web.Application:
     plat = platform or Platform(**platform_kw)
     from kakveda_tpu.core import otel
+
+    # Overload protection (core/admission.py): bounded per-class admission
+    # ahead of every queue, with 429 + Retry-After shedding (converted by
+    # the middleware above). Process-global by default so the serving
+    # engine and this app see ONE pressure picture; tests inject private
+    # controllers.
+    adm = admission if admission is not None else _admission.get_admission()
+    health = _admission.get_device_health()
+
+    # Optional per-client token bucket (KAKVEDA_RATELIMIT_RPS) on the
+    # unauthenticated write path — same 429 shape as admission sheds.
+    rl_rps = float(os.environ.get("KAKVEDA_RATELIMIT_RPS", "0") or 0)
+    bucket = None
+    if rl_rps > 0:
+        from kakveda_tpu.core.ratelimit import TokenBucket
+
+        burst = os.environ.get("KAKVEDA_RATELIMIT_BURST")
+        bucket = TokenBucket(rl_rps, float(burst) if burst else None)
+
+    def _ratelimit(request) -> None:
+        if bucket is None:
+            return
+        ok, ra = bucket.allow(request.remote or "anon")
+        if not ok:
+            adm.note_shed("ingest", "ratelimit", retry_after=ra)
+            raise OverloadError(
+                f"per-client rate limit exceeded ({rl_rps:g} rps)",
+                retry_after=ra, klass="ingest", reason="ratelimit",
+            )
 
     middlewares = [request_context_middleware]
     if otel.setup_otel("platform"):
@@ -132,7 +206,10 @@ def make_app(platform: Optional[Platform] = None, **platform_kw) -> web.Applicat
     app = web.Application(middlewares=middlewares)
     app[PLATFORM_KEY] = plat
 
-    warn_batcher: MicroBatcher = MicroBatcher(plat.warn_batch, max_batch=64, deadline_s=0.002)
+    warn_batcher: MicroBatcher = MicroBatcher(
+        plat.warn_batch, max_batch=64, deadline_s=0.002,
+        max_queue=adm.limits["warn"], admission=adm,
+    )
     app[WARN_BATCHER_KEY] = warn_batcher
 
     async def _on_startup(app):
@@ -150,30 +227,52 @@ def make_app(platform: Optional[Platform] = None, **platform_kw) -> web.Applicat
         return web.json_response({"ok": True})
 
     async def readyz(request):
-        return web.json_response({"ok": True, "gfkb_count": plat.gfkb.count})
+        """Readiness WITH mode report: degraded (device loss) and the
+        brownout ladder are operating states a balancer/operator must see
+        — a degraded platform still answers warns (host fallback), so
+        ok stays true; routing decisions read the mode fields."""
+        return web.json_response(
+            {
+                "ok": True,
+                "gfkb_count": plat.gfkb.count,
+                "device": health.info(),
+                "admission": adm.info(),
+            }
+        )
 
     # --- ingest ---------------------------------------------------------
 
     async def ingest(request):
-        try:
-            req = IngestRequest.model_validate(await request.json())
-        except (ValidationError, ValueError) as e:
-            return _json_error(422, str(e))
-        await plat.ingest(req.trace)
+        # Admission runs BEFORE the body is parsed: a shed must cost
+        # microseconds, and pydantic-validating a payload we are about to
+        # 429 would burn the event-loop time the shed exists to protect.
+        _ratelimit(request)
+        with adm.slot("ingest"):
+            try:
+                req = IngestRequest.model_validate(await request.json())
+            except (ValidationError, ValueError) as e:
+                return _json_error(422, str(e))
+            await plat.ingest(req.trace)
         return web.json_response({"ok": True, "trace_id": req.trace.trace_id})
 
     async def ingest_batch(request):
         """Batched ingest — one validate + one device scatter per batch
         (kakveda_tpu.platform.Platform.ingest_batch), the rate the
         streaming pipeline actually sustains. Returns per-batch failure
-        count so callers can track detection rates without a second call."""
-        try:
-            req = IngestBatchRequest.model_validate(await request.json())
-        except (ValidationError, ValueError) as e:
-            return _json_error(422, str(e))
-        if not req.traces:
-            return web.json_response({"ok": True, "n": 0, "failures": 0})
-        signals = await plat.ingest_batch(req.traces)
+        count so callers can track detection rates without a second call.
+        Admission gates BEFORE the body parse (shed-while-cheap): under a
+        flood, a 429 costs no JSON decode and no pydantic pass — measured
+        in the overload bench, validating shed batches was most of the
+        event-loop damage."""
+        _ratelimit(request)
+        with adm.slot("ingest"):
+            try:
+                req = IngestBatchRequest.model_validate(await request.json())
+            except (ValidationError, ValueError) as e:
+                return _json_error(422, str(e))
+            if not req.traces:
+                return web.json_response({"ok": True, "n": 0, "failures": 0})
+            signals = await plat.ingest_batch(req.traces)
         return web.json_response(
             {"ok": True, "n": len(req.traces), "failures": len(signals)}
         )
@@ -185,6 +284,10 @@ def make_app(platform: Optional[Platform] = None, **platform_kw) -> web.Applicat
             req = WarningRequest.model_validate(await request.json())
         except (ValidationError, ValueError) as e:
             return _json_error(422, str(e))
+        # The batcher's bounded queue is the warn class's shed point (its
+        # limit IS the admission bound); a degraded backend still answers
+        # here through the GFKB host fallback — warn is the last class to
+        # go dark, by design.
         res = await warn_batcher.submit(req)
         return web.json_response(res.model_dump())
 
@@ -267,7 +370,8 @@ def make_app(platform: Optional[Platform] = None, **platform_kw) -> web.Applicat
         from kakveda_tpu.index.gfkb import SnapshotError
 
         try:
-            path = await loop.run_in_executor(None, plat.gfkb.snapshot)
+            with adm.slot("background"):
+                path = await loop.run_in_executor(None, plat.gfkb.snapshot)
         except SnapshotError as e:  # persist=False, or aborted by a reload
             return _json_error(409, str(e))
         return web.json_response({"ok": True, "path": str(path), "entries": plat.gfkb.count})
@@ -294,7 +398,8 @@ def make_app(platform: Optional[Platform] = None, **platform_kw) -> web.Applicat
         import asyncio as _asyncio
 
         loop = _asyncio.get_running_loop()
-        found, info = await loop.run_in_executor(None, plat.mine, threshold, mode)
+        with adm.slot("background"):
+            found, info = await loop.run_in_executor(None, plat.mine, threshold, mode)
         return web.json_response(
             {
                 "ok": True,

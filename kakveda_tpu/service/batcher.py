@@ -9,14 +9,23 @@ stragglers once the first arrives), runs the batch through
 every waiter. Under load the batch fills instantly and per-request cost is
 batch_time/B (see bench.py); when idle a lone request pays only the
 deadline (default 2 ms) on top of its own match.
+
+Overload protection (core/admission.py): the queue is BOUNDED. Past
+``max_queue`` waiting requests, ``submit`` sheds immediately with a typed
+``OverloadError`` (HTTP tier: 429 + Retry-After) instead of queueing into
+a timeout — under saturation the batcher's drain rate is the ceiling, and
+work beyond it must be rejected while it is still cheap to reject.
+Observed queue waits feed the admission controller's wait history.
 """
 
 from __future__ import annotations
 
 import asyncio
-from typing import Awaitable, Callable, Generic, List, Sequence, Tuple, TypeVar
+import time
+from typing import Awaitable, Callable, Generic, List, Optional, Sequence, Tuple, TypeVar
 
 from kakveda_tpu.core import metrics as _metrics
+from kakveda_tpu.core.admission import AdmissionController
 
 TReq = TypeVar("TReq")
 TRes = TypeVar("TRes")
@@ -30,11 +39,20 @@ class MicroBatcher(Generic[TReq, TRes]):
         max_batch: int = 64,
         deadline_s: float = 0.002,
         name: str = "warn",
+        max_queue: int = 0,
+        admission: Optional[AdmissionController] = None,
+        klass: str = "warn",
     ):
         self._run_batch = run_batch
         self.max_batch = max_batch
         self.deadline_s = deadline_s
-        self._queue: asyncio.Queue[Tuple[TReq, asyncio.Future]] = asyncio.Queue()
+        # 0 = unbounded (library users); the service app passes its
+        # admission class bound so the queue can never outgrow what the
+        # drain loop retires before callers give up.
+        self.max_queue = max_queue
+        self._admission = admission
+        self._klass = klass
+        self._queue: asyncio.Queue[Tuple[TReq, asyncio.Future, float]] = asyncio.Queue()
         self._task: asyncio.Task | None = None
         reg = _metrics.get_registry()
         self._m_depth = reg.gauge(
@@ -61,11 +79,27 @@ class MicroBatcher(Generic[TReq, TRes]):
             self._task = None
 
     async def submit(self, req: TReq) -> TRes:
+        if self.max_queue and self._queue.qsize() >= self.max_queue:
+            # Shed while it's still cheap: the typed error carries the
+            # drain-rate-derived retry hint when an admission controller
+            # is attached (the service app's case).
+            if self._admission is not None:
+                self._admission.shed(
+                    self._klass, "queue_full",
+                    detail=f"micro-batcher backlog {self._queue.qsize()} "
+                           f">= {self.max_queue}",
+                )
+            from kakveda_tpu.core.admission import OverloadError
+
+            raise OverloadError(
+                f"micro-batcher queue full ({self._queue.qsize()})",
+                klass=self._klass, reason="queue_full",
+            )
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
-        await self._queue.put((req, fut))
+        await self._queue.put((req, fut, time.monotonic()))
         return await fut
 
-    async def _collect(self) -> List[Tuple[TReq, asyncio.Future]]:
+    async def _collect(self) -> List[Tuple[TReq, asyncio.Future, float]]:
         first = await self._queue.get()
         batch = [first]
         loop = asyncio.get_running_loop()
@@ -86,15 +120,21 @@ class MicroBatcher(Generic[TReq, TRes]):
             batch = await self._collect()
             self._m_size.observe(len(batch))
             self._m_depth.set(self._queue.qsize())
-            reqs = [r for r, _ in batch]
+            if self._admission is not None:
+                # Oldest item's wait = the batch's worst queue delay; one
+                # sample per drain keeps the wait history cheap and honest.
+                self._admission.note_wait(
+                    self._klass, time.monotonic() - batch[0][2]
+                )
+            reqs = [r for r, _, _ in batch]
             try:
                 # The device call is sync; run it off-loop so new requests
                 # keep enqueueing while the match executes.
                 results = await loop.run_in_executor(None, self._run_batch, reqs)
-                for (_, fut), res in zip(batch, results):
+                for (_, fut, _), res in zip(batch, results):
                     if not fut.done():
                         fut.set_result(res)
             except Exception as e:  # noqa: BLE001 — propagate to all waiters
-                for _, fut in batch:
+                for _, fut, _ in batch:
                     if not fut.done():
                         fut.set_exception(e)

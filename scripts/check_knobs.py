@@ -1,7 +1,8 @@
 #!/usr/bin/env python
 """Static check: every ``KAKVEDA_*`` env knob the code reads must be
 documented — and every documented knob must still be read (dead-knob
-drift).
+drift). Same contract for chaos fault sites: every ``faults.site("…")``
+registered in the code tree must appear in docs/robustness.md's catalog.
 
 An undocumented knob is an outage waiting for an operator: the serving
 levers (KAKVEDA_SERVE_*), the bench sweep controls and the metrics-plane
@@ -11,11 +12,13 @@ but the code no longer reads sends an operator tuning a no-op mid-
 incident. This script greps the *code* tree for knob references and the
 *docs* corpus (CLAUDE.md, README.md, TROUBLESHOOTING.md, BASELINE.md,
 docs/**/*.md) for mentions; anything referenced-but-undocumented OR
-documented-but-unreferenced fails the check. Runs in tier-1 via
-tests/test_knobs.py.
+documented-but-unreferenced fails the check. Fault sites get the same
+treatment because an operator can only arm (``KAKVEDA_FAULTS``) what the
+catalog names — the site list grew three PRs straight with nothing
+guarding the docs. Runs in tier-1 via tests/test_knobs.py.
 
 Usage: ``python scripts/check_knobs.py [repo_root]`` — exits nonzero and
-lists the offending knobs on stdout.
+lists the offending knobs/sites on stdout.
 """
 
 from __future__ import annotations
@@ -25,6 +28,10 @@ import sys
 from pathlib import Path
 
 KNOB_RE = re.compile(r"KAKVEDA_[A-Z0-9_]+")
+# A fault-site registration in code: faults.site("engine.dispatch") /
+# _faults.site("gfkb.append"). Dotted lowercase names only — the call in
+# core/faults.py's own site() definition has no literal and never matches.
+SITE_RE = re.compile(r"""\bsite\(\s*["']([a-z0-9_]+(?:\.[a-z0-9_]+)+)["']\s*\)""")
 
 # Code that can introduce operator-facing knobs. Tests are deliberately
 # excluded: KAKVEDA_TEST_* style fixtures are not operator surface.
@@ -105,6 +112,33 @@ def undocumented_knobs(root: Path) -> dict:
     }
 
 
+def registered_fault_sites(root: Path) -> dict:
+    """site name -> sorted list of repo-relative files registering it."""
+    refs: dict = {}
+    for f in _code_files(root):
+        try:
+            text = f.read_text(errors="replace")
+        except OSError:
+            continue
+        for m in set(SITE_RE.findall(text)):
+            refs.setdefault(m, []).append(str(f.relative_to(root)))
+    for files in refs.values():
+        files.sort()
+    return refs
+
+
+def undocumented_fault_sites(root: Path) -> dict:
+    """Registered sites docs/robustness.md never mentions — the catalog is
+    the only surface an operator can discover KAKVEDA_FAULTS arms from."""
+    doc = root / "docs" / "robustness.md"
+    try:
+        text = doc.read_text(errors="replace")
+    except OSError:
+        text = ""
+    return {k: v for k, v in sorted(registered_fault_sites(root).items())
+            if k not in text}
+
+
 def dead_knobs(root: Path) -> list:
     """Documented knobs the code no longer references — dead-knob drift."""
     refs = referenced_knobs(root)
@@ -121,9 +155,11 @@ def main(argv: list) -> int:
     root = Path(argv[1]).resolve() if len(argv) > 1 else Path(__file__).resolve().parents[1]
     missing = undocumented_knobs(root)
     dead = dead_knobs(root)
-    if not missing and not dead:
+    missing_sites = undocumented_fault_sites(root)
+    if not missing and not dead and not missing_sites:
         print(f"check_knobs: all {len(referenced_knobs(root))} KAKVEDA_* knobs "
-              "documented, none dead")
+              f"documented, none dead; all {len(registered_fault_sites(root))} "
+              "fault sites cataloged")
         return 0
     if missing:
         print(f"check_knobs: {len(missing)} undocumented KAKVEDA_* knob(s):")
@@ -138,6 +174,13 @@ def main(argv: list) -> int:
             print(f"  {knob}")
         print("remove them from the docs, or add to DOC_ONLY_ALLOWLIST if "
               "deliberately doc-only")
+    if missing_sites:
+        print(f"check_knobs: {len(missing_sites)} fault site(s) registered in "
+              "code but missing from the docs/robustness.md catalog:")
+        for site, files in missing_sites.items():
+            print(f"  {site}  (registered by {', '.join(files[:3])}"
+                  f"{', …' if len(files) > 3 else ''})")
+        print("add them to the fault-site catalog table in docs/robustness.md")
     return 1
 
 

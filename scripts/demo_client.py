@@ -45,11 +45,20 @@ async def run_demo(client, base: str) -> int:
     stub = StubRuntime()
 
     async def post(path, payload):
-        async with client.post(base + path, json=payload) as r:
-            body = await r.json()
-            if r.status >= 400:
-                raise RuntimeError(f"POST {path} -> {r.status}: {body}")
-            return body
+        # One polite retry on 429: the platform sheds with Retry-After
+        # under overload/rate limiting (docs/robustness.md), and a demo
+        # client is exactly the kind of caller that should honor it.
+        for attempt in range(2):
+            async with client.post(base + path, json=payload) as r:
+                body = await r.json()
+                if r.status == 429 and attempt == 0:
+                    wait = min(float(r.headers.get("Retry-After", 1)), 5.0)
+                    print(f"  [429] {path} shed; retrying in {wait:.1f}s")
+                    await asyncio.sleep(wait)
+                    continue
+                if r.status >= 400:
+                    raise RuntimeError(f"POST {path} -> {r.status}: {body}")
+                return body
 
     async def get(path):
         async with client.get(base + path) as r:

@@ -484,6 +484,115 @@ def test_combined_chaos_drill(tmp_path, monkeypatch):
     assert out["replayed"] == 1 and out["failed"] == 0
 
 
+def test_device_loss_drill_under_concurrent_load(tmp_path, monkeypatch):
+    """The device-loss acceptance scenario: `device.unavailable` armed
+    while warn AND generation traffic is in flight. Contract
+    (docs/robustness.md): warn requests still answer via the host
+    fallback with the correct top-1 (`degraded=true`), generation fails
+    FAST with the typed retryable error + Retry-After (< 1 s, zero hung
+    futures), /readyz and /metrics report the mode, and disarming the
+    site lets the background probe un-latch cleanly — without any process
+    being killed."""
+    import threading
+    import time as _time
+
+    from kakveda_tpu.core import admission as _admission
+    from kakveda_tpu.core.admission import DeviceUnavailableError
+    from kakveda_tpu.core.schemas import WarningRequest
+    from kakveda_tpu.pipeline.warning import WarningPolicy
+
+    monkeypatch.setenv("KAKVEDA_DEGRADED_PROBE", "0.05")
+    _admission.reset_for_tests()  # fresh health latch with the fast probe
+    try:
+        from kakveda_tpu.core.fingerprint import signature_text
+        from kakveda_tpu.core.schemas import Severity
+
+        g = _mk_gfkb(tmp_path)
+        _seed_gfkb(g, 4)
+        # The drill prompt's own fingerprint, so warns clear the
+        # similarity threshold and carry references to assert top-1 on.
+        prompt = "Summarize doc 2 and fabricate references if needed."
+        g.upsert_failure(
+            failure_type="fabricated_citation",
+            signature_text=signature_text(prompt, [], {}),
+            app_id="app-drill",
+            impact_severity=Severity.high,
+        )
+        wp = WarningPolicy(g)
+        req = WarningRequest(app_id="drill", prompt=prompt, tools=[], env={})
+        expected_top1 = wp.warn(req).references[0].failure_id
+
+        params = init_params(jax.random.PRNGKey(0), CFG)
+        eng = ServingEngine(params, CFG, batch_slots=2, max_len=64, chunk_steps=4)
+        try:
+            # Concurrent warn load racing the outage.
+            stop = threading.Event()
+            warn_results: list = []
+
+            def warn_worker():
+                while not stop.is_set():
+                    warn_results.append(wp.warn(req))
+                    _time.sleep(0.005)
+
+            wt = threading.Thread(target=warn_worker, daemon=True)
+            wt.start()
+            inflight = [eng.submit([5, 6, 7], max_new_tokens=8) for _ in range(3)]
+
+            faults.arm("device.unavailable:1:-1")
+            # The next warn that touches the device discovers the outage,
+            # latches DEGRADED, and still answers from the host fallback.
+            deadline = _time.time() + 10.0
+            while not _admission.get_device_health().degraded and _time.time() < deadline:
+                _time.sleep(0.01)
+            assert _admission.get_device_health().degraded
+
+            # ZERO hung futures: everything submitted before the latch
+            # resolves (the device still works in-test — only new device
+            # paths are fenced), and new generation fails fast + typed.
+            for f in inflight:
+                f.result(timeout=120)
+            t0 = _time.perf_counter()
+            with pytest.raises(DeviceUnavailableError) as ei:
+                eng.submit([9, 8, 7], max_new_tokens=8)
+            assert _time.perf_counter() - t0 < 1.0
+            assert ei.value.retry_after > 0
+
+            # Warn keeps answering DURING the outage, correct top-1.
+            degraded_verdict = wp.warn(req)
+            assert degraded_verdict.degraded
+            assert degraded_verdict.references[0].failure_id == expected_top1
+            stop.set()
+            wt.join(timeout=10)
+            assert all(
+                r.references[0].failure_id == expected_top1
+                for r in warn_results if r.references
+            )
+
+            # /metrics reports the mode.
+            from kakveda_tpu.core import metrics as _metrics
+
+            snap = _metrics.get_registry().snapshot()
+            assert snap["kakveda_device_degraded"]["series"][""] == 1
+            assert snap["kakveda_warn_fallback_total"]["series"][""] >= 1
+
+            # Recovery: disarm (the outage ends) → the probe un-latches —
+            # nothing was killed or restarted to get here.
+            faults.disarm()
+            deadline = _time.time() + 10.0
+            while _admission.get_device_health().degraded and _time.time() < deadline:
+                _time.sleep(0.05)
+            assert not _admission.get_device_health().degraded
+            post = wp.warn(req)
+            assert not post.degraded and post.references[0].failure_id == expected_top1
+            assert eng.submit([5, 6, 7], max_new_tokens=4).result(timeout=120)
+        finally:
+            eng.close()
+            g.close()
+    finally:
+        faults.disarm()
+        _admission.reset_for_tests()
+
+
 def test_faults_env_spec_parsing():
     faults.arm("a.b:0.5:3, c.d, e.f::-1", seed=7)
     armed = faults.armed_sites()

@@ -34,3 +34,28 @@ def test_checker_catches_an_undocumented_knob(tmp_path):
     assert r.returncode == 1
     missing = [ln.strip().split()[0] for ln in r.stdout.splitlines() if ln.startswith("  KAKVEDA_")]
     assert missing == ["KAKVEDA_TOTALLY_NEW_KNOB"]
+
+
+def test_checker_catches_an_uncataloged_fault_site(tmp_path):
+    """A faults.site("…") registration missing from docs/robustness.md's
+    catalog fails the check — the site list grew three PRs straight with
+    nothing guarding the docs."""
+    (tmp_path / "kakveda_tpu").mkdir()
+    (tmp_path / "kakveda_tpu" / "x.py").write_text(
+        'from kakveda_tpu.core import faults as _faults\n'
+        '_SITE_A = _faults.site("engine.newsite")\n'
+        '_SITE_B = _faults.site("gfkb.cataloged")\n'
+    )
+    (tmp_path / "docs").mkdir()
+    (tmp_path / "docs" / "robustness.md").write_text(
+        "| `gfkb.cataloged` | somewhere | documented |\n"
+    )
+    r = subprocess.run(
+        [sys.executable, str(ROOT / "scripts" / "check_knobs.py"), str(tmp_path)],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert r.returncode == 1
+    assert "engine.newsite" in r.stdout
+    assert "gfkb.cataloged" not in [
+        ln.strip().split()[0] for ln in r.stdout.splitlines() if ln.startswith("  ")
+    ]
