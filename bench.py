@@ -706,7 +706,9 @@ def _measure_spec_judge(k: int) -> dict:
             "judge requests — beyond tie noise, a real parity bug"
         )
 
-    s = cb_spec.spec_stats
+    # THE read API (CLAUDE.md): the lock-guarded deep-copy snapshot, never
+    # the live dicts — single-threaded here, but the discipline is uniform.
+    s = cb_spec.stats_snapshot()["spec"]
     engine_rate = s["emitted"] / s["slot_chunks"] if s["slot_chunks"] else 0.0
     return {
         "tokens_per_round": st["tokens_per_round"],
@@ -2134,6 +2136,22 @@ def _metrics_plane() -> dict:
         return {}
 
 
+def _lint_findings() -> int:
+    """Invariant-lint finding count over this tree (the AST rules of
+    scripts/lint_invariants.py, docs/static-analysis.md), folded into the
+    bench JSON line so every BENCH_r{N}.json records whether the design
+    contracts held at measurement time. 0 = clean; -1 = the linter itself
+    failed (never sink a bench line over telemetry)."""
+    try:
+        from pathlib import Path
+
+        from kakveda_tpu.analysis.framework import run_lint
+
+        return len(run_lint(Path(__file__).resolve().parent).findings)
+    except Exception:  # noqa: BLE001 — lint telemetry must never sink a bench line
+        return -1
+
+
 def load_resumable_partial(partial_path: str, backend: str) -> dict:
     """Load already-measured metrics from a prior wedged sweep.
 
@@ -2318,6 +2336,7 @@ def main() -> int:
     if which in fns:
         out = fns[which](backend)
         out["metrics_plane"] = _metrics_plane()
+        out["lint_findings"] = _lint_findings()
         print(json.dumps(out))
         return 0
 
@@ -2390,6 +2409,7 @@ def main() -> int:
     headline = results[0]
     headline["extra_metrics"] = results[1:]
     headline["metrics_plane"] = _metrics_plane()
+    headline["lint_findings"] = _lint_findings()
     print(json.dumps(headline))
     return 0
 

@@ -1,0 +1,16 @@
+"""Static analysis: machine-enforced design contracts.
+
+CLAUDE.md's invariants ("gate transitions go through ``_set_gate_state``
+ONLY", "every forward path must honor a new ``LlamaConfig`` flag", "batcher
+stat mutations hold ``cb.stats_lock``") were prose until this package: a
+dependency-free AST lint framework (:mod:`framework`) plus the
+project-specific rules (:mod:`rules`) that encode them, run via
+``python scripts/lint_invariants.py`` and enforced in tier-1 by
+``tests/test_lint_invariants.py``. ``scripts/check_knobs.py``'s knob/
+fault-site parity checks live here too (:mod:`knobs`) so both entry points
+share one source-tree discovery helper (:mod:`discovery`).
+
+Deliberately imports NOTHING heavy — no jax, no numpy — so the lint runs
+in well under a second and tier-1 can gate on it without a backend.
+Rule catalog: docs/static-analysis.md.
+"""

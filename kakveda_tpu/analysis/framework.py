@@ -1,0 +1,228 @@
+"""AST lint framework: rule registry, pragmas, baseline, stable outputs.
+
+The machinery under ``scripts/lint_invariants.py`` — rules themselves live
+in :mod:`kakveda_tpu.analysis.rules`. Design mirrors what made
+``check_knobs.py`` stick:
+
+* **Pure stdlib.** Parsing is ``ast`` only; no file is ever imported or
+  executed, so linting ``models/serving.py`` needs no jax, no backend, no
+  mesh — the whole-tree run is budgeted under 10 s in tier-1 and actually
+  takes well under one.
+* **Rules are registered, not hardcoded.** A rule declares an ``id`` (the
+  stable name docs, pragmas and the baseline refer to), an ``invariant``
+  one-liner (surfaced by ``--list-rules`` and docs/static-analysis.md) and
+  either a per-file visitor (``scope`` + ``visit_file``) or a whole-tree
+  check (``check_tree``). The runner parses each file once and dispatches.
+* **Suppressions are inline and named**: ``# kakveda: allow[rule-id]`` on
+  the offending line or the line above. A suppression without a rule id
+  does not exist — greps for the id find every grandfathered site.
+* **Baseline**: ``kakveda_tpu/analysis/baseline.json`` holds finding keys
+  (rule:file:message — line numbers excluded so unrelated edits don't
+  churn it) that are reported but don't fail. Shipped EMPTY: the PR that
+  introduced the linter fixed what it found. Keep it that way.
+* **Stable exit codes** (enforced by tests): 0 = clean (suppressed/
+  baselined findings allowed), 1 = live findings, 2 = usage/internal
+  error. Output is human lines by default, ``--json`` for machines —
+  bench.py folds ``len(findings)`` into its JSON line as
+  ``lint_findings``.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import re
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence
+
+from kakveda_tpu.analysis import discovery
+
+PRAGMA_RE = re.compile(r"#\s*kakveda:\s*allow\[([A-Za-z0-9_,\- ]+)\]")
+
+# Default baseline location, repo-relative (committed; grandfathered keys).
+BASELINE_REL = "kakveda_tpu/analysis/baseline.json"
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation at one location."""
+
+    rule: str
+    file: str  # repo-relative posix path
+    line: int
+    message: str
+
+    @property
+    def baseline_key(self) -> str:
+        # Deliberately line-free: a baselined finding must survive the file
+        # shifting around it, and die the moment the offending code changes
+        # enough to reword the message.
+        return f"{self.rule}:{self.file}:{self.message}"
+
+    def human(self) -> str:
+        return f"{self.file}:{self.line}: [{self.rule}] {self.message}"
+
+    def as_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "file": self.file,
+            "line": self.line,
+            "message": self.message,
+        }
+
+
+class FileContext:
+    """One parsed source file: AST, raw lines, and suppression pragmas."""
+
+    def __init__(self, root: Path, path: Path):
+        self.path = path
+        self.rel = path.relative_to(root).as_posix()
+        self.source = path.read_text(errors="replace")
+        self.lines = self.source.splitlines()
+        self.parse_error: Optional[SyntaxError] = None
+        try:
+            self.tree: Optional[ast.AST] = ast.parse(self.source)
+        except SyntaxError as e:
+            self.tree = None
+            self.parse_error = e
+        # lineno -> rule ids allowed on that line (or the line below it).
+        self.allows: Dict[int, set] = {}
+        for i, ln in enumerate(self.lines, 1):
+            m = PRAGMA_RE.search(ln)
+            if m:
+                self.allows[i] = {r.strip() for r in m.group(1).split(",") if r.strip()}
+
+    def find_line(self, needle: str) -> int:
+        """First 1-based line containing ``needle`` (1 when absent) — for
+        tree rules whose evidence is textual (knob/site strings)."""
+        for i, ln in enumerate(self.lines, 1):
+            if needle in ln:
+                return i
+        return 1
+
+
+class TreeContext:
+    """The whole scanned tree, parsed once and shared by every rule."""
+
+    def __init__(self, root: Path):
+        self.root = Path(root)
+        self.files: List[FileContext] = [
+            FileContext(self.root, p) for p in discovery.code_files(self.root)
+        ]
+        self.by_rel: Dict[str, FileContext] = {fc.rel: fc for fc in self.files}
+
+
+class Rule:
+    """Base rule. Subclasses set ``id``/``invariant`` and implement either
+    ``visit_file`` (with ``scope`` = tuple of repo-relative path prefixes)
+    or ``check_tree`` (``scope`` = None)."""
+
+    id: str = ""
+    invariant: str = ""
+    scope: Optional[Sequence[str]] = None  # None => whole-tree rule
+
+    def interested(self, rel: str) -> bool:
+        return self.scope is not None and any(
+            rel == s or rel.startswith(s) for s in self.scope
+        )
+
+    def visit_file(self, fc: FileContext, ctx: TreeContext) -> List[Finding]:
+        return []
+
+    def check_tree(self, ctx: TreeContext) -> List[Finding]:
+        return []
+
+
+_REGISTRY: Dict[str, Rule] = {}
+
+
+def register(cls):
+    """Class decorator: instantiate and register a rule by its id."""
+    if not cls.id:
+        raise ValueError(f"rule {cls.__name__} has no id")
+    if cls.id in _REGISTRY:
+        raise ValueError(f"duplicate rule id {cls.id!r}")
+    _REGISTRY[cls.id] = cls()
+    return cls
+
+
+def all_rules() -> Dict[str, Rule]:
+    """The registry, loading the project rules on first use."""
+    from kakveda_tpu.analysis import rules as _rules  # noqa: F401  (registers)
+
+    return dict(sorted(_REGISTRY.items()))
+
+
+@dataclass
+class LintResult:
+    findings: List[Finding]      # live: fail the run
+    suppressed: List[Finding]    # silenced by an inline pragma
+    baselined: List[Finding]     # grandfathered by baseline.json
+    rules_run: List[str]
+
+
+def _suppressed(ctx: TreeContext, f: Finding) -> bool:
+    fc = ctx.by_rel.get(f.file)
+    if fc is None:
+        return False
+    for ln in (f.line, f.line - 1):
+        ids = fc.allows.get(ln)
+        if ids and (f.rule in ids or "*" in ids):
+            return True
+    return False
+
+
+def load_baseline(root: Path, baseline_path: Optional[Path] = None) -> set:
+    p = baseline_path or (Path(root) / BASELINE_REL)
+    try:
+        data = json.loads(p.read_text())
+    except (OSError, ValueError):
+        return set()
+    return {str(k) for k in data} if isinstance(data, list) else set()
+
+
+def run_lint(
+    root,
+    rule_ids: Optional[Iterable[str]] = None,
+    baseline_path: Optional[Path] = None,
+) -> LintResult:
+    """Run the (selected) rules over ``root``; partition findings into
+    live / suppressed / baselined. Raises KeyError on an unknown rule id."""
+    registry = all_rules()
+    if rule_ids:
+        rules = [registry[r] for r in rule_ids]  # KeyError = caller's usage error
+    else:
+        rules = list(registry.values())
+    ctx = TreeContext(Path(root))
+
+    raw: List[Finding] = []
+    for fc in ctx.files:
+        if fc.parse_error is not None:
+            # A file the linter cannot parse is a file whose invariants
+            # nobody can verify — always a finding, whatever rules ran.
+            raw.append(Finding(
+                "syntax", fc.rel, fc.parse_error.lineno or 1,
+                f"unparseable source: {fc.parse_error.msg}",
+            ))
+            continue
+        for rule in rules:
+            if rule.interested(fc.rel):
+                raw.extend(rule.visit_file(fc, ctx))
+    for rule in rules:
+        if rule.scope is None:
+            raw.extend(rule.check_tree(ctx))
+
+    raw = sorted(set(raw), key=lambda f: (f.file, f.line, f.rule, f.message))
+    baseline = load_baseline(ctx.root, baseline_path)
+    findings: List[Finding] = []
+    suppressed: List[Finding] = []
+    baselined: List[Finding] = []
+    for f in raw:
+        if _suppressed(ctx, f):
+            suppressed.append(f)
+        elif f.baseline_key in baseline:
+            baselined.append(f)
+        else:
+            findings.append(f)
+    return LintResult(findings, suppressed, baselined, [r.id for r in rules])

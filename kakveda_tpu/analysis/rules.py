@@ -1,0 +1,896 @@
+"""The project rules: CLAUDE.md's design contracts as AST checks.
+
+Each rule encodes ONE prose invariant (catalog with rationale and
+suppression policy: docs/static-analysis.md). Rules are intentionally
+narrow — they match the specific idioms this codebase uses (``cfg.<flag>``
+reads, ``self.<dict>["key"]`` stores, ``with …stats_lock`` blocks,
+``_faults.site("…")`` registrations) rather than trying to be a general
+linter; a pattern the rule can't see is a pattern the codebase shouldn't
+use for that invariant in the first place.
+
+False-positive policy: a deliberate exception gets an inline
+``# kakveda: allow[rule-id]`` pragma WITH a comment explaining why —
+never widen a rule's blind spot to hide one site.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from kakveda_tpu.analysis import discovery as _discovery
+from kakveda_tpu.analysis import knobs as _knobs
+from kakveda_tpu.analysis.framework import (
+    FileContext,
+    Finding,
+    Rule,
+    TreeContext,
+    register,
+)
+
+# ---------------------------------------------------------------------------
+# shared AST helpers
+# ---------------------------------------------------------------------------
+
+
+def _parent_map(node: ast.AST) -> Dict[ast.AST, ast.AST]:
+    parents: Dict[ast.AST, ast.AST] = {}
+    for parent in ast.walk(node):
+        for child in ast.iter_child_nodes(parent):
+            parents[child] = parent
+    return parents
+
+
+def _enclosing_function(
+    node: ast.AST, parents: Dict[ast.AST, ast.AST]
+) -> Optional[ast.AST]:
+    cur = parents.get(node)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return cur
+        cur = parents.get(cur)
+    return None
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """``self.X`` -> ``X`` (else None)."""
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def _call_chain_base_attr(call: ast.Call) -> Optional[str]:
+    """For ``self.G.labels(...).set(...)`` / ``self.G.set(...)`` /
+    ``self.C.labels(...).inc()`` return ``G``/``C`` — the self attribute at
+    the base of a method-call chain (else None)."""
+    cur: ast.AST = call.func
+    while True:
+        if isinstance(cur, ast.Attribute):
+            cur = cur.value
+        elif isinstance(cur, ast.Call):
+            cur = cur.func
+        else:
+            return None  # chain bottoms out at a bare name/subscript
+        attr = _self_attr(cur)
+        if attr is not None:
+            return attr
+
+
+def _const_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+# ---------------------------------------------------------------------------
+# forward-flag-parity
+# ---------------------------------------------------------------------------
+
+_PARITY_FILES = (
+    "kakveda_tpu/models/llama.py",
+    "kakveda_tpu/models/attention.py",
+    "kakveda_tpu/models/moe.py",
+    "kakveda_tpu/models/serving.py",
+    "kakveda_tpu/models/pipeline.py",
+)
+_PARITY_ROOTS = ("forward", "decode_step", "_forward_wide", "pp_forward")
+# Shape/arch parameters every path reads incidentally — not family flags,
+# excluded so the contract stays about behavior-bearing flags.
+_PARITY_IGNORE = frozenset({
+    "vocab_size", "d_model", "n_layers", "n_heads", "n_kv_heads", "d_ff",
+    "max_seq_len", "norm_eps", "dtype", "head_dim_opt",
+})
+# Params-presence flags: family deltas keyed on layer-dict membership
+# ("post_attn_norm" in layer) rather than a cfg read — tracked with the
+# same parity contract.
+_PARITY_LAYER_KEYS = frozenset({
+    "bq", "bk", "bv", "q_norm", "k_norm",
+    "post_attn_norm", "post_ffw_norm", "router",
+})
+# (root, flag) pairs exempt BY DESIGN — documented in docs/static-analysis.md:
+# kv_quant shapes the KV cache, which the full-sequence paths don't have;
+# effective_vocab masking happens at the sampler for the offline paths
+# (generate._last_logits / _admit_jit) but in-program for _forward_wide.
+_PARITY_EXEMPT: Set[Tuple[str, str]] = {
+    ("forward", "kv_quant"),
+    ("pp_forward", "kv_quant"),
+    ("forward", "effective_vocab"),
+    ("decode_step", "effective_vocab"),
+    ("pp_forward", "effective_vocab"),
+}
+
+
+class _FuncInfo:
+    __slots__ = ("reads", "keys", "calls", "rel", "line")
+
+    def __init__(self, rel: str, line: int):
+        self.reads: Set[str] = set()
+        self.keys: Set[str] = set()
+        self.calls: Set[str] = set()
+        self.rel = rel
+        self.line = line
+
+
+def _scan_parity_function(node, rel: str, receivers: Set[str]) -> _FuncInfo:
+    info = _FuncInfo(rel, node.lineno)
+    for n in ast.walk(node):
+        if isinstance(n, ast.Attribute) and isinstance(n.value, ast.Name):
+            if n.value.id in receivers:
+                info.reads.add(n.attr)
+        elif isinstance(n, ast.Call):
+            if isinstance(n.func, ast.Name):
+                info.calls.add(n.func.id)
+            elif isinstance(n.func, ast.Attribute):
+                v = n.func.value
+                if isinstance(v, ast.Name) and v.id in receivers:
+                    info.calls.add(n.func.attr)  # cfg.layer_window(li)
+        elif isinstance(n, ast.Compare) and len(n.ops) == 1:
+            if isinstance(n.ops[0], (ast.In, ast.NotIn)):
+                k = _const_str(n.left)
+                if (
+                    k in _PARITY_LAYER_KEYS
+                    and isinstance(n.comparators[0], ast.Name)
+                    and n.comparators[0].id == "layer"
+                ):
+                    info.keys.add(k)
+        elif isinstance(n, ast.Subscript):
+            if isinstance(n.value, ast.Name) and n.value.id == "layer":
+                k = _const_str(n.slice)
+                if k in _PARITY_LAYER_KEYS:
+                    info.keys.add(k)
+    return info
+
+
+@register
+class ForwardFlagParity(Rule):
+    id = "forward-flag-parity"
+    invariant = (
+        "every LlamaConfig feature flag read by llama.forward must also be "
+        "read (transitively) by decode_step, serving._forward_wide and "
+        "pipeline.pp_forward — the 'grep all four before adding a flag' "
+        "rule, automated"
+    )
+    scope = None  # tree rule: spans models/llama|serving|pipeline
+
+    def check_tree(self, ctx: TreeContext) -> List[Finding]:
+        funcs: Dict[str, _FuncInfo] = {}
+        fields: Optional[Set[str]] = None
+        for rel in _PARITY_FILES:
+            fc = ctx.by_rel.get(rel)
+            if fc is None or fc.tree is None:
+                continue
+            for node in fc.tree.body:
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    funcs.setdefault(
+                        node.name, _scan_parity_function(node, rel, {"cfg"})
+                    )
+                elif isinstance(node, ast.ClassDef) and node.name == "LlamaConfig":
+                    fields = {
+                        stmt.target.id
+                        for stmt in node.body
+                        if isinstance(stmt, ast.AnnAssign)
+                        and isinstance(stmt.target, ast.Name)
+                    }
+                    for m in node.body:
+                        if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                            # Config methods/properties (layer_window) read
+                            # flags through ``self``.
+                            funcs.setdefault(
+                                m.name,
+                                _scan_parity_function(m, rel, {"cfg", "self"}),
+                            )
+
+        roots = [r for r in _PARITY_ROOTS if r in funcs]
+        if len(roots) < 2:
+            return []  # nothing to compare (partial fixture tree)
+
+        def closure(root: str) -> Tuple[Set[str], Set[str]]:
+            reads: Set[str] = set()
+            keys: Set[str] = set()
+            seen: Set[str] = set()
+            stack = [root]
+            while stack:
+                name = stack.pop()
+                if name in seen or name not in funcs:
+                    continue
+                seen.add(name)
+                info = funcs[name]
+                reads |= info.reads
+                keys |= info.keys
+                stack.extend(info.calls)
+            if fields is not None:
+                reads &= fields
+            return reads - _PARITY_IGNORE, keys
+
+        per_root = {r: closure(r) for r in roots}
+        union_flags = set().union(*(f for f, _ in per_root.values()))
+        union_keys = set().union(*(k for _, k in per_root.values()))
+
+        out: List[Finding] = []
+        for root in roots:
+            flags, keys = per_root[root]
+            for flag in sorted(union_flags - flags):
+                if (root, flag) in _PARITY_EXEMPT:
+                    continue
+                others = sorted(r for r in roots if flag in per_root[r][0])
+                out.append(Finding(
+                    self.id, funcs[root].rel, funcs[root].line,
+                    f"forward path `{root}` never reads `cfg.{flag}` "
+                    f"(read by {', '.join(others)}); every forward path "
+                    "must honor every model-family flag",
+                ))
+            for key in sorted(union_keys - keys):
+                others = sorted(r for r in roots if key in per_root[r][1])
+                out.append(Finding(
+                    self.id, funcs[root].rel, funcs[root].line,
+                    f"forward path `{root}` never checks layer key "
+                    f"{key!r} (checked by {', '.join(others)}); every "
+                    "forward path must honor every params-keyed family flag",
+                ))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# single-writer
+# ---------------------------------------------------------------------------
+
+_SINGLE_WRITER = {
+    "kakveda_tpu/models/serving.py": ("_set_gate_state",),
+    "kakveda_tpu/core/admission.py": ("_set_brownout_state",),
+}
+_ANY_KEY = object()
+
+
+@register
+class SingleWriterTransitions(Rule):
+    id = "single-writer"
+    invariant = (
+        "the fields moved by _set_gate_state/_set_brownout_state (state "
+        "key, gauge vector, transition counter) are assigned nowhere else "
+        "in their class except __init__"
+    )
+    scope = tuple(_SINGLE_WRITER)
+
+    def visit_file(self, fc: FileContext, ctx: TreeContext) -> List[Finding]:
+        out: List[Finding] = []
+        for cls in ast.walk(fc.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            methods = {
+                m.name: m
+                for m in cls.body
+                if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef))
+            }
+            for helper_name in _SINGLE_WRITER[fc.rel]:
+                helper = methods.get(helper_name)
+                if helper is None:
+                    continue
+                attrs, subs, metrics = self._protected(helper)
+                for name, m in methods.items():
+                    if name in (helper_name, "__init__"):
+                        continue
+                    out.extend(
+                        self._violations(fc, m, helper_name, attrs, subs, metrics)
+                    )
+        return out
+
+    @staticmethod
+    def _protected(helper) -> Tuple[Set[str], Dict[str, set], Set[str]]:
+        """Derive the protected write-set from the helper's own body."""
+        attrs: Set[str] = set()          # self.X = …
+        subs: Dict[str, set] = {}        # self.X[key] = … (key set or ANY)
+        metrics: Set[str] = set()        # self.G.labels(...).set()/.inc()
+        for n in ast.walk(helper):
+            if isinstance(n, (ast.Assign, ast.AugAssign)):
+                targets = n.targets if isinstance(n, ast.Assign) else [n.target]
+                for t in targets:
+                    a = _self_attr(t)
+                    if a is not None:
+                        attrs.add(a)
+                    elif isinstance(t, ast.Subscript):
+                        base = _self_attr(t.value)
+                        if base is not None:
+                            key = _const_str(t.slice)
+                            subs.setdefault(base, set()).add(
+                                key if key is not None else _ANY_KEY
+                            )
+            elif isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute):
+                if n.func.attr in ("set", "inc", "dec"):
+                    base = _call_chain_base_attr(n)
+                    if base is not None:
+                        metrics.add(base)
+        return attrs, subs, metrics
+
+    def _violations(
+        self, fc, method, helper_name, attrs, subs, metrics
+    ) -> List[Finding]:
+        out: List[Finding] = []
+        # Local aliases of protected dict attrs (x = self.spec_stats).
+        aliases: Dict[str, str] = {}
+        for n in ast.walk(method):
+            if isinstance(n, ast.Assign) and len(n.targets) == 1:
+                t = n.targets[0]
+                base = _self_attr(n.value)
+                if (
+                    isinstance(t, ast.Name)
+                    and base is not None
+                    and (base in subs or base in attrs)
+                ):
+                    aliases[t.id] = base
+
+        def sub_base(node: ast.Subscript) -> Optional[str]:
+            b = _self_attr(node.value)
+            if b is not None:
+                return b
+            if isinstance(node.value, ast.Name):
+                return aliases.get(node.value.id)
+            return None
+
+        for n in ast.walk(method):
+            if isinstance(n, (ast.Assign, ast.AugAssign)):
+                targets = n.targets if isinstance(n, ast.Assign) else [n.target]
+                for t in targets:
+                    a = _self_attr(t)
+                    if a is not None and (a in attrs or a in subs):
+                        out.append(Finding(
+                            self.id, fc.rel, t.lineno,
+                            f"`self.{a}` is moved by {helper_name}() only; "
+                            f"direct assignment in {method.name}() bypasses "
+                            "the single-writer transition helper",
+                        ))
+                    elif isinstance(t, ast.Subscript):
+                        base = sub_base(t)
+                        if base in subs:
+                            key = _const_str(t.slice)
+                            protected = subs[base]
+                            if _ANY_KEY in protected or key in protected:
+                                out.append(Finding(
+                                    self.id, fc.rel, t.lineno,
+                                    f"`self.{base}[{key!r}]` is moved by "
+                                    f"{helper_name}() only; direct store in "
+                                    f"{method.name}() bypasses the "
+                                    "single-writer transition helper",
+                                ))
+            elif isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute):
+                if n.func.attr in ("set", "inc", "dec"):
+                    base = _call_chain_base_attr(n)
+                    if base in metrics:
+                        out.append(Finding(
+                            self.id, fc.rel, n.lineno,
+                            f"metric `self.{base}` is moved by "
+                            f"{helper_name}() only; direct "
+                            f".{n.func.attr}() in {method.name}() bypasses "
+                            "the single-writer transition helper",
+                        ))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# stats-lock
+# ---------------------------------------------------------------------------
+
+_STATS_ATTRS = frozenset({"spec_stats", "prefix_stats", "_stats"})
+_READ_GUARDED = ("spec_stats", "prefix_stats")
+_MUTATORS = frozenset({
+    "update", "setdefault", "pop", "popitem", "clear",
+    "append", "extend", "insert", "remove",
+})
+_SERVING_REL = "kakveda_tpu/models/serving.py"
+
+
+def _attr_anywhere(node: ast.AST, attr: str) -> bool:
+    return any(
+        isinstance(n, ast.Attribute) and n.attr == attr for n in ast.walk(node)
+    )
+
+
+@register
+class StatsLockDiscipline(Rule):
+    id = "stats-lock"
+    invariant = (
+        "mutations of the batcher/engine stats dicts (spec_stats, "
+        "prefix_stats, _stats) happen lexically inside `with …stats_lock`; "
+        "outside models/serving.py the spec/prefix stats are read only "
+        "through stats()/stats_snapshot()"
+    )
+    scope = None  # needs the whole tree for the external-read half
+
+    def check_tree(self, ctx: TreeContext) -> List[Finding]:
+        out: List[Finding] = []
+        for fc in ctx.files:
+            if fc.tree is None:
+                continue
+            if fc.rel == _SERVING_REL or fc.rel.startswith("tests/"):
+                continue
+            if fc.rel.startswith("kakveda_tpu/analysis/"):
+                continue  # the linter names the dicts without touching them
+            for n in ast.walk(fc.tree):
+                if isinstance(n, ast.Attribute) and n.attr in _READ_GUARDED:
+                    out.append(Finding(
+                        self.id, fc.rel, n.lineno,
+                        f"direct `{n.attr}` access outside the serving "
+                        "module — the loop thread mutates the live dicts; "
+                        "read through ServingEngine.stats() / "
+                        "ContinuousBatcher.stats_snapshot()",
+                    ))
+        fc = ctx.by_rel.get(_SERVING_REL)
+        if fc is not None and fc.tree is not None:
+            out.extend(self._check_serving(fc))
+        return out
+
+    def _check_serving(self, fc: FileContext) -> List[Finding]:
+        out: List[Finding] = []
+        parents = _parent_map(fc.tree)
+
+        def in_locked_with(node: ast.AST) -> bool:
+            cur = parents.get(node)
+            while cur is not None and not isinstance(
+                cur, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                if isinstance(cur, ast.With):
+                    for item in cur.items:
+                        if _attr_anywhere(item.context_expr, "stats_lock"):
+                            return True
+                cur = parents.get(cur)
+            return False
+
+        for func in ast.walk(fc.tree):
+            if not isinstance(func, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if func.name == "__init__":
+                continue  # construction publishes the dicts before any reader
+            # Aliases: s = self.spec_stats; kt = s["k_trace"] — anything
+            # reached from a stats dict counts as the stats dict.
+            aliases: Set[str] = set()
+            changed = True
+            while changed:
+                changed = False
+                for n in ast.walk(func):
+                    if isinstance(n, ast.Assign) and len(n.targets) == 1:
+                        t = n.targets[0]
+                        if isinstance(t, ast.Name) and t.id not in aliases:
+                            if self._is_stats_expr(n.value, aliases):
+                                aliases.add(t.id)
+                                changed = True
+
+            for n in ast.walk(func):
+                target = None
+                if isinstance(n, (ast.Assign, ast.AugAssign)):
+                    targets = n.targets if isinstance(n, ast.Assign) else [n.target]
+                    for t in targets:
+                        if isinstance(t, ast.Subscript) and self._is_stats_expr(
+                            t.value, aliases
+                        ):
+                            target = t
+                elif isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute):
+                    if n.func.attr in _MUTATORS and self._is_stats_expr(
+                        n.func.value, aliases
+                    ):
+                        target = n
+                elif isinstance(n, ast.Delete):
+                    for t in n.targets:
+                        if isinstance(t, ast.Subscript) and self._is_stats_expr(
+                            t.value, aliases
+                        ):
+                            target = t
+                if target is not None and not in_locked_with(target):
+                    out.append(Finding(
+                        self.id, fc.rel, target.lineno,
+                        f"stats mutation in {func.name}() outside a "
+                        "`with …stats_lock` block — the loop thread and "
+                        "readers race on these dicts",
+                    ))
+        return out
+
+    @staticmethod
+    def _is_stats_expr(node: ast.AST, aliases: Set[str]) -> bool:
+        if isinstance(node, ast.Attribute) and node.attr in _STATS_ATTRS:
+            return True
+        if isinstance(node, ast.Name) and node.id in aliases:
+            return True
+        if isinstance(node, ast.Subscript):
+            return StatsLockDiscipline._is_stats_expr(node.value, aliases)
+        return False
+
+
+# ---------------------------------------------------------------------------
+# host-sync
+# ---------------------------------------------------------------------------
+
+_NP_NAMES = frozenset({"np", "onp", "numpy"})
+
+
+@register
+class HostSyncHazards(Rule):
+    id = "host-sync"
+    invariant = (
+        "no host synchronization (.item()/.tolist()/np.asarray/float(arg)) "
+        "inside jit-compiled bodies in models/ and ops/, and no "
+        "jnp.asarray(self.<mirror>_np) upload without .copy() — the CPU "
+        "backend aliases numpy buffers zero-copy"
+    )
+    scope = ("kakveda_tpu/models/", "kakveda_tpu/ops/")
+
+    def visit_file(self, fc: FileContext, ctx: TreeContext) -> List[Finding]:
+        out: List[Finding] = []
+        jit_names: Set[str] = set()
+        func_nodes: Dict[str, ast.AST] = {}
+        jit_nodes: List[ast.AST] = []
+
+        for n in ast.walk(fc.tree):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                func_nodes.setdefault(n.name, n)
+                if any(self._is_jit_decorator(d) for d in n.decorator_list):
+                    jit_nodes.append(n)
+            elif isinstance(n, ast.Call):
+                # x = jax.jit(fn) / jax.jit(self._impl, …)
+                if self._is_jit_ref(n.func) and n.args:
+                    a = n.args[0]
+                    if isinstance(a, ast.Name):
+                        jit_names.add(a.id)
+                    elif isinstance(a, ast.Attribute):
+                        jit_names.add(a.attr)
+                # jax.lax.scan(body, …): body is traced like a jit fn
+                elif (
+                    isinstance(n.func, ast.Attribute)
+                    and n.func.attr == "scan"
+                    and n.args
+                    and isinstance(n.args[0], ast.Name)
+                ):
+                    jit_names.add(n.args[0].id)
+
+        for name in jit_names:
+            node = func_nodes.get(name)
+            if node is not None and node not in jit_nodes:
+                jit_nodes.append(node)
+
+        for func in jit_nodes:
+            params = {a.arg for a in func.args.args + func.args.kwonlyargs}
+            for n in ast.walk(func):
+                if not isinstance(n, ast.Call):
+                    continue
+                msg = None
+                if isinstance(n.func, ast.Attribute):
+                    if n.func.attr in ("item", "tolist"):
+                        msg = f".{n.func.attr}() forces a device→host sync"
+                    elif (
+                        n.func.attr in ("asarray", "array")
+                        and isinstance(n.func.value, ast.Name)
+                        and n.func.value.id in _NP_NAMES
+                    ):
+                        msg = (
+                            f"{n.func.value.id}.{n.func.attr}() on a traced "
+                            "value forces a device→host sync"
+                        )
+                    elif (
+                        n.func.attr == "device_get"
+                        and isinstance(n.func.value, ast.Name)
+                        and n.func.value.id == "jax"
+                    ):
+                        msg = "jax.device_get() forces a device→host sync"
+                elif (
+                    isinstance(n.func, ast.Name)
+                    and n.func.id in ("float", "int", "bool")
+                    and len(n.args) == 1
+                    and isinstance(n.args[0], ast.Name)
+                    and n.args[0].id in params
+                ):
+                    msg = (
+                        f"{n.func.id}() on traced argument "
+                        f"`{n.args[0].id}` forces a device→host sync"
+                    )
+                if msg is not None:
+                    out.append(Finding(
+                        self.id, fc.rel, n.lineno,
+                        f"inside jit-compiled `{func.name}`: {msg} "
+                        "(~70-90 ms wire RTT per dispatch on tunneled TPUs)",
+                    ))
+
+        # Mutable-mirror aliasing: jnp.asarray(self.<x>_np) without .copy().
+        for n in ast.walk(fc.tree):
+            if (
+                isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Attribute)
+                and n.func.attr == "asarray"
+                and isinstance(n.func.value, ast.Name)
+                and n.func.value.id == "jnp"
+                and n.args
+                and isinstance(n.args[0], ast.Attribute)
+                and n.args[0].attr.endswith("_np")
+            ):
+                out.append(Finding(
+                    self.id, fc.rel, n.lineno,
+                    f"jnp.asarray(…{n.args[0].attr}) without .copy(): on the "
+                    "CPU backend the upload aliases the mutating numpy "
+                    "mirror zero-copy (flaky garbage logits)",
+                ))
+        return out
+
+    @staticmethod
+    def _is_jit_ref(node: ast.AST) -> bool:
+        return (isinstance(node, ast.Name) and node.id == "jit") or (
+            isinstance(node, ast.Attribute) and node.attr == "jit"
+        )
+
+    @classmethod
+    def _is_jit_decorator(cls, dec: ast.AST) -> bool:
+        if cls._is_jit_ref(dec):
+            return True
+        if isinstance(dec, ast.Call):
+            if cls._is_jit_ref(dec.func):
+                return True
+            # @partial(jax.jit, static_argnames=…)
+            if (
+                isinstance(dec.func, ast.Name) and dec.func.id == "partial"
+            ) or (
+                isinstance(dec.func, ast.Attribute) and dec.func.attr == "partial"
+            ):
+                return any(cls._is_jit_ref(a) for a in dec.args)
+        return False
+
+
+# ---------------------------------------------------------------------------
+# typed-errors
+# ---------------------------------------------------------------------------
+
+_TYPED_ERRORS = frozenset({
+    "OverloadError", "DeviceUnavailableError", "EngineDeadError",
+    "EngineRetryableError", "DeadlineExceededError",
+})
+_BROAD = frozenset({"Exception", "BaseException"})
+# Calls whose raise surface includes the typed errors above.
+_TYPED_SOURCES = frozenset({
+    "submit", "generate_ids", "register_prefix", "try_admit", "admit",
+    "shed", "slot", "check",
+})
+_PROPAGATORS = frozenset({"set_exception", "_fail", "fail", "note_failure"})
+
+
+@register
+class TypedErrorDiscipline(Rule):
+    id = "typed-errors"
+    invariant = (
+        "no broad `except Exception` that swallows "
+        "OverloadError/DeviceUnavailableError/EngineDeadError around "
+        "admission/engine calls on service paths — shed work must surface "
+        "as 429, never take the solo-decode fallback"
+    )
+    scope = (
+        "kakveda_tpu/service/",
+        "kakveda_tpu/cli/",
+        "kakveda_tpu/core/admission.py",
+        "kakveda_tpu/models/serving.py",
+        "kakveda_tpu/models/generate.py",
+        "kakveda_tpu/models/runtime.py",
+    )
+
+    def visit_file(self, fc: FileContext, ctx: TreeContext) -> List[Finding]:
+        out: List[Finding] = []
+        for n in ast.walk(fc.tree):
+            if not isinstance(n, ast.Try):
+                continue
+            typed_handled = False
+            for h in n.handlers:
+                names = self._handler_names(h)
+                if names & _TYPED_ERRORS:
+                    typed_handled = True
+                    continue
+                broad = h.type is None or (names & _BROAD)
+                if not broad or typed_handled:
+                    continue
+                if not self._body_calls_typed_source(n.body):
+                    continue
+                if self._handler_propagates(h):
+                    continue
+                out.append(Finding(
+                    self.id, fc.rel, h.lineno,
+                    "broad except around a typed-error source "
+                    "(admission/engine call in this try) swallows "
+                    "OverloadError/DeviceUnavailableError/EngineDeadError; "
+                    "catch the typed errors first, re-raise, or propagate "
+                    "the original exception",
+                ))
+        return out
+
+    @staticmethod
+    def _handler_names(h: ast.ExceptHandler) -> Set[str]:
+        names: Set[str] = set()
+        if h.type is None:
+            return names
+        nodes = h.type.elts if isinstance(h.type, ast.Tuple) else [h.type]
+        for t in nodes:
+            if isinstance(t, ast.Name):
+                names.add(t.id)
+            elif isinstance(t, ast.Attribute):
+                names.add(t.attr)
+        return names
+
+    @staticmethod
+    def _body_calls_typed_source(body) -> bool:
+        for stmt in body:
+            for n in ast.walk(stmt):
+                if isinstance(n, ast.Call):
+                    f = n.func
+                    name = (
+                        f.attr if isinstance(f, ast.Attribute)
+                        else f.id if isinstance(f, ast.Name) else None
+                    )
+                    if name in _TYPED_SOURCES:
+                        return True
+        return False
+
+    @staticmethod
+    def _handler_propagates(h: ast.ExceptHandler) -> bool:
+        for n in ast.walk(h):
+            if isinstance(n, ast.Raise):
+                if n.exc is None:
+                    return True  # bare re-raise keeps the type
+                if isinstance(n.exc, ast.Call):
+                    f = n.exc.func
+                    name = f.id if isinstance(f, ast.Name) else (
+                        f.attr if isinstance(f, ast.Attribute) else None
+                    )
+                    if name in _TYPED_ERRORS:
+                        return True
+            elif isinstance(n, ast.Call) and h.name is not None:
+                f = n.func
+                name = (
+                    f.attr if isinstance(f, ast.Attribute)
+                    else f.id if isinstance(f, ast.Name) else None
+                )
+                if name in _PROPAGATORS and any(
+                    isinstance(a, ast.Name) and a.id == h.name for a in n.args
+                ):
+                    return True
+        return False
+
+
+# ---------------------------------------------------------------------------
+# fault-site-once
+# ---------------------------------------------------------------------------
+
+
+@register
+class FaultSiteOnce(Rule):
+    id = "fault-site-once"
+    invariant = (
+        "faults.site(\"…\") resolves ONCE at construction (module import "
+        "or __init__) — the hot path calls .fire() on the kept reference, "
+        "never re-resolves"
+    )
+    scope = ("kakveda_tpu/", "bench.py", "scripts/", "__graft_entry__.py")
+
+    def visit_file(self, fc: FileContext, ctx: TreeContext) -> List[Finding]:
+        if fc.rel == "kakveda_tpu/core/faults.py":
+            return []  # the registry itself
+        out: List[Finding] = []
+        parents = None
+        for n in ast.walk(fc.tree):
+            if not (
+                isinstance(n, ast.Call)
+                and isinstance(n.func, (ast.Name, ast.Attribute))
+                and (
+                    n.func.id == "site"
+                    if isinstance(n.func, ast.Name)
+                    else n.func.attr == "site"
+                )
+                and n.args
+            ):
+                continue
+            name = _const_str(n.args[0])
+            if name is None or "." not in name:
+                continue
+            if parents is None:
+                parents = _parent_map(fc.tree)
+            func = _enclosing_function(n, parents)
+            if func is None or func.name == "__init__":
+                continue  # construction / import time: the contract
+            out.append(Finding(
+                self.id, fc.rel, n.lineno,
+                f"fault site {name!r} resolved inside {func.name}() — "
+                "resolve once at construction and keep the reference "
+                "(unarmed fire() is a bare attribute check; site() takes "
+                "a lock)",
+            ))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# fault-site-catalog + knob-docs (check_knobs, as rules)
+# ---------------------------------------------------------------------------
+
+
+def _evidence(ctx: TreeContext, files: List[str], needle: str) -> Tuple[str, int]:
+    """(file, line) of the first reference to ``needle`` among ``files``."""
+    for rel in files:
+        fc = ctx.by_rel.get(str(rel).replace("\\", "/"))
+        if fc is not None:
+            return fc.rel, fc.find_line(needle)
+    return files[0] if files else "?", 1
+
+
+@register
+class FaultSiteCatalog(Rule):
+    id = "fault-site-catalog"
+    invariant = (
+        "every fault site registered in code appears in the "
+        "docs/robustness.md catalog — the only surface operators can "
+        "discover KAKVEDA_FAULTS arms from"
+    )
+    scope = None
+
+    def check_tree(self, ctx: TreeContext) -> List[Finding]:
+        out: List[Finding] = []
+        for site, files in _knobs.undocumented_fault_sites(ctx.root).items():
+            rel, line = _evidence(ctx, files, site)
+            out.append(Finding(
+                self.id, rel, line,
+                f"fault site {site!r} is registered here but missing from "
+                "the docs/robustness.md catalog",
+            ))
+        return out
+
+
+@register
+class KnobDocsParity(Rule):
+    id = "knob-docs"
+    invariant = (
+        "every KAKVEDA_* knob the code reads is documented, and every "
+        "documented knob is still read (no dead-knob drift)"
+    )
+    scope = None
+
+    def check_tree(self, ctx: TreeContext) -> List[Finding]:
+        out: List[Finding] = []
+        for knob, files in _knobs.undocumented_knobs(ctx.root).items():
+            rel, line = _evidence(ctx, files, knob)
+            out.append(Finding(
+                self.id, rel, line,
+                f"env knob {knob} is read here but documented nowhere "
+                "(CLAUDE.md / docs/) — an undocumented knob is an outage "
+                "waiting for an operator",
+            ))
+        for knob in _knobs.dead_knobs(ctx.root):
+            rel, line = "docs", 1
+            for md in _discovery.md_files(ctx.root):
+                try:
+                    text = md.read_text(errors="replace")
+                except OSError:
+                    continue
+                if knob in text:
+                    rel = md.relative_to(ctx.root).as_posix()
+                    line = next(
+                        (i for i, ln in enumerate(text.splitlines(), 1) if knob in ln),
+                        1,
+                    )
+                    break
+            out.append(Finding(
+                self.id, rel, line,
+                f"env knob {knob} is documented but no code reads it — "
+                "dead-knob drift sends operators tuning a no-op",
+            ))
+        return out

@@ -263,8 +263,12 @@ class BrownoutController:
     def reset(self) -> None:
         with self._lock:
             self._set_brownout_state(0, 0.0)
-            self._occupancy = {s: 0.0 for s in BROWNOUT_STATES}
-            self._entered_at = time.monotonic()
+            # Deliberate direct writes AFTER the single-writer helper ran:
+            # reset() re-zeroes the occupancy HISTORY (tests, bench phase
+            # boundaries) — not a ladder transition, which the helper above
+            # already performed with full gauge/counter/recorder movement.
+            self._occupancy = {s: 0.0 for s in BROWNOUT_STATES}  # kakveda: allow[single-writer]
+            self._entered_at = time.monotonic()  # kakveda: allow[single-writer]
 
 
 class AdmissionController:

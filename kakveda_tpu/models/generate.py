@@ -680,7 +680,11 @@ class LlamaRuntime:
         ids = self.tokenizer.encode(prefix)
         try:
             return eng.register_prefix(ids)
-        except Exception:  # noqa: BLE001 — a failed registration must not break serving
+        except (RuntimeError, TimeoutError):
+            # A failed registration must not break serving: engine
+            # closed/dead (RuntimeError family) or a saturated pool timing
+            # the registration future out. Deliberately NOT a broad
+            # except — OverloadError/DeviceUnavailableError must surface.
             return False
 
     def serving_stats(self) -> dict:
@@ -790,11 +794,14 @@ class LlamaRuntime:
                     if len(common) >= 16:
                         try:
                             eng.register_prefix(list(common))
-                        except Exception:  # noqa: BLE001 — registration is an
-                            # optimization only: engine closed mid-flight
-                            # (RuntimeError) or a saturated pool timing out
-                            # the registration future (TimeoutError) must
-                            # not fail the batch itself.
+                        except (RuntimeError, TimeoutError):
+                            # Registration is an optimization only: engine
+                            # closed mid-flight (RuntimeError) or a
+                            # saturated pool timing out the registration
+                            # future must not fail the batch itself. Typed
+                            # admission errors are NOT RuntimeErrors and
+                            # still surface (docs/static-analysis.md,
+                            # typed-errors).
                             pass
                 with profiling.annotate("llama.generate_batch_online"):
                     futs = [eng.submit(i, max_new_tokens=max_tokens) for i in ids]

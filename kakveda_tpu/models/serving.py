@@ -655,24 +655,27 @@ class ContinuousBatcher:
     def _set_gate_state(self, new: str) -> None:
         """ONE definition of a gate transition: spec_stats, the state
         gauge vector, the transition counter and the flight recorder move
-        together. Caller holds ``stats_lock``."""
-        old = self.spec_stats["gate_state"]
-        if new == old:
-            return
-        self.spec_stats["gate_state"] = new
-        self._gate_gauge.labels(engine=self.name, state=old).set(0.0)
-        self._gate_gauge.labels(engine=self.name, state=new).set(1.0)
-        self._gate_transitions.labels(
-            **{"engine": self.name, "from": old, "to": new}
-        ).inc()
-        if self.recorder is not None:
-            self.recorder.record(
-                "gate", **{
-                    "from": old, "to": new,
-                    "tokens_per_verify": self.spec_stats["tokens_per_verify"],
-                    "break_even": self.spec_stats["break_even"],
-                }
-            )
+        together. Takes ``stats_lock`` itself (RLock — callers already
+        inside a locked section just re-enter), so the transition is
+        atomic even from a caller that forgot the lock."""
+        with self.stats_lock:
+            old = self.spec_stats["gate_state"]
+            if new == old:
+                return
+            self.spec_stats["gate_state"] = new
+            self._gate_gauge.labels(engine=self.name, state=old).set(0.0)
+            self._gate_gauge.labels(engine=self.name, state=new).set(1.0)
+            self._gate_transitions.labels(
+                **{"engine": self.name, "from": old, "to": new}
+            ).inc()
+            if self.recorder is not None:
+                self.recorder.record(
+                    "gate", **{
+                        "from": old, "to": new,
+                        "tokens_per_verify": self.spec_stats["tokens_per_verify"],
+                        "break_even": self.spec_stats["break_even"],
+                    }
+                )
 
     def _match_prefix(self, prompt_ids: List[int]):
         """Longest registered prefix of ``prompt_ids`` plus the suffix-chunk
@@ -1658,7 +1661,11 @@ class ServingEngine:
         for ids in list(self._prefix_ids):
             try:
                 self.cb.register_prefix(list(ids))
-            except Exception as e:  # noqa: BLE001 — prefix reuse is an optimization
+            # Prefix reuse is an optimization: a rebuild must come up even
+            # if a registration prefill fails (compile error on the fresh
+            # batcher, OOM, …). The batcher's register_prefix raises no
+            # typed admission errors, so nothing shed-shaped is swallowed.
+            except Exception as e:  # noqa: BLE001  # kakveda: allow[typed-errors]
                 log.warning(
                     "prefix re-registration failed after engine restart: %s", e
                 )

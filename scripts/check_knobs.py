@@ -9,13 +9,15 @@ levers (KAKVEDA_SERVE_*), the bench sweep controls and the metrics-plane
 sizing all change production behavior, and the only discoverable surface
 is the docs. The converse rots just as fast: a knob the docs still teach
 but the code no longer reads sends an operator tuning a no-op mid-
-incident. This script greps the *code* tree for knob references and the
-*docs* corpus (CLAUDE.md, README.md, TROUBLESHOOTING.md, BASELINE.md,
-docs/**/*.md) for mentions; anything referenced-but-undocumented OR
-documented-but-unreferenced fails the check. Fault sites get the same
-treatment because an operator can only arm (``KAKVEDA_FAULTS``) what the
-catalog names — the site list grew three PRs straight with nothing
-guarding the docs. Runs in tier-1 via tests/test_knobs.py.
+incident. Fault sites get the same treatment because an operator can only
+arm (``KAKVEDA_FAULTS``) what the catalog names.
+
+The scanning logic lives in :mod:`kakveda_tpu.analysis.knobs` — shared
+with the invariant linter's ``knob-docs`` and ``fault-site-catalog``
+rules (scripts/lint_invariants.py, docs/static-analysis.md) so both
+entry points walk ONE tree discovery helper
+(:mod:`kakveda_tpu.analysis.discovery`) instead of two divergent walkers.
+This CLI is kept for muscle memory and tier-1 (tests/test_knobs.py).
 
 Usage: ``python scripts/check_knobs.py [repo_root]`` — exits nonzero and
 lists the offending knobs/sites on stdout.
@@ -23,136 +25,31 @@ lists the offending knobs/sites on stdout.
 
 from __future__ import annotations
 
-import re
 import sys
 from pathlib import Path
 
-KNOB_RE = re.compile(r"KAKVEDA_[A-Z0-9_]+")
-# A fault-site registration in code: faults.site("engine.dispatch") /
-# _faults.site("gfkb.append"). Dotted lowercase names only — the call in
-# core/faults.py's own site() definition has no literal and never matches.
-SITE_RE = re.compile(r"""\bsite\(\s*["']([a-z0-9_]+(?:\.[a-z0-9_]+)+)["']\s*\)""")
+# Script-mode bootstrap: `python scripts/check_knobs.py` puts scripts/ on
+# sys.path, not the repo root the package import needs.
+_REPO = Path(__file__).resolve().parents[1]
+if str(_REPO) not in sys.path:
+    sys.path.insert(0, str(_REPO))
 
-# Code that can introduce operator-facing knobs. Tests are deliberately
-# excluded: KAKVEDA_TEST_* style fixtures are not operator surface.
-CODE_PATHS = ("kakveda_tpu", "scripts", "bench.py", "__graft_entry__.py")
-DOC_PATHS = ("CLAUDE.md", "README.md", "TROUBLESHOOTING.md", "BASELINE.md", "docs")
-
-# Internal/cross-process plumbing set by our own launchers, not operators.
-ALLOWLIST = frozenset({
-    "KAKVEDA_PROCESS_ID",  # set per-process by the multihost launcher
-    "KAKVEDA_TEST_PLATFORM",  # test-suite lever (tests/conftest.py), named here
-})
-
-# Knobs the docs legitimately mention without the scanned code tree reading
-# them — test-surface levers (tests/ is excluded from CODE_PATHS on
-# purpose) and docs-about-the-docs. Anything else documented-but-unread is
-# dead-knob drift and fails.
-DOC_ONLY_ALLOWLIST = frozenset({
-    "KAKVEDA_TEST_PLATFORM",  # tests/conftest.py: run the suite on real TPU
-    # tests/test_hf_integration.py: prompt/expectation for the real-weight
-    # integration test (tests/ is outside the code scan)
-    "KAKVEDA_HF_PROMPT",
-    "KAKVEDA_HF_EXPECT",
-})
-
-
-def _md_files(root: Path):
-    for rel in DOC_PATHS:
-        p = root / rel
-        if p.is_file():
-            yield p
-        elif p.is_dir():
-            yield from sorted(p.rglob("*.md"))
-
-
-def _code_files(root: Path):
-    for rel in CODE_PATHS:
-        p = root / rel
-        if p.is_file():
-            yield p
-        elif p.is_dir():
-            yield from sorted(p.rglob("*.py"))
-
-
-def referenced_knobs(root: Path) -> dict:
-    """knob -> sorted list of repo-relative files referencing it."""
-    refs: dict = {}
-    for f in _code_files(root):
-        try:
-            text = f.read_text(errors="replace")
-        except OSError:
-            continue
-        for m in set(KNOB_RE.findall(text)):
-            if m.rstrip("_") != m or m == "KAKVEDA_":
-                continue
-            refs.setdefault(m, []).append(str(f.relative_to(root)))
-    for files in refs.values():
-        files.sort()
-    return refs
-
-
-def documented_knobs(root: Path) -> set:
-    docs: set = set()
-    for f in _md_files(root):
-        try:
-            docs.update(KNOB_RE.findall(f.read_text(errors="replace")))
-        except OSError:
-            continue
-    return docs
-
-
-def undocumented_knobs(root: Path) -> dict:
-    """knob -> referencing files, for every knob the docs never mention."""
-    refs = referenced_knobs(root)
-    docs = documented_knobs(root)
-    return {
-        k: v for k, v in sorted(refs.items())
-        if k not in docs and k not in ALLOWLIST
-    }
-
-
-def registered_fault_sites(root: Path) -> dict:
-    """site name -> sorted list of repo-relative files registering it."""
-    refs: dict = {}
-    for f in _code_files(root):
-        try:
-            text = f.read_text(errors="replace")
-        except OSError:
-            continue
-        for m in set(SITE_RE.findall(text)):
-            refs.setdefault(m, []).append(str(f.relative_to(root)))
-    for files in refs.values():
-        files.sort()
-    return refs
-
-
-def undocumented_fault_sites(root: Path) -> dict:
-    """Registered sites docs/robustness.md never mentions — the catalog is
-    the only surface an operator can discover KAKVEDA_FAULTS arms from."""
-    doc = root / "docs" / "robustness.md"
-    try:
-        text = doc.read_text(errors="replace")
-    except OSError:
-        text = ""
-    return {k: v for k, v in sorted(registered_fault_sites(root).items())
-            if k not in text}
-
-
-def dead_knobs(root: Path) -> list:
-    """Documented knobs the code no longer references — dead-knob drift."""
-    refs = referenced_knobs(root)
-    docs = documented_knobs(root)
-    return sorted(
-        k for k in docs
-        if k not in refs
-        and k not in DOC_ONLY_ALLOWLIST
-        and k.rstrip("_") == k and k != "KAKVEDA_"
-    )
+from kakveda_tpu.analysis.knobs import (  # noqa: E402,F401 — re-exported API
+    ALLOWLIST,
+    DOC_ONLY_ALLOWLIST,
+    KNOB_RE,
+    SITE_RE,
+    dead_knobs,
+    documented_knobs,
+    referenced_knobs,
+    registered_fault_sites,
+    undocumented_fault_sites,
+    undocumented_knobs,
+)
 
 
 def main(argv: list) -> int:
-    root = Path(argv[1]).resolve() if len(argv) > 1 else Path(__file__).resolve().parents[1]
+    root = Path(argv[1]).resolve() if len(argv) > 1 else _REPO
     missing = undocumented_knobs(root)
     dead = dead_knobs(root)
     missing_sites = undocumented_fault_sites(root)
