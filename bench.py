@@ -6,9 +6,11 @@ warn-under-decode and pattern mining, and prints ONE JSON line —
 headline = the warn north star, with the rest under ``extra_metrics`` so
 the driver's BENCH_r{N}.json carries every number.
 ``KAKVEDA_BENCH_METRIC=warn|ingest|decode|spec|continuous|mixed|
-mixed-decode|mine|serve|overload`` runs a single metric instead
+mixed-decode|mine|serve|overload|tiered`` runs a single metric instead
 (``overload`` floods the HTTP tier past its admission bounds and proves
-shedding keeps warn p95 bounded — docs/robustness.md).
+shedding keeps warn p95 bounded; ``tiered`` A/Bs the IVF-routed tiered
+GFKB against the exact oracle at 1M rows plus a 10M host/disk arm —
+docs/robustness.md, docs/performance.md § tiered).
 
 == warn: pre-flight warning p50 latency at a 1M-entry GFKB.
 
@@ -2123,6 +2125,161 @@ def _bench_continuous(backend: str) -> dict:
     }
 
 
+def _bench_tiered(backend: str) -> dict:
+    """Tiered-GFKB routing A/B, self-certifying vs the exact oracle (the
+    ``mine`` metric's style): build a clustered sparse corpus through the
+    REAL tier insert path (warm RAM + IVF router; the big arm spills most
+    rows to cold memmap shards), then answer the same queries twice —
+    routed (nprobe candidate lists, exact top-k over candidates) and the
+    exact full scan — and report recall@1 plus both latency distributions.
+    The acceptance bar (ISSUE 7): routed p50 ≤ 0.25× exact p50 at 1M rows
+    with recall@1 ≥ 0.99, and a ≥10M-row corpus running end-to-end via the
+    host/disk tiers. Host-only by design: the tiers exist precisely for
+    rows the device cannot hold, so this metric survives a chip outage.
+    """
+    from kakveda_tpu.index.tiers import TierConfig, TieredIndex
+
+    n = int(os.environ.get("KAKVEDA_BENCH_TIERED_N", 1 << 20))
+    dim = int(os.environ.get("KAKVEDA_BENCH_TIERED_DIM", 2048))
+    n_queries = int(os.environ.get("KAKVEDA_BENCH_TIERED_QUERIES", 128))
+    big_n = int(os.environ.get("KAKVEDA_BENCH_TIERED_BIG_N", 10_000_000))
+    print(
+        f"bench[tiered]: n={n} dim={dim} queries={n_queries} big_n={big_n}",
+        file=sys.stderr,
+    )
+
+    rng = np.random.default_rng(7)
+    K = 16  # nnz per synthetic row (hashed-ngram rows are similarly sparse)
+
+    def make_rows(n_rows: int, n_templates: int, batch: int):
+        """Yield (slots, idx, val, template_ids) batches: each template
+        owns K stable feature buckets; rows jitter the weights and swap
+        in 2 noise features — clustered like real failure signatures."""
+        tmpl_feats = rng.integers(0, dim, size=(n_templates, K), dtype=np.int64)
+        for s in range(0, n_rows, batch):
+            e = min(n_rows, s + batch)
+            t = rng.integers(0, n_templates, size=e - s)
+            idx = tmpl_feats[t].astype(np.int32)
+            val = (1.0 + 0.1 * rng.standard_normal((e - s, K))).astype(np.float32)
+            noise = rng.integers(0, dim, size=(e - s, 2))
+            idx[:, K - 2 :] = noise
+            val /= np.maximum(np.linalg.norm(val, axis=1, keepdims=True), 1e-9)
+            yield np.arange(s, e, dtype=np.int64), idx, val, t
+
+    def build(n_rows: int, n_templates: int, cfg: TierConfig, data_dir=None):
+        tiers = TieredIndex(dim, cfg, data_dir)
+        templates = np.empty(n_rows, np.int64)
+        t0 = time.perf_counter()
+        for slots, idx, val, t in make_rows(n_rows, n_templates, 8192):
+            tiers.insert(slots, idx, val)
+            templates[slots[0] : slots[-1] + 1] = t
+        return tiers, templates, time.perf_counter() - t0
+
+    def make_queries(tiers, n_rows: int, m: int):
+        """Noisy copies of random stored rows — built ONCE so the routed
+        and exact arms answer the identical query set."""
+        out = []
+        for s in rng.integers(0, n_rows, size=m).tolist():
+            row = tiers.row(int(s))
+            q_idx = row[0].astype(np.int32)
+            q_val = row[1] + 0.05 * rng.standard_normal(len(row[1])).astype(np.float32)
+            q_val /= max(float(np.linalg.norm(q_val)), 1e-9)
+            out.append((q_idx, q_val))
+        return out
+
+    def run_queries(tiers, queries, exact: bool):
+        lat, top1, scores1 = [], [], []
+        for q_idx, q_val in queries:
+            t0 = time.perf_counter()
+            sc, sl, _mode = tiers.match_host(q_idx, q_val, 5, exact=exact)
+            lat.append((time.perf_counter() - t0) * 1000.0)
+            top1.append(int(sl[0]) if len(sl) else -1)
+            scores1.append(float(sc[0]) if len(sc) else -np.inf)
+        return np.asarray(lat), np.asarray(top1), np.asarray(scores1)
+
+    # --- 1M arm: warm-resident, routed vs exact on the same corpus -----
+    cfg = TierConfig(
+        tiered=True, hot_rows=0, warm_rows=1 << 62, nprobe=8,
+        max_list=1 << 62, promote_cache=4096,
+    )
+    tiers, templates, build_s = build(n, 1024, cfg)
+    print(
+        f"bench[tiered]: built {n:,} rows in {build_s:.1f}s "
+        f"({tiers.info()['centroids']} centroids)", file=sys.stderr,
+    )
+    queries = make_queries(tiers, n, n_queries)
+    lat_r, top_r, sc_r = run_queries(tiers, queries, exact=False)
+    lat_e, top_e, sc_e = run_queries(tiers, queries, exact=True)
+    # recall@1: routed top-1 matches the oracle slot, or ties its score
+    # (duplicate templates make exact ties common).
+    recall = float(np.mean((top_r == top_e) | (sc_r >= sc_e - 1e-5)))
+    p50_r, p95_r = float(np.percentile(lat_r, 50)), float(np.percentile(lat_r, 95))
+    p50_e, p95_e = float(np.percentile(lat_e, 50)), float(np.percentile(lat_e, 95))
+    ratio = p50_r / p50_e if p50_e > 0 else float("inf")
+    print(
+        f"bench[tiered]: routed p50={p50_r:.3f}ms p95={p95_r:.3f}ms | exact "
+        f"p50={p50_e:.3f}ms p95={p95_e:.3f}ms | ratio={ratio:.3f} "
+        f"recall@1={recall:.4f}", file=sys.stderr,
+    )
+
+    # --- big arm: ≥10M rows end-to-end through warm + cold (disk) ------
+    big = {}
+    if big_n > 0:
+        import tempfile
+        from pathlib import Path
+
+        with tempfile.TemporaryDirectory(prefix="kakveda-tiered-") as td:
+            cfg_big = TierConfig(
+                tiered=True, hot_rows=0, warm_rows=1 << 20, nprobe=4,
+                max_list=1 << 62, promote_cache=8192,
+                cold_dir=Path(td) / "cold",
+            )
+            tiers_b, _tmpl, build_big_s = build(big_n, 256, cfg_big)
+            info = tiers_b.info()
+            print(
+                f"bench[tiered]: big arm {big_n:,} rows in {build_big_s:.1f}s "
+                f"(warm={info['warm']:,} cold={info['cold']:,})",
+                file=sys.stderr,
+            )
+            queries_b = make_queries(tiers_b, big_n, 32)
+            lat_b, top_b, sc_b = run_queries(tiers_b, queries_b, exact=False)
+            # sampled oracle: the exact scan is O(N) at 10M — certify
+            # recall on a subset of the same queries
+            m_oracle = 8
+            lat_be, top_be, sc_be = run_queries(tiers_b, queries_b[:m_oracle], exact=True)
+            big = {
+                "n": big_n,
+                "build_s": round(build_big_s, 1),
+                "warm_rows": int(info["warm"]),
+                "cold_rows": int(info["cold"]),
+                "routed_p50_ms": round(float(np.percentile(lat_b, 50)), 3),
+                "routed_p95_ms": round(float(np.percentile(lat_b, 95)), 3),
+                "exact_p50_ms": round(float(np.percentile(lat_be, 50)), 3),
+                "recall_at1_sampled": round(
+                    float(np.mean((top_b[:m_oracle] == top_be) | (sc_b[:m_oracle] >= sc_be - 1e-5))), 4
+                ),
+            }
+
+    return {
+        "metric": f"tiered_warn_routed_p50_ms_at_{n}",
+        "value": round(p50_r, 3),
+        "unit": "ms",
+        # headline self-certification: exact-scan p50 over routed p50 —
+        # ≥4 means the ≤0.25× sublinear bar holds.
+        "vs_baseline": round(p50_e / p50_r, 1) if p50_r > 0 else 0.0,
+        "recall_at1": round(recall, 4),
+        "exact_p50_ms": round(p50_e, 3),
+        "exact_p95_ms": round(p95_e, 3),
+        "routed_p95_ms": round(p95_r, 3),
+        "sublinear_ratio": round(ratio, 4),
+        "sublinear_ok": bool(ratio <= 0.25),
+        "recall_ok": bool(recall >= 0.99),
+        "build_s": round(build_s, 1),
+        "centroids": int(tiers.info()["centroids"]),
+        "big": big,
+    }
+
+
 def _metrics_plane() -> dict:
     """Compact snapshot of the process-global metrics registry, folded
     into every emitted bench JSON line: BENCH_*.json then carries the
@@ -2332,6 +2489,7 @@ def main() -> int:
         "pallas": _bench_pallas,
         "serve": _bench_serve,
         "overload": _bench_overload,
+        "tiered": _bench_tiered,
     }
     if which in fns:
         out = fns[which](backend)
@@ -2374,6 +2532,7 @@ def main() -> int:
         _bench_mixed,
         _bench_mixed_decode,
         _bench_mine,
+        _bench_tiered,
     )
     for fn in order:
         if fn.__name__ in done:

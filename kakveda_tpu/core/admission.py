@@ -26,8 +26,10 @@ the same observability discipline as the spec gate):
   single-definition discipline).
 * :class:`DeviceHealth` — the device-loss latch. A ``device.unavailable``
   fault site (chaos harness) or a REAL backend error observed on a device
-  path latches DEGRADED: the warn path falls back to the host-side kNN
-  (``GFKB.match_batch_host``), generation fails fast with a typed
+  path latches DEGRADED: the warn path serves from the GFKB's host
+  warm/cold tiers (``GFKB.match_batch_fallback``, the same storage
+  hierarchy that absorbs overflow — index/tiers.py), generation fails
+  fast with a typed
   retryable :class:`DeviceUnavailableError` + retry hint, and a background
   probe thread re-tests the backend (a tiny compiled op) until it answers,
   then un-latches. The probe never kills or restarts anything — a wedged
@@ -550,8 +552,8 @@ class DeviceHealth:
     * hot paths that would touch the device call :meth:`check` first and
       fail FAST with :class:`DeviceUnavailableError` (< 1 s, never a hang
       into a wedged dispatch);
-    * the warn path serves from the host fallback index (degraded but
-      alive);
+    * the warn path serves from the GFKB's host warm/cold tiers (degraded
+      but alive);
     * one daemon probe thread retries a tiny device op every
       ``KAKVEDA_DEGRADED_PROBE`` seconds. Success un-latches. The probe
       NEVER kills the wedged process or backend — a remote TPU lease that

@@ -144,10 +144,17 @@ class WarningResponse(BaseModel):
     pattern_id: Optional[str] = None
     references: List[FailureMatch] = Field(default_factory=list)
     message: str
-    # True when the verdict was served by the host-side fallback index
+    # True when the verdict was served by the host-side warm/cold tiers
     # because the accelerator backend is latched DEGRADED (device-loss
     # mode, docs/robustness.md) — still a real verdict, just slower.
     degraded: bool = False
+    # Serving provenance from the tiered GFKB (index/tiers.py): which
+    # storage tier answered ("hot" = exact device scan, "tiered" = device
+    # + routed overflow, "warm"/"warm_routed" = host tiers while
+    # degraded, "*_exact"/"*_fault" = routing degraded to the exact
+    # scan), and the IVF nprobe used when the answer was routed.
+    tier: Optional[str] = None
+    nprobe: Optional[int] = None
 
 
 class HealthPoint(BaseModel):

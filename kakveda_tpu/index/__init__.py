@@ -1,3 +1,4 @@
-"""Device-resident Global Failure Knowledge Base."""
+"""Global Failure Knowledge Base — device-hot index + tiered host storage."""
 
 from kakveda_tpu.index.gfkb import GFKB  # noqa: F401
+from kakveda_tpu.index.tiers import TierConfig, TieredIndex  # noqa: F401

@@ -54,6 +54,7 @@ from kakveda_tpu.core.schemas import (
     Severity,
     utcnow,
 )
+from kakveda_tpu.index.tiers import TierConfig, TieredIndex
 from kakveda_tpu.ops.featurizer import HashedNGramFeaturizer, dense_rows_to_sparse
 from kakveda_tpu.ops.knn import ShardedKnn, batch_bucket
 from kakveda_tpu.parallel.mesh import create_mesh
@@ -62,6 +63,12 @@ from kakveda_tpu.parallel.mesh import create_mesh
 class SnapshotError(RuntimeError):
     """Snapshot unavailable or aborted (persist=False, concurrent reload) —
     a caller-side condition, distinct from device/runtime failures."""
+
+
+class HostFallbackDisabled(RuntimeError):
+    """Degraded-mode matching requested but the host tiers are disabled
+    (KAKVEDA_HOST_FALLBACK=0) — a configuration condition, typed so the
+    warn path never confuses it with a device failure."""
 
 
 def _iso(ts: str):
@@ -99,6 +106,7 @@ class GFKB:
         top_k: int = 5,
         featurizer: Optional[HashedNGramFeaturizer] = None,
         persist: bool = True,
+        tier_config: Optional[TierConfig] = None,
     ):
         self.data_dir = Path(data_dir)
         self.persist = persist
@@ -170,20 +178,23 @@ class GFKB:
         # disarmed, which is what un-latches degraded mode.
         self._fault_device = _faults.site("device.unavailable")
 
-        # Device-loss degraded mode: a host-side mirror of every row's
-        # sparse (idx, val) embedding, kept slot-aligned so the warn path
-        # can still answer "has this failed before?" with a numpy cosine
-        # top-k when the chip is gone (match_batch_host). ~100s of bytes
-        # per row (hashed-ngram rows are ~98% zeros). The inverted index
-        # over the mirror is built lazily on the FIRST degraded query and
-        # extended incrementally as rows land. KAKVEDA_HOST_FALLBACK=0
-        # opts out (no mirror, no fallback — degraded warn then errors).
+        # Tiered storage hierarchy (index/tiers.py): the host-warm tier
+        # mirrors every row's sparse (idx, val) embedding slot-aligned —
+        # degraded-mode matching, overflow past the device hot-row budget
+        # and snapshot restore ALL serve through it (one abstraction, not
+        # the PR-5 parallel mirror) — and the disk-cold tier pages rows
+        # past the warm budget in from memmap shards on demand. Routing
+        # is IVF-style over coarse centroids maintained per ingest batch.
+        # KAKVEDA_HOST_FALLBACK=0 opts out of the host tiers entirely (no
+        # mirror, no fallback, no hot cap — degraded warn then errors).
         self._host_fallback = os.environ.get("KAKVEDA_HOST_FALLBACK", "1") != "0"
-        self._host_rows: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}
-        # feature idx -> ([slots], [vals]) lists; covered slot count rides
-        # alongside so incremental extension knows where to resume.
-        self._host_index: Optional[dict] = None
-        self._host_index_n = 0
+        self._tiers: Optional[TieredIndex] = None
+        if self._host_fallback:
+            self._tiers = TieredIndex(
+                self.featurizer.dim,
+                tier_config or TierConfig(),
+                self.data_dir if persist else None,
+            )
         self._m_warn_fallback = _metrics.get_registry().counter(
             "kakveda_warn_fallback_total",
             "Warn verdicts served by the host-side fallback index while "
@@ -230,7 +241,7 @@ class GFKB:
             ("source",),
         )
         self._m_mine_attach = {
-            s: _attach.labels(source=s) for s in ("delta", "reused")
+            s: _attach.labels(source=s) for s in ("delta", "reused", "tier")
         }
         self._m_mine_merges = reg.counter(
             "kakveda_mine_merges_total",
@@ -397,10 +408,15 @@ class GFKB:
     # vectors. v4 adds the incremental-mining cluster labels
     # (clusters.npy) with their OWN manifest checksum: a bad cluster file
     # degrades to one full re-mine (state marked stale), never to full
-    # log replay and never to restoring unverified labels. Older
-    # snapshots fall back to full replay — acceptable one-time cost, no
-    # migration path needed.
-    _SNAPSHOT_VERSION = 4
+    # log replay and never to restoring unverified labels. v5 adds the
+    # tiered-index state — centroids.npy + tier_assign.npy (the IVF
+    # router) with their own checksum, and a "tiers" manifest section
+    # recording the hot boundary; overflow rows persist through the same
+    # sparse payload (sourced from the host tiers instead of the device).
+    # A bad/missing tier file degrades to one router rebuild from the
+    # restored rows, never to full log replay. Older snapshots fall back
+    # to full replay — acceptable one-time cost, no migration path needed.
+    _SNAPSHOT_VERSION = 5
     _TAIL_HASH_BYTES = 4096
     _SNAPSHOT_PAYLOAD = ("sparse_idx.npy", "sparse_val.npy", "records.jsonl")
 
@@ -487,8 +503,13 @@ class GFKB:
                 emb_copy = knn.device_copy(self._emb)
                 log_hash = self._log_prefix_hash(offset) if offset else ""
                 generation = self._generation
+                hot_n = min(n, self._hot_cap())
+                router_state = (
+                    self._tiers.export_router_state()
+                    if self._tiers is not None else None
+                )
 
-            vecs = knn.gather_slots(emb_copy, np.arange(n, dtype=np.int32))
+            vecs = knn.gather_slots(emb_copy, np.arange(hot_n, dtype=np.int32))
             del emb_copy
             # Persist SPARSE (idx, val) pairs, not the dense matrix:
             # hashed-ngram rows are ~98% zeros, so the snapshot shrinks
@@ -500,6 +521,26 @@ class GFKB:
             # scatter with no re-sparsify pass.
             sp_idx, sp_val = dense_rows_to_sparse(vecs, knn.dim)
             del vecs
+            if n > hot_n:
+                # Overflow rows never touched the device: their sparse
+                # pairs come straight from the host tiers (warm RAM or
+                # cold shards), padded to a common row width.
+                o_idx, o_val = self._tiers._rows_block(
+                    np.arange(hot_n, n, dtype=np.int64)
+                )
+                kk = max(sp_idx.shape[1], o_idx.shape[1])
+
+                def _pad(a, fill, dtype):
+                    out = np.full((a.shape[0], kk), fill, dtype)
+                    out[:, : a.shape[1]] = a
+                    return out
+
+                sp_idx = np.concatenate(
+                    [_pad(sp_idx, knn.dim, np.int32), _pad(o_idx, knn.dim, np.int32)]
+                )
+                sp_val = np.concatenate(
+                    [_pad(sp_val, 0.0, np.float32), _pad(o_val, 0.0, np.float32)]
+                )
             sd = self._snapshot_dir()
             tmp = Path(tempfile.mkdtemp(dir=self.data_dir, prefix=".snapshot-"))
             old = self.data_dir / f".snapshot-old-{os.getpid()}-{id(tmp)}"
@@ -535,6 +576,22 @@ class GFKB:
                         "checksum": hashlib.sha256(
                             (tmp / "clusters.npy").read_bytes()
                         ).hexdigest(),
+                    }
+                if router_state is not None:
+                    import hashlib
+
+                    cent, assign = router_state
+                    np.save(tmp / "centroids.npy", cent.astype(np.float32))
+                    np.save(tmp / "tier_assign.npy", assign.astype(np.int32))
+                    h = hashlib.sha256((tmp / "centroids.npy").read_bytes())
+                    h.update((tmp / "tier_assign.npy").read_bytes())
+                    manifest["tiers"] = {
+                        "n": n,
+                        "hot": hot_n,
+                        # Own checksum: a rotted router file costs one
+                        # router rebuild from the restored rows, not a
+                        # full log replay (routing is derived state).
+                        "checksum": h.hexdigest(),
                     }
                 (tmp / "manifest.json").write_text(json.dumps(manifest))
                 # Swap via renames under the data lock: serialized with
@@ -623,13 +680,49 @@ class GFKB:
             self._apps_by_type.setdefault(r.failure_type, set()).update(r.affected_apps)
         if n:
             tids = np.asarray([self._type_id(r.failure_type) for r in records], np.int32)
+            # route=False: the router's persisted partition (or a rebuild)
+            # installs after the rows, instead of re-assigning online.
             self._bulk_insert_chunked(
                 lambda i, j: (sp_idx[i:j], sp_val[i:j]),
                 np.arange(n, dtype=np.int32),
                 tids,
+                route=False,
             )
         self._mine_restore(sd, manifest)
+        self._restore_tiers(sd, manifest)
         return offset
+
+    def _restore_tiers(self, sd: Path, manifest: dict) -> None:
+        """Install the snapshot's IVF router state. NEVER trusts an
+        unverified partition: a missing section, checksum mismatch or
+        shape error degrades to ONE router rebuild from the restored rows
+        — routing is derived state; it must not force a full log replay
+        and must not silently misroute."""
+        t = self._tiers
+        if t is None or t.router is None:
+            return
+        try:
+            mf = manifest.get("tiers")
+            if not mf:
+                raise ValueError("snapshot carries no tier state")
+            import hashlib
+
+            h = hashlib.sha256((sd / "centroids.npy").read_bytes())
+            h.update((sd / "tier_assign.npy").read_bytes())
+            if h.hexdigest() != mf.get("checksum"):
+                raise ValueError("tier-state checksum mismatch")
+            cent = np.load(sd / "centroids.npy")
+            assign = np.load(sd / "tier_assign.npy")
+            if len(assign) != len(self._records) or int(mf.get("n", -1)) != len(assign):
+                raise ValueError("tier-state shape mismatch")
+            t.restore_router_state(cent, assign)
+        except Exception as e:  # noqa: BLE001 — degrade, never desync
+            log.warning(
+                "tier-router restore failed (%s: %s); rebuilding the "
+                "coarse partition from the restored rows",
+                type(e).__name__, e,
+            )
+            t.rebuild_router()
 
     def _mine_restore(self, sd: Path, manifest: dict) -> None:
         """Seed the incremental cluster state from a snapshot's labels.
@@ -675,21 +768,28 @@ class GFKB:
             )
             m.mark_stale(f"restore failed: {type(e).__name__}")
 
-    def _bulk_insert_chunked(self, sparsify, slots: np.ndarray, tids: np.ndarray) -> None:
+    def _bulk_insert_chunked(
+        self, sparsify, slots: np.ndarray, tids: np.ndarray, route: bool = True
+    ) -> None:
         """Bulk insert in bounded 64k chunks: insert inputs are replicated
         on every device, so a million-row restore in one call would put the
         whole matrix on each chip. ``sparsify(i, j)`` yields the (idx, val)
         pair for rows [i, j) — rows always ship sparse (hashed-ngram
         embeddings are ~98% zeros; at 1M rows that is ~250 MB over the wire
-        instead of 8 GB)."""
+        instead of 8 GB). Slots past the hot cap land in the host tiers
+        only — the device never grows past its row budget."""
         chunk = 1 << 16
+        hot = self._hot_cap()
         for i in range(0, len(slots), chunk):
             j = min(i + chunk, len(slots))
             sp_i, sp_v = sparsify(i, j)
-            self._store_host_rows(slots[i:j], sp_i, sp_v)
-            self._emb, self._valid, self._types = self._knn.insert_sparse(
-                self._emb, self._valid, self._types, sp_i, sp_v, slots[i:j], tids[i:j]
-            )
+            self._store_tier_rows(slots[i:j], sp_i, sp_v, route=route)
+            dev = slots[i:j] < hot
+            if dev.any():
+                self._emb, self._valid, self._types = self._knn.insert_sparse(
+                    self._emb, self._valid, self._types,
+                    sp_i[dev], sp_v[dev], slots[i:j][dev], tids[i:j][dev],
+                )
 
     def _insert_texts_chunked(self, texts: List[str], slots: np.ndarray, tids: np.ndarray) -> None:
         """Signature texts (replay/rebuild): encode sparse per chunk — no
@@ -729,9 +829,10 @@ class GFKB:
             # The rewrite replaced the files; any torn-tail truncation
             # scheduled against the OLD files must not fire on the new ones.
             self._truncate_pending = {}
-            self._host_rows = {}
-            self._host_index = None
-            self._host_index_n = 0
+            # Host tiers describe pre-rewrite slots (including any cold
+            # shards on disk) — drop them with everything else.
+            if self._tiers is not None:
+                self._tiers.reset()
             if self._mine is not None:
                 from kakveda_tpu.ops.incremental import ClusterState
 
@@ -810,7 +911,21 @@ class GFKB:
             records = list(self._records)
             knn = self._knn  # growth re-shard swaps the knn; pair it with the buffer
             emb_copy = knn.device_copy(self._emb)
-        vecs = knn.gather_slots(emb_copy, np.arange(len(records), dtype=np.int32))
+            hot_n = min(len(records), self._hot_cap())
+        vecs = knn.gather_slots(emb_copy, np.arange(hot_n, dtype=np.int32))
+        if len(records) > hot_n:
+            # Overflow rows densify from the host tiers (the device never
+            # held them). Callers of this API (full-sweep mining, audits)
+            # already accept O(N·dim) host memory.
+            o_idx, o_val = self._tiers._rows_block(
+                np.arange(hot_n, len(records), dtype=np.int64)
+            )
+            dense = np.zeros((len(records) - hot_n, knn.dim + 1), np.float32)
+            rows = np.broadcast_to(
+                np.arange(dense.shape[0])[:, None], o_idx.shape
+            )
+            np.add.at(dense, (rows, np.minimum(o_idx, knn.dim)), o_val)
+            vecs = np.concatenate([vecs, dense[:, : knn.dim]])
         return records, vecs
 
     def type_aggregate(self, failure_type: str) -> Tuple[List[str], List[str]]:
@@ -860,14 +975,16 @@ class GFKB:
         return knn, emb, valid, types
 
     def _ensure_capacity(self, needed: int) -> None:
-        """Init-time growth (replay/restore run single-threaded)."""
+        """Init-time growth (replay/restore run single-threaded). The
+        device only ever grows to the hot cap; overflow is the tiers'."""
+        needed = min(needed, self._hot_cap())
         if needed <= self._knn.capacity:
             return
         new_cap = self._knn.capacity
         while new_cap < needed:
             new_cap *= 2
         self._knn, self._emb, self._valid, self._types = self._build_index(
-            new_cap, self._records
+            new_cap, self._records[:needed]
         )
         self._publish()
 
@@ -877,13 +994,15 @@ class GFKB:
         WITHOUT the data lock so concurrent matches and ingests aren't
         stalled behind it; the swap re-checks under the lock and retries if
         a reload or competing growth won the race. Rows appended while the
-        rebuild ran are delta-scattered at swap time."""
+        rebuild ran are delta-scattered at swap time. Growth stops at the
+        hot cap — rows past it are host-tier only, by design."""
         while True:
             with self._lock:
-                needed = len(self._records)
+                hot = self._hot_cap()
+                needed = min(len(self._records), hot)
                 if needed <= self._knn.capacity:
                     return
-                records = list(self._records)
+                records = list(self._records[:hot])
                 old_knn = self._knn
                 gen = self._generation
             new_cap = old_knn.capacity
@@ -893,14 +1012,15 @@ class GFKB:
             with self._lock:
                 if self._generation != gen or self._knn is not old_knn:
                     continue  # reload or another growth swapped first; re-check
-                if len(self._records) > new_cap:
+                hot_now = min(len(self._records), hot)
+                if hot_now > new_cap:
                     continue  # appends outran the doubling; rebuild bigger
-                if len(self._records) > len(records):
-                    delta = self._records[len(records) :]
+                if hot_now > len(records):
+                    delta = self._records[len(records) : hot_now]
                     d_i, d_v = self.featurizer.encode_batch_sparse(
                         [r.signature_text for r in delta]
                     )
-                    dslots = np.arange(len(records), len(self._records), dtype=np.int32)
+                    dslots = np.arange(len(records), hot_now, dtype=np.int32)
                     dtids = np.asarray(
                         [self._type_id(r.failure_type) for r in delta], np.int32
                     )
@@ -1071,15 +1191,6 @@ class GFKB:
         Callers incremented _pending_embeds under the append lock; the
         finally block releases snapshot()/records_and_embeddings() waiters."""
         try:
-            if len(self._records) > self._knn.capacity:
-                if self._host_fallback:
-                    h_idx, h_val = self.featurizer.encode_batch_sparse(texts)
-                    with self._lock:
-                        if self._generation == gen:
-                            self._store_host_rows(np.asarray(slots), h_idx, h_val)
-                self._grow_and_reembed()
-                self._mine_attach_new(slots, texts, None, None, gen)
-                return
             # Sparse path: hashed-ngram rows are ~98% zeros; shipping (idx,
             # val) pairs instead of dense [B, dim] keeps streaming ingest off
             # the host→device wire bottleneck (the dense transfer dominated
@@ -1090,19 +1201,22 @@ class GFKB:
             with self._lock:
                 if self._generation != gen:
                     return  # reloaded since append; replay covered these rows
-                # Host mirror first: a device scatter that dies on a wedged
-                # backend must still leave degraded-mode matching complete.
-                self._store_host_rows(arr_slots, sp_idx, sp_val)
-                if len(self._records) > self._knn.capacity:
-                    need_growth = True
-                else:
-                    need_growth = False
+                # Host tiers first: a device scatter that dies on a wedged
+                # backend must still leave degraded-mode matching complete —
+                # and slots past the hot cap live ONLY here.
+                self._store_tier_rows(arr_slots, sp_idx, sp_val)
+                hot = self._hot_cap()
+                dev = arr_slots < hot
+                need_growth = min(len(self._records), hot) > self._knn.capacity
+                if not need_growth and dev.any():
                     with profiling.annotate("gfkb.insert"):
                         self._emb, self._valid, self._types = self._knn.insert_sparse(
-                            self._emb, self._valid, self._types, sp_idx, sp_val, arr_slots, arr_tids
+                            self._emb, self._valid, self._types,
+                            sp_idx[dev], sp_val[dev], arr_slots[dev], arr_tids[dev],
                         )
                     self._publish()
             if need_growth:
+                # The rebuild re-embeds every hot record, these included.
                 self._grow_and_reembed()
             self._mine_attach_new(slots, texts, sp_idx, sp_val, gen)
         finally:
@@ -1131,7 +1245,9 @@ class GFKB:
         try:
             self._fault_mine.fire()
             reused = []  # (slot, neigh_slots, sims)
+            tier_attach = []  # overflow rows: neighbors from the host tiers
             delta_rows: List[int] = []
+            hot = self._hot_cap()
             with self._lock:
                 if self._generation != gen:
                     return
@@ -1148,6 +1264,24 @@ class GFKB:
                     else:
                         d_idx = sp_idx[delta_rows]
                         d_val = sp_val[delta_rows]
+                    # Overflow rows aren't in the device index: their
+                    # neighbors come from the host tiers' (routed) top-k
+                    # instead of a device dispatch — same attach contract.
+                    ovf = [
+                        j for j, i in enumerate(delta_rows) if int(slots[i]) >= hot
+                    ]
+                    if ovf and self._tiers is not None:
+                        for j in ovf:
+                            nscores, nslots, _mode = self._tiers.match_host(
+                                d_idx[j], d_val[j], m.k + 1
+                            )
+                            tier_attach.append(
+                                (int(slots[delta_rows[j]]), nslots, nscores)
+                            )
+                        keep = [j for j in range(len(delta_rows)) if j not in set(ovf)]
+                        delta_rows = [delta_rows[j] for j in keep]
+                        d_idx, d_val = d_idx[keep], d_val[keep]
+                if delta_rows:
                     from kakveda_tpu.ops.incremental import delta_topk_sparse
 
                     # Dispatch under the data lock (PJRT buffer-hold rule,
@@ -1169,6 +1303,10 @@ class GFKB:
             for s, nslots, nsims in reused:
                 m.attach(int(s), nslots, nsims)
                 self._m_mine_attach["reused"].inc()
+            for s, nslots, nsims in tier_attach:
+                keep = np.isfinite(nsims) & (nsims >= m.threshold)
+                m.attach(int(s), nslots[keep], nsims[keep])
+                self._m_mine_attach["tier"].inc()
             if len(self._mine_pending) > self._mine_pending_max:
                 with self._lock:
                     self._mine_drain_locked()
@@ -1300,6 +1438,12 @@ class GFKB:
             nc = m.n_clusters_cached()
             if nc is not None:
                 self._m_mine_clusters.set(nc)
+            if self._tiers is not None:
+                # A fresh full-sweep partition is the best coarse structure
+                # available — re-seed the IVF router's centroids from it
+                # (ops/incremental.py centroid export; failure keeps the
+                # online partition, routing is derived state).
+                self._tiers.reseed_router(labels)
             return True
 
     def _drain_pending_embeds(self) -> None:
@@ -1310,96 +1454,74 @@ class GFKB:
             self._embeds_cv.wait(timeout=30.0)
 
     # ------------------------------------------------------------------
-    # host fallback (device-loss degraded mode)
+    # host tiers (degraded mode, overflow, restore — one hierarchy)
     # ------------------------------------------------------------------
 
-    def _store_host_rows(self, slots, sp_idx: np.ndarray, sp_val: np.ndarray) -> None:
-        """Mirror freshly embedded rows on host (sparse, trimmed of the
-        pad sentinel) so degraded-mode matching has something to read.
-        Rows land BEFORE the device scatter, so a scatter that dies on a
-        wedged backend still leaves the host mirror complete."""
-        if not self._host_fallback:
+    def _hot_cap(self) -> int:
+        """Logical slots the device-hot tier may hold. Unbounded without
+        the host tiers (KAKVEDA_HOST_FALLBACK=0 — nothing could absorb an
+        overflow) or with tiering off (pre-tiered growth semantics)."""
+        if self._tiers is None:
+            return 1 << 62
+        return self._tiers.cfg.hot_rows
+
+    def _store_tier_rows(
+        self, slots, sp_idx: np.ndarray, sp_val: np.ndarray, route: bool = True
+    ) -> None:
+        """Land freshly embedded rows in the host tiers (warm RAM, or the
+        cold memmap past the warm budget) and feed the router's per-batch
+        delta update. Rows land BEFORE the device scatter, so a scatter
+        that dies on a wedged backend still leaves degraded-mode matching
+        complete. ``route=False`` skips the router assignment (snapshot
+        restore installs the persisted router state instead)."""
+        if self._tiers is None:
             return
-        dim = self.featurizer.dim
-        for r, slot in enumerate(np.asarray(slots).tolist()):
-            keep = sp_idx[r] < dim  # pad idx == dim (the scatter drop sentinel)
-            self._host_rows[int(slot)] = (
-                sp_idx[r][keep].astype(np.int32, copy=True),
-                sp_val[r][keep].astype(np.float32, copy=True),
-            )
+        self._tiers.insert(np.asarray(slots, np.int64), sp_idx, sp_val, route=route)
 
-    def _host_index_extend_locked(self) -> Optional[dict]:
-        """Build/extend the inverted index over the host mirror (call with
-        the data lock held). Incremental: only slots past the covered
-        watermark are folded in, so steady-state degraded queries pay
-        O(new rows), not O(N), per call."""
-        if not self._host_fallback:
-            return None
-        n = len(self._records)
-        if self._host_index is None:
-            self._host_index = {}
-            self._host_index_n = 0
-        idx = self._host_index
-        slot = self._host_index_n
-        while slot < n:
-            row = self._host_rows.get(slot)
-            if row is None:
-                # Embed still pending for this slot: stop here so the
-                # watermark never advances past an unmirrored row (it
-                # would otherwise be invisible to every later query).
-                break
-            for f, v in zip(row[0].tolist(), row[1].tolist()):
-                ent = idx.get(f)
-                if ent is None:
-                    ent = idx[f] = ([], [])
-                ent[0].append(slot)
-                ent[1].append(v)
-            slot += 1
-        self._host_index_n = slot
-        return idx
+    def tiers_info(self) -> dict:
+        """Tier residency/routing view (readyz + tests)."""
+        if self._tiers is None:
+            return {"enabled": False}
+        info = self._tiers.info()
+        info["enabled"] = True
+        return info
 
-    def match_batch_host(
+    def match_batch_fallback(
         self,
         signature_texts: Sequence[str],
         failure_type: Optional[str] = None,
-    ) -> List[List[FailureMatch]]:
-        """Degraded-mode top-k: numpy cosine over the host sparse mirror —
-        no device touch anywhere. Rows and queries are L2-normalized by
-        the featurizer, so the sparse dot IS the cosine score; scoring is
-        one inverted-index walk per query (O(query nnz · postings)).
-        Slower than the compiled device path but ALIVE, which is the whole
-        contract of degraded mode. ``failure_type`` keeps the default
-        post-truncation filter semantics of :meth:`match_batch`."""
-        if not self._host_fallback:
-            raise RuntimeError(
+    ) -> Tuple[List[List[FailureMatch]], dict]:
+        """Device-free top-k from the host tiers — the degraded-mode path
+        (and the code overflow matching shares). Small corpora take the
+        exact inverted-index walk (bit-for-bit the PR-5 fallback scores);
+        past the routing floor the IVF router narrows each query to
+        ``nprobe`` candidate lists with exact scoring over candidates. A
+        routing fault degrades that query to the exact scan — slower,
+        never wrong-but-confident. Returns ``(matches, info)`` where
+        ``info`` carries the serving ``tier``/``nprobe`` for verdicts.
+        ``failure_type`` keeps :meth:`match_batch`'s default
+        post-truncation filter semantics."""
+        if self._tiers is None:
+            raise HostFallbackDisabled(
                 "host fallback disabled (KAKVEDA_HOST_FALLBACK=0)"
             )
         q_idx, q_val = self.featurizer.encode_batch_sparse(list(signature_texts))
-        dim = self.featurizer.dim
         with self._lock:
-            records = self._records
-            n = len(records)
-            if n == 0:
-                return [[] for _ in signature_texts]
-            inv = self._host_index_extend_locked()
-            scores_rows = []
-            for r in range(q_idx.shape[0]):
-                scores = np.zeros(n, np.float32)
-                keep = q_idx[r] < dim
-                for f, v in zip(q_idx[r][keep].tolist(), q_val[r][keep].tolist()):
-                    ent = inv.get(f)
-                    if ent is not None:
-                        scores[np.asarray(ent[0])] += v * np.asarray(ent[1], np.float32)
-                scores_rows.append(scores)
-            self._m_warn_fallback.inc(len(signature_texts))
+            records = list(self._records)
+        n = len(records)
+        if n == 0:
+            return [[] for _ in signature_texts], {"tier": "warm", "nprobe": None}
         out: List[List[FailureMatch]] = []
         k = self.top_k
-        for scores in scores_rows:
-            order = np.argsort(-scores)[: max(k, 1)]
+        routed = False
+        for r in range(q_idx.shape[0]):
+            scores, slots, mode = self._tiers.match_host(
+                q_idx[r], q_val[r], max(k, 1)
+            )
+            routed = routed or mode == "routed"
             row: List[FailureMatch] = []
-            for slot in order.tolist():
-                s = float(scores[slot])
-                if s <= 0.0:
+            for s, slot in zip(scores.tolist(), slots.tolist()):
+                if s <= 0.0 or slot >= n:
                     continue
                 rec = records[slot]
                 if failure_type and rec.failure_type != failure_type:
@@ -1408,13 +1530,18 @@ class GFKB:
                     FailureMatch(
                         failure_id=rec.failure_id,
                         version=rec.version,
-                        score=min(1.0, max(-1.0, s)),
+                        score=min(1.0, max(-1.0, float(s))),
                         failure_type=rec.failure_type,
                         suggested_mitigation=rec.resolution,
                     )
                 )
             out.append(row)
-        return out
+        self._m_warn_fallback.inc(len(signature_texts))
+        info = {
+            "tier": "warm_routed" if routed else "warm",
+            "nprobe": self._tiers.cfg.nprobe if routed else None,
+        }
+        return out, info
 
     # ------------------------------------------------------------------
     # match
@@ -1434,7 +1561,23 @@ class GFKB:
         failure_type: Optional[str] = None,
         type_filter: str = "post",
     ) -> List[List[FailureMatch]]:
-        """Top-k similarity matches for a batch of queries (one device call).
+        return self.match_batch_info(signature_texts, failure_type, type_filter)[0]
+
+    def match_batch_info(
+        self,
+        signature_texts: Sequence[str],
+        failure_type: Optional[str] = None,
+        type_filter: str = "post",
+    ) -> Tuple[List[List[FailureMatch]], dict]:
+        """Top-k similarity matches for a batch of queries (one device call),
+        plus serving provenance (``tier``/``nprobe``) for verdicts.
+
+        Slots within the hot cap are answered by the exact device scan;
+        when the corpus has overflowed onto the host tiers, each query
+        additionally gathers a routed (or exact-degraded) host top-k over
+        the overflow slots and the two are merged by score — the device
+        stays exact over what it holds, the tiers make the rest
+        representable.
 
         ``type_filter``:
           * ``"post"`` (default) — reference-compatible: the type filter
@@ -1467,22 +1610,54 @@ class GFKB:
             knn, emb, valid, types, records = self._view
             n = len(records)
             if n == 0:
-                return [[] for _ in signature_texts]
+                return [[] for _ in signature_texts], {"tier": "hot", "nprobe": None}
             tid = None
             if type_filter == "pre" and failure_type is not None:
                 tid = self._type_ids.get(failure_type)
                 if tid is None:
-                    return [[] for _ in signature_texts]
+                    return [[] for _ in signature_texts], {"tier": "hot", "nprobe": None}
             with profiling.annotate("gfkb.match.dispatch"):
                 # Device-loss drill point: armed, the dispatch dies the way
                 # a wedged backend does, and the warn path's degraded-mode
-                # fallback (WarningPolicy → match_batch_host) takes over.
+                # fallback (WarningPolicy → match_batch_fallback) takes over.
                 self._fault_device.fire()
                 if tid is not None:
                     valid = knn.mask_valid(valid, types, tid)
                 packed = knn.topk_async_sparse(emb, valid, q_idx, q_val)
         with profiling.annotate("gfkb.match.fetch"):
             scores, slots = knn.topk_result(packed)
+
+        info = {"tier": "hot", "nprobe": None}
+        hot = self._hot_cap()
+        if n > hot and self._tiers is not None:
+            # Overflow: merge the device's exact hot top-k with the host
+            # tiers' (routed) top-k over slots the device doesn't hold.
+            modes: set = set()
+            m_scores, m_slots = [], []
+            k = scores.shape[1]
+            for i in range(b):
+                o_s, o_sl, mode = self._tiers.match_host(
+                    q_idx[i], q_val[i], k, min_slot=hot
+                )
+                modes.add(mode)
+                if tid is not None and len(o_sl):
+                    keep = np.asarray(
+                        [records[int(s)].failure_type == failure_type for s in o_sl]
+                    )
+                    o_s, o_sl = o_s[keep], o_sl[keep]
+                cs = np.concatenate([scores[i], o_s])
+                csl = np.concatenate([slots[i], o_sl])
+                order = np.argsort(-cs)[:k]
+                m_scores.append(cs[order])
+                m_slots.append(csl[order])
+            scores = np.stack(m_scores)
+            slots = np.stack(m_slots)
+            if "fault_exact" in modes:
+                info = {"tier": "tiered_fault", "nprobe": None}
+            elif modes == {"routed"}:
+                info = {"tier": "tiered", "nprobe": self._tiers.cfg.nprobe}
+            else:
+                info = {"tier": "tiered_exact", "nprobe": None}
 
         if self._mine is not None and self._match_cache_max > 0 and failure_type is None:
             # Remember the fetched neighbors per signature: a pre-flight
@@ -1520,7 +1695,7 @@ class GFKB:
                     )
                 )
             out.append(row)
-        return out
+        return out, info
 
     # ------------------------------------------------------------------
     # patterns

@@ -53,7 +53,49 @@ import numpy as np
 
 from kakveda_tpu.ops.clustering import _BLOCK, _block_topk, _sparse_components
 
-__all__ = ["ClusterState", "delta_topk_sparse", "delta_topk_dense", "unpack_topk"]
+__all__ = [
+    "ClusterState",
+    "delta_topk_sparse",
+    "delta_topk_dense",
+    "unpack_topk",
+    "centroids_from_sparse",
+]
+
+
+def centroids_from_sparse(labels, rows_fn, dim: int, chunk: int = 1 << 14):
+    """Export a label partition as coarse-quantizer state: one
+    L2-normalized centroid per cluster, built from sparse member rows.
+
+    This is the bridge between the incremental mining state (its
+    :meth:`ClusterState.labels` partition — per-row cluster structure the
+    platform already maintains) and the tiered index's IVF router
+    (``index/tiers.py``): the router re-seeds its coarse partition from
+    these exact member means instead of its online running estimates.
+
+    ``rows_fn(slots) -> (idx [B, K] int32, val [B, K] f32)`` supplies the
+    sparse rows (pad idx == ``dim``). Returns ``(centroids [C, dim] f32,
+    counts [C] int64, lists, assign [n] int32)`` where ``assign`` maps
+    each row to its dense centroid id and ``lists[c]`` are the member
+    slots. Pure numpy, chunked so no dense [n, dim] ever materializes.
+    """
+    labels = np.asarray(labels)
+    n = len(labels)
+    uniq, assign = np.unique(labels, return_inverse=True)
+    c = len(uniq)
+    sums = np.zeros((c, dim), np.float32)
+    counts = np.bincount(assign, minlength=c).astype(np.int64)
+    for s in range(0, n, chunk):
+        e = min(n, s + chunk)
+        idx, val = rows_fn(np.arange(s, e, dtype=np.int64))
+        keep = idx < dim
+        rows_lab = np.broadcast_to(assign[s:e, None], idx.shape)[keep]
+        np.add.at(sums, (rows_lab, idx[keep]), val[keep])
+    norms = np.linalg.norm(sums, axis=1, keepdims=True)
+    cents = np.divide(sums, norms, out=np.zeros_like(sums), where=norms > 0)
+    lists: list = [[] for _ in range(c)]
+    for slot, a in enumerate(assign.tolist()):
+        lists[a].append(slot)
+    return cents, counts, lists, assign.astype(np.int32)
 
 
 # ---------------------------------------------------------------------------

@@ -54,26 +54,26 @@ class WarningPolicy:
         sigs = [signature_text(r.prompt, r.tools, r.env) for r in reqs]
         # Device-loss degraded mode (core/admission.py): while the backend
         # is latched DEGRADED we never even dispatch (a wedged chip hangs,
-        # it doesn't error) — the host-side numpy cosine over the GFKB's
-        # sparse mirror answers instead, flagged `degraded=true`. A fresh
-        # backend failure here latches the mode and takes the same
-        # fallback, so the request that DISCOVERS the outage still gets a
-        # verdict. The pre-flight check is the product; it must not die
-        # with the chip.
+        # it doesn't error) — the GFKB's host-warm/disk-cold tiers answer
+        # instead (index/tiers.py, `match_batch_fallback`), flagged
+        # `degraded=true`. A fresh backend failure here latches the mode
+        # and takes the same fallback, so the request that DISCOVERS the
+        # outage still gets a verdict. The pre-flight check is the
+        # product; it must not die with the chip.
         from kakveda_tpu.core import admission as _admission
 
         health = _admission.get_device_health()
         degraded = False
         if health.degraded:
-            all_matches = self.gfkb.match_batch_host(sigs)
+            all_matches, tier_info = self.gfkb.match_batch_fallback(sigs)
             degraded = True
         else:
             try:
-                all_matches = self.gfkb.match_batch(sigs)
+                all_matches, tier_info = self.gfkb.match_batch_info(sigs)
             except Exception as e:  # noqa: BLE001 — classify, maybe degrade
                 if not health.note_failure(e, where="gfkb.match"):
                     raise  # a real software bug, not a device loss
-                all_matches = self.gfkb.match_batch_host(sigs)
+                all_matches, tier_info = self.gfkb.match_batch_fallback(sigs)
                 degraded = True
         self._m_batch.observe(time.perf_counter() - t0)
         patterns = self.gfkb.list_patterns()
@@ -103,6 +103,8 @@ class WarningPolicy:
                             f"Suggested mitigation: {best.suggested_mitigation or 'n/a'}"
                         ),
                         degraded=degraded,
+                        tier=tier_info.get("tier"),
+                        nprobe=tier_info.get("nprobe"),
                     )
                 )
             else:
@@ -114,6 +116,8 @@ class WarningPolicy:
                         references=[],
                         message="No high-similarity match found in GFKB.",
                         degraded=degraded,
+                        tier=tier_info.get("tier"),
+                        nprobe=tier_info.get("nprobe"),
                     )
                 )
         for r in out:

@@ -237,6 +237,7 @@ def make_app(
                 "gfkb_count": plat.gfkb.count,
                 "device": health.info(),
                 "admission": adm.info(),
+                "tiers": plat.gfkb.tiers_info(),
             }
         )
 

@@ -240,7 +240,7 @@ def test_host_fallback_matches_device_top1(tmp_path):
             "totally unrelated prompt about the weather",
         ):
             dev = g.match(q)
-            host = g.match_batch_host([q])[0]
+            host = g.match_batch_fallback([q])[0][0]
             if dev and dev[0].score > 0:
                 assert host, f"host fallback empty for {q!r}"
                 assert host[0].failure_id == dev[0].failure_id
@@ -266,10 +266,10 @@ def test_host_fallback_covers_restart_and_reload(tmp_path):
     g.close()
     g2 = _mk_gfkb(tmp_path)  # snapshot restore + tail replay
     try:
-        host = g2.match_batch_host(["intent:retry | upstream deadline exceeded"])[0]
+        host = g2.match_batch_fallback(["intent:retry | upstream deadline exceeded"])[0][0]
         assert host and host[0].failure_type == "timeout"
         g2.reload()  # full log replay path
-        host = g2.match_batch_host(["intent:citations | doc 1 fabricated references"])[0]
+        host = g2.match_batch_fallback(["intent:citations | doc 1 fabricated references"])[0][0]
         assert host and host[0].failure_type == "fabricated_citation"
     finally:
         g2.close()

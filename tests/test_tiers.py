@@ -1,0 +1,453 @@
+"""Tiered GFKB storage hierarchy (kakveda_tpu/index/tiers.py).
+
+Covers the ISSUE-7 acceptance surface at tier-1 sizes: routed recall vs
+the exact oracle, KAKVEDA_GFKB_TIERED=0 bit-for-bit parity with the
+exact scan, manifest v5 snapshot round-trip (+ checksum-mismatch
+degrade), cold-tier spill/paging, and the degraded-mode drill answering
+from the warm tier under concurrent load. Chaos-marked tests prove the
+``gfkb.tier_route`` / ``gfkb.tier_spill`` fault contract: degrade to the
+exact scan / keep rows warm — never a wrong-but-confident verdict,
+never a failed ingest.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from kakveda_tpu.core import faults
+from kakveda_tpu.index.tiers import TierConfig, TieredIndex
+
+
+@pytest.fixture(autouse=True)
+def _disarmed():
+    faults.disarm()
+    yield
+    faults.disarm()
+
+
+def _mk_gfkb(tmp_path, tier_config=None, **kw):
+    from kakveda_tpu.index.gfkb import GFKB
+    from kakveda_tpu.parallel.mesh import create_mesh
+
+    return GFKB(
+        data_dir=tmp_path,
+        mesh=create_mesh("data:1"),
+        capacity=kw.pop("capacity", 64),
+        dim=kw.pop("dim", 256),
+        tier_config=tier_config,
+        **kw,
+    )
+
+
+def _seed_batch(g, n, prefix="doc"):
+    items = [
+        dict(
+            failure_type="fabricated_citation",
+            signature_text=f"{prefix} {i} variant {i % 7} fabricated references",
+            app_id=f"app-{i % 3}",
+            impact_severity="high",
+        )
+        for i in range(n)
+    ]
+    g.upsert_failures_batch(items)
+
+
+def _clustered_corpus(n, dim, n_templates, k=12, seed=3):
+    """Synthetic sparse rows with template structure (the shape real
+    hashed-ngram signatures have)."""
+    rng = np.random.default_rng(seed)
+    tmpl = rng.integers(0, dim, size=(n_templates, k), dtype=np.int64)
+    t = rng.integers(0, n_templates, size=n)
+    idx = tmpl[t].astype(np.int32)
+    val = (1.0 + 0.1 * rng.standard_normal((n, k))).astype(np.float32)
+    val /= np.maximum(np.linalg.norm(val, axis=1, keepdims=True), 1e-9)
+    return idx, val, t, rng
+
+
+# ---------------------------------------------------------------------------
+# routing quality / parity
+# ---------------------------------------------------------------------------
+
+
+def test_routed_recall_vs_exact_oracle():
+    """Property: routed top-1 ≥ 0.99 recall vs the exact scan over a
+    clustered corpus (the ISSUE-7 tier-1 recall bar)."""
+    dim, n = 512, 2500
+    idx, val, _t, rng = _clustered_corpus(n, dim, n_templates=40)
+    tiers = TieredIndex(dim, TierConfig(tiered=True, hot_rows=0, nprobe=8))
+    for s in range(0, n, 256):
+        e = min(n, s + 256)
+        tiers.insert(np.arange(s, e), idx[s:e], val[s:e])
+    hits = 0
+    n_q = 100
+    for qi in rng.integers(0, n, size=n_q).tolist():
+        q_val = val[qi] + 0.05 * rng.standard_normal(idx.shape[1]).astype(np.float32)
+        q_val /= max(float(np.linalg.norm(q_val)), 1e-9)
+        r_sc, r_sl, r_mode = tiers.match_host(idx[qi], q_val, 3, exact=False)
+        e_sc, e_sl, e_mode = tiers.match_host(idx[qi], q_val, 3, exact=True)
+        assert r_mode == "routed" and e_mode == "exact"
+        if r_sl[0] == e_sl[0] or r_sc[0] >= e_sc[0] - 1e-5:
+            hits += 1
+    assert hits / n_q >= 0.99
+
+
+def test_tiered_off_bit_for_bit_parity(tmp_path):
+    """KAKVEDA_GFKB_TIERED=0 must preserve today's exact behavior
+    bit-for-bit: identical match results AND identical fallback scores
+    vs a tiered GFKB whose corpus fits entirely in the hot tier."""
+    g0 = _mk_gfkb(tmp_path / "off", tier_config=TierConfig(tiered=False))
+    g1 = _mk_gfkb(tmp_path / "on", tier_config=TierConfig(tiered=True))
+    try:
+        _seed_batch(g0, 30)
+        _seed_batch(g1, 30)
+        queries = [
+            "doc 3 variant 3 fabricated references",
+            "doc 11 variant 4 fabricated references",
+            "completely unrelated weather question",
+        ]
+        m0 = g0.match_batch(queries)
+        m1 = g1.match_batch(queries)
+        for a, b in zip(m0, m1):
+            assert [(x.failure_id, x.score) for x in a] == [
+                (x.failure_id, x.score) for x in b
+            ]
+        f0, i0 = g0.match_batch_fallback(queries)
+        f1, i1 = g1.match_batch_fallback(queries)
+        for a, b in zip(f0, f1):
+            assert [(x.failure_id, x.score) for x in a] == [
+                (x.failure_id, x.score) for x in b
+            ]
+        assert i0["tier"] == i1["tier"] == "warm"
+    finally:
+        g0.close()
+        g1.close()
+
+
+def test_overflow_matches_stay_correct(tmp_path):
+    """Rows past the hot cap are host-tier only; match_batch must still
+    return them (merged with the device's exact hot top-k)."""
+    cfg = TierConfig(tiered=True, hot_rows=16, warm_rows=1 << 20, nprobe=4)
+    g = _mk_gfkb(tmp_path, tier_config=cfg, capacity=16)
+    try:
+        _seed_batch(g, 48)
+        assert g.tiers_info()["hot"] == 16
+        # hot-resident row
+        ms, info = g.match_batch_info(["doc 3 variant 3 fabricated references"])
+        assert ms[0][0].failure_id == "F-0004"
+        assert info["tier"].startswith("tiered")
+        # overflow row (slot 40 ≥ hot cap)
+        ms, info = g.match_batch_info(["doc 40 variant 5 fabricated references"])
+        assert ms[0][0].failure_id == "F-0041"
+        assert info["tier"].startswith("tiered")
+    finally:
+        g.close()
+
+
+# ---------------------------------------------------------------------------
+# cold tier
+# ---------------------------------------------------------------------------
+
+
+def test_cold_spill_and_paged_reads(tmp_path):
+    """Rows past the warm budget land in memmap shards; matching pages
+    only candidates in and stays exact-correct; promoted reads count."""
+    cfg = TierConfig(tiered=True, hot_rows=8, warm_rows=16, nprobe=4)
+    g = _mk_gfkb(tmp_path, tier_config=cfg, capacity=8)
+    try:
+        _seed_batch(g, 40)
+        info = g.tiers_info()
+        assert info["cold"] == 24 and info["warm_overflow"] == 0
+        assert (tmp_path / "cold" / "cold.json").exists()
+        # slot 30 lives in the cold shards — exact top-1 must find it
+        ms = g.match_batch(["doc 30 variant 2 fabricated references"])
+        assert ms[0][0].failure_id == "F-0031"
+        fb, _ = g.match_batch_fallback(["doc 30 variant 2 fabricated references"])
+        assert fb[0][0].failure_id == "F-0031"
+    finally:
+        g.close()
+
+
+def test_cold_rows_survive_reopen(tmp_path):
+    cfg = TierConfig(tiered=True, hot_rows=8, warm_rows=16, nprobe=4)
+    g = _mk_gfkb(tmp_path, tier_config=cfg, capacity=8)
+    _seed_batch(g, 40)
+    g.snapshot()
+    g.close()
+    g2 = _mk_gfkb(tmp_path, tier_config=cfg, capacity=8)
+    try:
+        assert g2.count == 40
+        info = g2.tiers_info()
+        assert info["cold"] == 24 and info["warm_overflow"] == 0
+        assert g2.match("doc 30 variant 2 fabricated references")[0].failure_id == "F-0031"
+    finally:
+        g2.close()
+
+
+# ---------------------------------------------------------------------------
+# snapshot manifest v5
+# ---------------------------------------------------------------------------
+
+
+def test_snapshot_v5_round_trip_restores_router(tmp_path):
+    cfg = TierConfig(tiered=True, hot_rows=16, warm_rows=1 << 20, nprobe=4)
+    g = _mk_gfkb(tmp_path, tier_config=cfg, capacity=16)
+    _seed_batch(g, 40)
+    centroids_before = g.tiers_info()["centroids"]
+    sd = g.snapshot()
+    assert (sd / "centroids.npy").exists() and (sd / "tier_assign.npy").exists()
+    import json
+
+    manifest = json.loads((sd / "manifest.json").read_text())
+    assert manifest["version"] == 5
+    assert manifest["tiers"]["n"] == 40 and manifest["tiers"]["hot"] == 16
+    g.close()
+    g2 = _mk_gfkb(tmp_path, tier_config=cfg, capacity=16)
+    try:
+        assert g2.count == 40
+        assert g2.tiers_info()["centroids"] == centroids_before
+        assert g2.match("doc 22 variant 1 fabricated references")[0].failure_id == "F-0023"
+    finally:
+        g2.close()
+
+
+def test_snapshot_v5_tier_checksum_mismatch_degrades_to_rebuild(tmp_path, caplog):
+    """A rotted router file must cost one rebuild from the restored rows
+    — matching stays correct, restore never falls back to full replay."""
+    cfg = TierConfig(tiered=True, hot_rows=16, warm_rows=1 << 20, nprobe=4)
+    g = _mk_gfkb(tmp_path, tier_config=cfg, capacity=16)
+    _seed_batch(g, 40)
+    sd = g.snapshot()
+    g.close()
+    raw = np.load(sd / "centroids.npy")
+    np.save(sd / "centroids.npy", raw + 0.5)  # corrupt AFTER the manifest hash
+    import logging
+
+    with caplog.at_level(logging.WARNING, logger="kakveda.gfkb"):
+        g2 = _mk_gfkb(tmp_path, tier_config=cfg, capacity=16)
+    try:
+        assert any("tier-router restore failed" in r.message for r in caplog.records)
+        assert g2.count == 40  # rows restored from the snapshot regardless
+        assert g2.tiers_info()["centroids"] > 0  # rebuilt partition
+        assert g2.match("doc 22 variant 1 fabricated references")[0].failure_id == "F-0023"
+    finally:
+        g2.close()
+
+
+def test_snapshot_main_checksum_still_degrades_to_full_replay(tmp_path):
+    """v5 keeps the v3 contract: a corrupted sparse payload falls back to
+    full log replay (never restores garbage vectors)."""
+    cfg = TierConfig(tiered=True, hot_rows=16, warm_rows=1 << 20, nprobe=4)
+    g = _mk_gfkb(tmp_path, tier_config=cfg, capacity=16)
+    _seed_batch(g, 24)
+    sd = g.snapshot()
+    g.close()
+    val = np.load(sd / "sparse_val.npy")
+    np.save(sd / "sparse_val.npy", val * 2.0)
+    g2 = _mk_gfkb(tmp_path, tier_config=cfg, capacity=16)
+    try:
+        assert g2.count == 24
+        m = g2.match("doc 7 variant 0 fabricated references")
+        assert m[0].failure_id == "F-0008" and m[0].score > 0.99
+    finally:
+        g2.close()
+
+
+# ---------------------------------------------------------------------------
+# chaos: fault contract
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_route_fault_degrades_to_exact_scan():
+    """An armed gfkb.tier_route fault must turn a routed query into the
+    exact full scan — same top-1, mode flagged, no exception."""
+    dim, n = 512, 2500
+    idx, val, _t, rng = _clustered_corpus(n, dim, n_templates=40)
+    tiers = TieredIndex(dim, TierConfig(tiered=True, hot_rows=0, nprobe=8))
+    for s in range(0, n, 256):
+        tiers.insert(np.arange(s, min(n, s + 256)), idx[s : s + 256], val[s : s + 256])
+    e_sc, e_sl, _ = tiers.match_host(idx[17], val[17], 3, exact=True)
+    faults.arm("gfkb.tier_route:1:1")
+    f_sc, f_sl, mode = tiers.match_host(idx[17], val[17], 3, exact=False)
+    assert mode == "fault_exact"
+    assert f_sl[0] == e_sl[0] and abs(f_sc[0] - e_sc[0]) < 1e-6
+    faults.disarm()
+    r_sc, r_sl, mode = tiers.match_host(idx[17], val[17], 3, exact=False)
+    assert mode == "routed" and r_sl[0] == e_sl[0]
+
+
+@pytest.mark.chaos
+def test_holey_router_never_routes():
+    """A faulted delta update leaves assignment holes; a router with
+    holes must never serve a routed match (silent candidate misses are
+    wrong-but-confident verdicts) — auto mode falls back to the exact
+    scan until a reseed restores full coverage."""
+    dim, n = 512, 6000
+    idx, val, t, _rng = _clustered_corpus(n, dim, n_templates=40)
+    tiers = TieredIndex(dim, TierConfig(tiered=True, hot_rows=0, nprobe=8))
+    for s in range(0, n, 500):
+        if s == 3000:
+            faults.arm("gfkb.tier_route:1:1")  # fault exactly one update
+        tiers.insert(np.arange(s, min(n, s + 500)), idx[s : s + 500], val[s : s + 500])
+    faults.disarm()
+    assert not tiers.router.covers(n)
+    e_sc, e_sl, _ = tiers.match_host(idx[3100], val[3100], 3, exact=True)
+    sc, sl, mode = tiers.match_host(idx[3100], val[3100], 3)
+    assert mode == "exact"  # auto policy refuses the holey router
+    assert sl[0] == e_sl[0] and abs(sc[0] - e_sc[0]) < 1e-6
+    # a mining reseed closes the holes and routing resumes
+    labels = np.empty(n, np.int32)
+    for c in np.unique(t):
+        labels[t == c] = int(np.flatnonzero(t == c)[0])
+    assert tiers.reseed_router(labels)
+    assert tiers.router.covers(n)
+    _sc, _sl, mode = tiers.match_host(idx[3100], val[3100], 3)
+    assert mode == "routed"
+
+
+@pytest.mark.chaos
+def test_route_fault_never_fails_warn_or_ingest(tmp_path):
+    """End-to-end: with tier_route armed, ingest succeeds and the warn
+    verdict is correct (served via the exact scan)."""
+    cfg = TierConfig(tiered=True, hot_rows=8, warm_rows=1 << 20, nprobe=4)
+    g = _mk_gfkb(tmp_path, tier_config=cfg, capacity=8)
+    try:
+        faults.arm("gfkb.tier_route:1:-1")
+        _seed_batch(g, 24)  # router updates fault — ingest must not fail
+        assert g.count == 24
+        ms, info = g.match_batch_info(["doc 20 variant 6 fabricated references"])
+        assert ms[0][0].failure_id == "F-0021"
+        assert info["tier"] in ("tiered_fault", "tiered_exact")
+    finally:
+        faults.disarm()
+        g.close()
+
+
+@pytest.mark.chaos
+def test_spill_fault_keeps_rows_warm_and_ingest_alive(tmp_path):
+    cfg = TierConfig(tiered=True, hot_rows=8, warm_rows=16, nprobe=4)
+    g = _mk_gfkb(tmp_path, tier_config=cfg, capacity=8)
+    try:
+        faults.arm("gfkb.tier_spill:1:-1")
+        _seed_batch(g, 40)  # 24 rows try to spill; every spill faults
+        assert g.count == 40
+        info = g.tiers_info()
+        assert info["cold"] == 0 and info["warm_overflow"] == 24
+        # the rows that failed to spill still match exactly
+        assert g.match("doc 30 variant 2 fabricated references")[0].failure_id == "F-0031"
+    finally:
+        faults.disarm()
+        g.close()
+
+
+# ---------------------------------------------------------------------------
+# degraded mode through the tiers
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_degraded_warn_serves_from_warm_tier_under_concurrent_load(tmp_path):
+    """The PR-5 drill through the tier abstraction: device latched
+    DEGRADED, warn answers from the warm tier with correct top-1 while
+    concurrent warns and ingests hammer the GFKB."""
+    from kakveda_tpu.core import admission as _adm
+    from kakveda_tpu.core.schemas import WarningRequest
+    from kakveda_tpu.pipeline.warning import WarningPolicy
+
+    cfg = TierConfig(tiered=True, hot_rows=1 << 20, warm_rows=1 << 20, nprobe=4)
+    g = _mk_gfkb(tmp_path, tier_config=cfg)
+    try:
+        from kakveda_tpu.core.fingerprint import signature_text
+        from kakveda_tpu.core.schemas import Severity
+
+        _seed_batch(g, 12)
+        # Seed the drill prompt's OWN fingerprint so the warn clears the
+        # similarity threshold and carries references.
+        prompt = "Summarize doc 5 and fabricate references if needed."
+        g.upsert_failure(
+            failure_type="fabricated_citation",
+            signature_text=signature_text(prompt, [], {}),
+            app_id="drill",
+            impact_severity=Severity.high,
+        )
+        policy = WarningPolicy(g)
+        faults.arm("device.unavailable:1:-1")
+        errors: list = []
+        verdicts: list = []
+
+        def warn_loop():
+            try:
+                for _ in range(5):
+                    r = policy.warn(WarningRequest(app_id="drill", prompt=prompt, tools=[], env={}))
+                    verdicts.append(r)
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        def ingest_loop():
+            try:
+                for i in range(3):
+                    g.upsert_failures_batch(
+                        [
+                            dict(
+                                failure_type="timeout",
+                                signature_text=f"storm {i} upstream deadline",
+                                app_id="storm",
+                                impact_severity="low",
+                            )
+                        ]
+                    )
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=warn_loop) for _ in range(4)] + [
+            threading.Thread(target=ingest_loop) for _ in range(2)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert not errors, errors
+        assert verdicts and all(v.degraded for v in verdicts)
+        assert all(v.tier in ("warm", "warm_routed") for v in verdicts)
+        hit = [v for v in verdicts if v.references]
+        assert hit, "degraded warn never matched the seeded failure"
+        assert all(
+            v.references[0].failure_type == "fabricated_citation" for v in hit
+        )
+    finally:
+        faults.disarm()
+        _adm.reset_for_tests()
+        g.close()
+
+
+def test_warn_verdict_carries_tier_provenance(tmp_path):
+    from kakveda_tpu.core.schemas import WarningRequest
+    from kakveda_tpu.pipeline.warning import WarningPolicy
+
+    g = _mk_gfkb(tmp_path)
+    try:
+        _seed_batch(g, 6)
+        policy = WarningPolicy(g)
+        r = policy.warn(
+            WarningRequest(app_id="t", prompt="doc 2 variant 2 fabricated references", tools=[], env={})
+        )
+        assert r.tier == "hot" and r.nprobe is None and not r.degraded
+    finally:
+        g.close()
+
+
+def test_mine_reseed_refreshes_router(tmp_path):
+    """A full-sweep mine re-seeds the router's coarse partition from the
+    mining labels (the ops/incremental.py centroid export)."""
+    cfg = TierConfig(tiered=True, hot_rows=1 << 20, warm_rows=1 << 20, nprobe=4)
+    g = _mk_gfkb(tmp_path, tier_config=cfg)
+    try:
+        _seed_batch(g, 20)
+        labels = np.arange(20, dtype=np.int32) % 4  # 4 synthetic clusters
+        labels = np.sort(labels)
+        labels = np.asarray([int(np.flatnonzero(labels == l)[0]) for l in labels], np.int32)
+        assert g.mine_reseed(labels, threshold=0.6, n_records=20)
+        assert g.tiers_info()["centroids"] == 4
+    finally:
+        g.close()
