@@ -1946,6 +1946,258 @@ def _bench_overload(backend: str) -> dict:
     }
 
 
+def _bench_fleet(backend: str) -> dict:
+    """Replica-fleet scale-out A/B (docs/scale-out.md): aggregate warn
+    throughput through the front router at 1 vs N replicas, plus router
+    added-latency vs hitting a replica directly, shard balance and
+    hot-key skew.
+
+    The replicas' per-process bottleneck is pinned to the DISPATCH RTT,
+    not CPU: every replica runs with ``KAKVEDA_WARN_RTT_EMU_MS`` (default
+    160 ms — one dispatch + one fetch at the ~80 ms wire RTT of the
+    tunneled TPU this platform actually serves from, CLAUDE.md) so each
+    micro-batched device call blocks one round trip exactly like a remote
+    dispatch/fetch does, releasing the GIL/CPU while it waits. That is the regime horizontal scale-out
+    exists for — per-replica throughput is capped at
+    max_batch/RTT regardless of host cores — and the only regime a
+    1-core bench host can honestly demonstrate scaling in (N CPU-bound
+    replicas on one core aggregate to 1x by construction). The emulation
+    is declared in the JSON row (``rtt_emulated_ms``); on real hardware
+    the wire provides it and the knob stays 0.
+
+    Self-certifying: aggregate throughput at N replicas must reach
+    ``KAKVEDA_BENCH_FLEET_MIN_RATIO`` (default 2.5x) of the single-replica
+    arm with ZERO failed warns in either arm, or the bench raises."""
+    import asyncio
+    import tempfile
+    from pathlib import Path
+
+    import yaml
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from kakveda_tpu.core import metrics as _metrics
+    from kakveda_tpu.fleet.router import make_router_app
+    from kakveda_tpu.fleet.supervisor import FleetSupervisor, pick_port_base
+
+    n_replicas = int(os.environ.get("KAKVEDA_BENCH_FLEET_REPLICAS", 4))
+    rtt_ms = float(os.environ.get("KAKVEDA_BENCH_FLEET_RTT_MS", 160))
+    n_clients = int(os.environ.get("KAKVEDA_BENCH_FLEET_CLIENTS", 48))
+    duration = float(os.environ.get("KAKVEDA_BENCH_FLEET_DUR", 8.0))
+    min_ratio = float(os.environ.get("KAKVEDA_BENCH_FLEET_MIN_RATIO", 2.5))
+
+    tmp = Path(tempfile.mkdtemp(prefix="kakveda-bench-fleet-"))
+    cfg = tmp / "config.yaml"
+    cfg.write_text(yaml.safe_dump({
+        "failure_matching": {
+            "similarity_threshold": 0.8, "embedding_dim": 512, "top_k": 5,
+        },
+    }))
+    replica_env = {
+        "JAX_PLATFORMS": "cpu" if not _on_tpu(backend) else "",
+        "KAKVEDA_CONFIG_PATH": str(cfg),
+        "KAKVEDA_INDEX_CAPACITY": "2048",
+        "KAKVEDA_WARN_RTT_EMU_MS": str(rtt_ms),
+        # Small per-call batches keep each replica RTT-bound (the regime
+        # under test); per-request INFO logging is CPU the shared-core
+        # load generator needs.
+        "KAKVEDA_WARN_MAX_BATCH": "4",
+        "KAKVEDA_LOG_LEVEL": "WARNING",
+        "KAKVEDA_GC_TUNE": "0",
+    }
+    replica_env = {k: v for k, v in replica_env.items() if v != ""}
+
+    def _shard_series() -> dict:
+        fam = _metrics.get_registry().snapshot().get(
+            "kakveda_fleet_shard_load_total", {}
+        )
+        return dict(fam.get("series", {}))
+
+    def run_arm(n: int) -> dict:
+        import httpx
+
+        root = tmp / f"arm-{n}"
+        sup = FleetSupervisor(
+            root, port_base=pick_port_base(n), replicas=n, env=replica_env,
+        )
+        sup.start_all()
+        lat_direct: list = []
+        lat_routed: list = []
+        counts = {"ok": 0, "shed": 0, "failed": 0}
+        shard_before = _shard_series()
+
+        async def go():
+            router_app = make_router_app(
+                sup.backend_map(), probe_interval_s=1.0, eject_fails=3,
+                retries=min(2, n - 1) if n > 1 else 0, timeout_s=20.0,
+            )
+            rc = TestClient(TestServer(router_app))
+            await rc.start_server()
+            try:
+                # Seed the corpus through the router; replication converges
+                # every replica before any measurement.
+                traces = [
+                    {
+                        "trace_id": f"fl-{i}",
+                        "ts": time.time(),
+                        "app_id": f"app-{i % 8}",
+                        "prompt": f"Cite sources for claim {i} even if unavailable.",
+                        "response": "See [1].\n\nReferences:\n[1] Smith (2020).",
+                        "tools": [], "env": {"os": "linux"},
+                    }
+                    for i in range(32)
+                ]
+                r = await rc.post("/ingest/batch", json={"traces": traces})
+                assert r.status == 200, await r.text()
+                loop = asyncio.get_running_loop()
+                for u in sup.urls():
+                    for _ in range(80):
+                        body = await loop.run_in_executor(
+                            None, lambda u=u: httpx.get(u + "/readyz", timeout=5).json()
+                        )
+                        if body["gfkb_count"] > 0:
+                            break
+                        await asyncio.sleep(0.25)
+
+                async def warm_and_time(post, sink, reps):
+                    for i in range(reps):
+                        t0 = time.perf_counter()
+                        rr = await post(i)
+                        await rr.read() if hasattr(rr, "read") else None
+                        sink.append(time.perf_counter() - t0)
+
+                # Router added latency vs direct: sequential probes of the
+                # same replica, unloaded.
+                async with httpx.AsyncClient() as hc:
+                    async def direct(i):
+                        return await hc.post(
+                            sup.url(0) + "/warn",
+                            json={"app_id": "lat", "prompt": f"Cite sources for claim {i}."},
+                            timeout=20.0,
+                        )
+
+                    await direct(0)  # warm the compiled match path
+                    for i in range(20):
+                        t0 = time.perf_counter()
+                        await direct(i)
+                        lat_direct.append(time.perf_counter() - t0)
+                for i in range(20):
+                    t0 = time.perf_counter()
+                    r = await rc.post(
+                        "/warn",
+                        json={"app_id": "lat", "prompt": f"Cite sources for claim {i}."},
+                    )
+                    await r.read()
+                    lat_routed.append(time.perf_counter() - t0)
+
+                # Aggregate throughput: closed-loop clients, one app key
+                # each (the production shape — a client IS an app), so
+                # every shard's batch pipeline stays saturated instead of
+                # every client stalling on the momentarily-slowest shard.
+                stop = asyncio.Event()
+
+                async def client_loop(wid: int):
+                    i = 0
+                    while not stop.is_set():
+                        r = await rc.post("/warn", json={
+                            "app_id": f"app-{wid}",
+                            "prompt": f"Cite sources for claim {wid}-{i}.",
+                        })
+                        await r.read()
+                        if r.status == 200:
+                            counts["ok"] += 1
+                        elif r.status == 429:
+                            counts["shed"] += 1
+                        else:
+                            counts["failed"] += 1
+                        i += 1
+
+                tasks = [asyncio.create_task(client_loop(w)) for w in range(n_clients)]
+                t0 = time.perf_counter()
+                await asyncio.sleep(duration)
+                stop.set()
+                await asyncio.gather(*tasks)
+                return time.perf_counter() - t0
+            finally:
+                await rc.close()
+
+        try:
+            sup.wait_ready(timeout_s=300.0)
+            wall = asyncio.run(go())
+        finally:
+            sup.stop_all()
+        shard_after = _shard_series()
+        shards = {}
+        for label, v in shard_after.items():
+            delta = v - shard_before.get(label, 0)
+            if delta > 0:
+                shards[label] = int(delta)
+        return {
+            "replicas": n,
+            "rate": counts["ok"] / wall,
+            "counts": dict(counts),
+            "wall_s": wall,
+            "warn_p50_direct_ms": float(np.percentile(lat_direct, 50)) * 1e3,
+            "warn_p50_routed_ms": float(np.percentile(lat_routed, 50)) * 1e3,
+            "warn_p95_direct_ms": float(np.percentile(lat_direct, 95)) * 1e3,
+            "warn_p95_routed_ms": float(np.percentile(lat_routed, 95)) * 1e3,
+            "shard_load": shards,
+        }
+
+    one = run_arm(1)
+    many = run_arm(n_replicas)
+    ratio = many["rate"] / one["rate"] if one["rate"] > 0 else 0.0
+    loads = list(many["shard_load"].values())
+    balance = (min(loads) / max(loads)) if loads and max(loads) > 0 else 0.0
+    hot_fam = _metrics.get_registry().snapshot().get(
+        "kakveda_fleet_hot_key_share", {}
+    )
+    hot_share = max(
+        (v for v in hot_fam.get("series", {}).values() if isinstance(v, (int, float))),
+        default=0.0,
+    )
+    added_p50 = many["warn_p50_routed_ms"] - many["warn_p50_direct_ms"]
+    added_p95 = many["warn_p95_routed_ms"] - many["warn_p95_direct_ms"]
+    print(
+        f"bench[fleet]: aggregate warn {one['rate']:.0f}/s @1 -> "
+        f"{many['rate']:.0f}/s @{n_replicas} ({ratio:.2f}x; bound {min_ratio}x); "
+        f"router added p50 {added_p50:+.1f} ms p95 {added_p95:+.1f} ms; "
+        f"shard balance min/max {balance:.2f} {many['shard_load']}; "
+        f"rtt emulated {rtt_ms:.0f} ms",
+        file=sys.stderr,
+    )
+    for arm in (one, many):
+        if arm["counts"]["failed"]:
+            raise AssertionError(
+                f"fleet bench lost {arm['counts']['failed']} warns at "
+                f"{arm['replicas']} replicas — the router must answer or shed, "
+                "never fail"
+            )
+    if ratio < min_ratio:
+        raise AssertionError(
+            f"aggregate warn throughput at {n_replicas} replicas is "
+            f"{ratio:.2f}x the single-replica arm (bound {min_ratio}x) — "
+            "scale-out did not scale"
+        )
+    return {
+        "metric": f"fleet_warn_throughput_scaling_{n_replicas}v1",
+        "value": round(ratio, 2),
+        "unit": "x",
+        "vs_baseline": round(ratio, 2),
+        "rate_1_replica": round(one["rate"], 1),
+        f"rate_{n_replicas}_replicas": round(many["rate"], 1),
+        "router_added_p50_ms": round(added_p50, 2),
+        "router_added_p95_ms": round(added_p95, 2),
+        "warn_p50_routed_ms": round(many["warn_p50_routed_ms"], 2),
+        "shard_load": many["shard_load"],
+        "shard_balance_min_over_max": round(balance, 3),
+        "hot_key_share": round(hot_share, 4),
+        "sheds": {"one": one["counts"]["shed"], "many": many["counts"]["shed"]},
+        "rtt_emulated_ms": rtt_ms,
+        "clients": n_clients,
+        "duration_s": duration,
+    }
+
+
 def _bench_mine(backend: str) -> dict:
     n = int(os.environ.get("KAKVEDA_BENCH_MINE_N", 500_000 if _on_tpu(backend) else 20_000))
     dim = int(os.environ.get("KAKVEDA_BENCH_DIM", 2048))
@@ -2490,6 +2742,7 @@ def main() -> int:
         "serve": _bench_serve,
         "overload": _bench_overload,
         "tiered": _bench_tiered,
+        "fleet": _bench_fleet,
     }
     if which in fns:
         out = fns[which](backend)
@@ -2533,6 +2786,7 @@ def main() -> int:
         _bench_mixed_decode,
         _bench_mine,
         _bench_tiered,
+        _bench_fleet,
     )
     for fn in order:
         if fn.__name__ in done:

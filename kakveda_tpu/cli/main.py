@@ -145,7 +145,47 @@ def _cmd_doctor(args: argparse.Namespace) -> int:
         safe = url.split("@", 1)[-1] if "@" in url else url.split("//", 1)[-1]
         return f"reachable at {safe}"
 
+    def _fleet():
+        """Per-replica health + fleet admission mode, from the manifest
+        `up --replicas` writes (fleet.json) — mirrors the router's
+        /readyz report for operators without curl."""
+        from kakveda_tpu.fleet.supervisor import read_manifest
+
+        manifest = read_manifest(args.dir)
+        if not manifest:
+            return "single-process (no fleet.json)"
+        import httpx
+
+        parts = []
+        worst = "normal"
+        for rep in manifest.get("replicas", []):
+            rid = rep.get("id", "?")
+            pidp = Path(rep.get("pid_file", ""))
+            alive = False
+            try:
+                alive = _pid_alive(int(pidp.read_text().strip()))
+            except (OSError, ValueError):
+                pass
+            mode = "down"
+            if alive:
+                try:
+                    r = httpx.get(rep["url"] + "/readyz", timeout=2.0)
+                    r.raise_for_status()
+                    adm = r.json().get("admission", {})
+                    mode = adm.get("brownout", "?")
+                    steps = ("normal", "no_spec", "clamped",
+                             "shed_background", "shed_interactive")
+                    if mode in steps and steps.index(mode) > steps.index(worst):
+                        worst = mode
+                except (httpx.HTTPError, ValueError):
+                    mode = "unreachable"
+            parts.append(f"{rid}={'up' if alive else 'DOWN'}/{mode}")
+        if any("DOWN" in p or "unreachable" in p for p in parts):
+            raise RuntimeError(" ".join(parts))
+        return f"{' '.join(parts)} fleet_mode={worst}"
+
     check("python", lambda: sys.version.split()[0])
+    check("fleet", _fleet)
     check("jax", _jax)
     check("device mesh", _mesh)
     check("device compute", _device_compute)
@@ -223,12 +263,50 @@ def _cmd_status(args: argparse.Namespace) -> int:
     status["server"] = (
         {"pid": pid, "running": _pid_alive(pid)} if pid else {"pid": None, "running": False}
     )
+    replicas = {}
+    for pidp in sorted(root.glob("replica-*.pid")):
+        try:
+            rpid = int(pidp.read_text().strip())
+        except (OSError, ValueError):
+            continue
+        replicas[pidp.stem] = {"pid": rpid, "running": _pid_alive(rpid)}
+    if replicas:
+        status["replicas"] = replicas
     print(json.dumps(status, indent=2))
     return 0
 
 
 def _cmd_up(args: argparse.Namespace) -> int:
     root = Path(args.dir)
+
+    if getattr(args, "replica_index", None) is not None:
+        # We ARE a fleet replica (spawned by the supervisor): a plain
+        # single-process server with its own data dir and pid file beside
+        # server.pid (replica-<i>.pid / data/replica-<i>/). Fleet identity
+        # (KAKVEDA_REPLICA_ID / _FLEET_SELF / _FLEET_PEERS) arrived in env.
+        i = int(args.replica_index)
+        try:
+            from kakveda_tpu.service.main import run_server
+        except ImportError:
+            print("the HTTP service layer is not available in this build", file=sys.stderr)
+            return 1
+        pidp = root / f"replica-{i}.pid"
+        root.mkdir(parents=True, exist_ok=True)
+        pidp.write_text(str(os.getpid()))
+        try:
+            return run_server(
+                host=args.host,
+                port=args.port,
+                data_dir=str(root / "data" / f"replica-{i}"),
+                dashboard_port=args.dashboard_port or None,
+            )
+        finally:
+            try:
+                if int(pidp.read_text().strip()) == os.getpid():
+                    pidp.unlink()
+            except (OSError, ValueError):
+                pass
+
     pid = _read_pid(root)
     # pid == os.getpid(): we ARE the detached child (the parent recorded
     # our pid before exec'ing us) — not a conflict.
@@ -248,6 +326,9 @@ def _cmd_up(args: argparse.Namespace) -> int:
             "--dir", str(root), "--host", args.host, "--port", str(args.port),
             "--dashboard-port", str(args.dashboard_port),
         ]
+        if getattr(args, "replicas", 0):
+            cmd += ["--replicas", str(args.replicas),
+                    "--port-base", str(args.port_base or 0)]
         root.mkdir(parents=True, exist_ok=True)  # fresh --dir: log lives inside
         logf = open(_log_path(root), "ab")
         proc = subprocess.Popen(
@@ -256,6 +337,9 @@ def _cmd_up(args: argparse.Namespace) -> int:
         _pid_path(root).write_text(str(proc.pid))
         print(f"server starting (pid {proc.pid}); logs: {_log_path(root)}")
         return 0
+
+    if getattr(args, "replicas", 0):
+        return _run_fleet(args, root)
 
     try:
         from kakveda_tpu.service.main import run_server
@@ -278,6 +362,45 @@ def _cmd_up(args: argparse.Namespace) -> int:
             pass
 
 
+def _run_fleet(args: argparse.Namespace, root: Path) -> int:
+    """`up --replicas N [--port-base P]`: spawn N replica servers on
+    P..P+N-1 (per-replica pid/log files, private data dirs), wait for
+    readiness, then serve the front router (fleet/router.py) on --port.
+    The router supervises: health probes + ejection always; process
+    restarts within KAKVEDA_FLEET_RESTARTS. Teardown (SIGTERM/exit or
+    `kakveda-tpu down`) stops every replica."""
+    from aiohttp import web
+
+    from kakveda_tpu.fleet.router import make_router_app
+    from kakveda_tpu.fleet.supervisor import FleetSupervisor
+
+    port_base = args.port_base or (args.port + 1)
+    sup = FleetSupervisor(
+        root, host=args.host, port_base=port_base,
+        replicas=args.replicas, router_port=args.port,
+    )
+    _pid_path(root).write_text(str(os.getpid()))
+    sup.start_all()
+    print(
+        f"fleet: {args.replicas} replicas starting on ports "
+        f"{port_base}..{port_base + args.replicas - 1} "
+        f"(replica-<i>.pid / replica-<i>.log under {root})"
+    )
+    try:
+        sup.wait_ready(timeout_s=float(os.environ.get("KAKVEDA_FLEET_READY_S", "240")))
+        app = make_router_app(sup.backend_map(), supervisor=sup)
+        print(f"fleet router on http://{args.host}:{args.port}")
+        web.run_app(app, host=args.host, port=args.port, print=None)
+        return 0
+    finally:
+        sup.stop_all()
+        try:
+            if _read_pid(root) == os.getpid():
+                _pid_path(root).unlink()
+        except OSError:
+            pass
+
+
 def _cmd_down(args: argparse.Namespace) -> int:
     """Stop the server recorded in server.pid (SIGTERM, bounded wait) —
     real process management, matching the operational intent of the
@@ -286,24 +409,49 @@ def _cmd_down(args: argparse.Namespace) -> int:
     import time
 
     root = Path(args.dir)
+    rc = 0
     pid = _read_pid(root)
     if pid is None:
         print("no server.pid — nothing to stop")
-        return 0
-    if not _pid_alive(pid):
+    elif not _pid_alive(pid):
         print(f"stale server.pid (pid {pid} not running); cleaning up")
         _pid_path(root).unlink(missing_ok=True)
-        return 0
-    os.kill(pid, signal.SIGTERM)
-    deadline = time.time() + args.timeout
-    while time.time() < deadline:
-        if not _pid_alive(pid):
+    else:
+        os.kill(pid, signal.SIGTERM)
+        deadline = time.time() + args.timeout
+        while _pid_alive(pid) and time.time() < deadline:
+            time.sleep(0.2)
+        if _pid_alive(pid):
+            print(f"pid {pid} did not exit within {args.timeout}s (still running)",
+                  file=sys.stderr)
+            rc = 1
+        else:
             _pid_path(root).unlink(missing_ok=True)
             print(f"stopped (pid {pid})")
-            return 0
-        time.sleep(0.2)
-    print(f"pid {pid} did not exit within {args.timeout}s (still running)", file=sys.stderr)
-    return 1
+
+    # Fleet sweep: a foreground fleet parent tears its replicas down on
+    # exit, but a crashed parent (or a SIGKILL'd router) leaves
+    # replica-<i>.pid files behind — stop whatever still runs.
+    for pidp in sorted(root.glob("replica-*.pid")):
+        try:
+            rpid = int(pidp.read_text().strip())
+        except (OSError, ValueError):
+            pidp.unlink(missing_ok=True)
+            continue
+        if _pid_alive(rpid):
+            os.kill(rpid, signal.SIGTERM)
+            deadline = time.time() + args.timeout
+            while _pid_alive(rpid) and time.time() < deadline:
+                time.sleep(0.2)
+            if _pid_alive(rpid):
+                print(f"replica pid {rpid} did not exit within {args.timeout}s",
+                      file=sys.stderr)
+                rc = 1
+                continue
+            print(f"stopped replica (pid {rpid})")
+        pidp.unlink(missing_ok=True)
+    (root / "fleet.json").unlink(missing_ok=True)
+    return rc
 
 
 def _cmd_dlq(args: argparse.Namespace) -> int:
@@ -397,6 +545,12 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--port", type=int, default=8100)
     sp.add_argument("--dashboard-port", type=int, default=8110, help="0 disables the dashboard")
     sp.add_argument("-d", "--detach", action="store_true", help="run in the background (server.pid/server.log)")
+    sp.add_argument("--replicas", type=int, default=0,
+                    help="spawn N service replicas behind a front router on --port (docs/scale-out.md)")
+    sp.add_argument("--port-base", type=int, default=0,
+                    help="first replica port (default --port + 1)")
+    # Internal: set by the fleet supervisor on the children it spawns.
+    sp.add_argument("--replica-index", type=int, default=None, help=argparse.SUPPRESS)
     sp.set_defaults(fn=_cmd_up)
 
     sp = sub.add_parser("down", help="stop the server recorded in server.pid")

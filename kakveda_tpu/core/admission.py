@@ -313,6 +313,12 @@ class AdmissionController:
         )
         self._lock = threading.Lock()
         self._inflight: Dict[str, int] = {k: 0 for k in CLASSES}
+        # Fleet pressure floor (gossip input, fleet/gossip.py): the max
+        # live PEER occupancy with an expiry — while fresh, pressure() is
+        # max(local, fleet) so the brownout ladder degrades fleet-wide.
+        # This is an INPUT feed only: transitions still happen solely in
+        # BrownoutController._set_brownout_state.
+        self._fleet_pressure: Tuple[float, float] = (0.0, 0.0)  # (value, expires)
         # Per-class drain-rate estimate: (completions, window start) over a
         # sliding ~5 s window, plus recent observed queue waits — the two
         # inputs Retry-After and deadline shedding derive from.
@@ -353,14 +359,36 @@ class AdmissionController:
     # -- pressure --------------------------------------------------------
 
     def _pressure_locked(self) -> float:
-        return max(
+        local = max(
             self._inflight[k] / self.limits[k] if self.limits[k] > 0 else 0.0
             for k in CLASSES
         )
+        fp, expires = self._fleet_pressure
+        if fp > local and time.monotonic() < expires:
+            return fp
+        return local
 
     def pressure(self) -> float:
         with self._lock:
             return self._pressure_locked()
+
+    def note_fleet_pressure(self, pressure: float, ttl_s: float = 5.0) -> None:
+        """Gossip input (fleet/gossip.py): fold the fleet's worst live
+        occupancy in as a pressure floor with an expiry — a silent peer
+        stops contributing after ``ttl_s``, so a dead replica can't pin
+        the whole fleet browned-out. Also re-evaluates the ladder, which
+        is how an IDLE replica follows the fleet down (and back up)."""
+        p = max(0.0, min(float(pressure), 2.0))
+        with self._lock:
+            self._fleet_pressure = (p, time.monotonic() + max(0.1, ttl_s))
+            combined = self._pressure_locked()
+        self.brownout.note_pressure(combined)
+
+    def fleet_pressure(self) -> float:
+        """The live (unexpired) fleet pressure floor, 0.0 when none."""
+        with self._lock:
+            fp, expires = self._fleet_pressure
+            return fp if time.monotonic() < expires else 0.0
 
     # -- drain rate / retry-after ---------------------------------------
 
@@ -507,6 +535,7 @@ class AdmissionController:
             },
             "brownout": self.brownout.state,
             "brownout_step": self.brownout.step,
+            "fleet_pressure": round(self.fleet_pressure(), 4),
         }
 
     def reset(self) -> None:
@@ -514,6 +543,7 @@ class AdmissionController:
         Counters are cumulative and stay."""
         with self._lock:
             self._sheds.clear()
+            self._fleet_pressure = (0.0, 0.0)
             for k in CLASSES:
                 self._inflight[k] = 0
                 self._waits[k].clear()

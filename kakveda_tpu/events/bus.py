@@ -58,8 +58,26 @@ log = logging.getLogger("kakveda.events")
 TOPIC_TRACE_INGESTED = "trace.ingested"
 TOPIC_FAILURE_DETECTED = "failure.detected"
 TOPIC_CHILD_SAFETY = "child_safety_alert"
+# Fleet topics (docs/scale-out.md): ``gfkb.replicate`` is the ingest
+# replication log — classified rows accepted by any replica, applied
+# idempotently by event id on every peer (at-least-once + DLQ replay IS
+# the convergence mechanism). ``fleet.control`` is the gossiped control
+# state (occupancy / brownout rung / DEGRADED latch) — EPHEMERAL by
+# convention: every sample is superseded by the next tick, so deliveries
+# are single-attempt and never dead-lettered (mark_ephemeral).
+TOPIC_GFKB_REPLICATE = "gfkb.replicate"
+TOPIC_FLEET_CONTROL = "fleet.control"
 
 Handler = Callable[[dict], Union[Awaitable[Any], Any]]
+
+
+def new_event_id() -> str:
+    """Mint a bus event id (hex uuid4). Events that must be applied
+    idempotently under at-least-once delivery (``gfkb.replicate``) carry
+    one in their ``id`` field; subscribers dedup on it."""
+    import uuid
+
+    return uuid.uuid4().hex
 
 
 class EventBus:
@@ -100,6 +118,11 @@ class EventBus:
         # so two event loops can touch this dict from different threads.
         self._breakers: Dict[str, dict] = {}
         self._breaker_lock = threading.Lock()
+        # Ephemeral topics (fleet gossip): single-attempt URL delivery, no
+        # dead-lettering — each event is superseded by the next tick, so
+        # retrying or replaying a stale one is pure waste. The breaker
+        # still applies (a dead peer must not cost a timeout per tick).
+        self._ephemeral_topics: set = set()
         if self._persist_path is not None:
             self._replay_subscriptions()
         self._fault_deliver = _faults.site("bus.deliver")
@@ -200,6 +223,18 @@ class EventBus:
 
     def topics(self) -> Dict[str, int]:
         return {k: len(v) for k, v in self._subs.items()}
+
+    def url_subscribers(self, topic: str) -> List[str]:
+        """The URL (external) subscribers of a topic — fleet startup uses
+        this to prune stale peer subscriptions without reaching into the
+        subscription table."""
+        return [s for s in self._subs.get(topic, []) if isinstance(s, str)]
+
+    def mark_ephemeral(self, topic: str) -> None:
+        """Opt a topic out of the at-least-once policy: URL deliveries are
+        single-attempt and never dead-lettered (gossip semantics — the next
+        tick supersedes this one). Local handlers are unaffected."""
+        self._ephemeral_topics.add(topic)
 
     def has_subscribers(self, topic: str, exclude: Collection[Handler] = ()) -> bool:
         return any(s not in exclude for s in self._subs.get(topic, []))
@@ -308,41 +343,54 @@ class EventBus:
 
     async def _deliver_url(self, topic: str, url: str, event: dict, client=None) -> bool:
         """At-least-once URL delivery: breaker gate, bounded retries with
-        exponential backoff + jitter, dead-letter on exhaustion."""
+        exponential backoff + jitter, dead-letter on exhaustion. Ephemeral
+        topics (mark_ephemeral) keep the breaker gate but drop the retries
+        and the DLQ — the next sample supersedes this one."""
+        ephemeral = topic in self._ephemeral_topics
         if not self._breaker_allow(url):
             self._m_att_short.inc()
-            self._dead_letter(topic, url, event, "circuit breaker open", 0)
+            if not ephemeral:
+                self._dead_letter(topic, url, event, "circuit breaker open", 0)
             return False
-        for attempt in range(self._retries):
+        retries = 1 if ephemeral else self._retries
+        for attempt in range(retries):
             ok = await self._deliver(url, event, client=client)
             if ok:
                 self._m_att_ok.inc()
                 self._breaker_result(url, True)
                 return True
-            if attempt + 1 < self._retries:
+            if attempt + 1 < retries:
                 self._m_att_retry.inc()
                 await asyncio.sleep(
                     self._retry_base * (2 ** attempt) * (0.5 + random.random())
                 )
         self._m_att_failed.inc()
         self._breaker_result(url, False)
-        self._dead_letter(
-            topic, url, event,
-            f"delivery failed after {self._retries} attempt(s)", self._retries,
-        )
+        if not ephemeral:
+            self._dead_letter(
+                topic, url, event,
+                f"delivery failed after {retries} attempt(s)", retries,
+            )
         return False
 
     async def _deliver(self, sub: Union[Handler, str], event: dict, client=None) -> bool:
         try:
             self._fault_deliver.fire()
             if isinstance(sub, str):
+                # A non-2xx answer IS a failed delivery: the subscriber did
+                # not accept the event (crashed handler, 429 shed, …), so
+                # the at-least-once policy must retry/dead-letter it — a
+                # fire-and-forget POST that ignores the status would count
+                # a peer's 500 as delivered and silently lose the event
+                # (the fleet replication log rides this path).
                 if client is not None:
-                    await client.post(sub, json=event)
+                    r = await client.post(sub, json=event)
                 else:
                     import httpx
 
                     async with httpx.AsyncClient(timeout=self.delivery_timeout) as c:
-                        await c.post(sub, json=event)
+                        r = await c.post(sub, json=event)
+                r.raise_for_status()
                 return True
             if asyncio.iscoroutinefunction(sub):
                 await asyncio.wait_for(sub(event), timeout=self.delivery_timeout)

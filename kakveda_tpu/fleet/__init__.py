@@ -1,0 +1,28 @@
+"""Replica fleet — horizontal scale-out of the serving tier (ROADMAP
+item 3, docs/scale-out.md).
+
+The single-process platform becomes an N-replica deployment on one box:
+
+* :mod:`kakveda_tpu.fleet.hashring` — deterministic consistent hashing
+  (warn traffic shards by app key; losing a replica remaps ~1/N of keys).
+* :mod:`kakveda_tpu.fleet.router` — the front router app: forwards by
+  ring assignment, probes replica health, ejects on consecutive
+  transport failures, retries idempotent warn reads on the next replica.
+* :mod:`kakveda_tpu.fleet.gossip` — control-state gossip over the bus
+  (``fleet.control``): every replica publishes occupancy / brownout rung
+  / DEGRADED latch and folds the fleet view back into its OWN admission
+  controller as a pressure input (never writing gate state directly).
+* :mod:`kakveda_tpu.fleet.supervisor` — spawn / supervise / tear down
+  replica processes (``cli up --replicas N``; per-replica pid/log files
+  beside the single-process server.pid/server.log convention).
+
+GFKB ingest fan-in rides the existing at-least-once bus
+(``gfkb.replicate`` topic): the accepting replica publishes classified
+rows as the replication log, every peer applies them idempotently by
+event id through the tiered insert path, and DLQ replay converges
+stragglers after an outage.
+"""
+
+from kakveda_tpu.fleet.hashring import HashRing
+
+__all__ = ["HashRing"]

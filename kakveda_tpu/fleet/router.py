@@ -1,0 +1,504 @@
+"""The fleet front router — one ingress port over N service replicas.
+
+``cli up --replicas N`` mounts this app on the public port; replicas
+listen on ``port_base + i``. Routing policy (docs/scale-out.md):
+
+* **Warn traffic shards by app key** (``app_id``, falling back to
+  ``signature_text``) over a deterministic consistent-hash ring
+  (:mod:`kakveda_tpu.fleet.hashring`) — affinity keeps each replica's
+  match cache and incremental-mining reuse hot for its share of apps.
+* **Health probes + ejection**: a background probe hits every replica's
+  ``/readyz``; ``KAKVEDA_ROUTER_EJECT_FAILS`` consecutive transport
+  failures eject a replica from selection (ring membership is untouched,
+  so recovery restores its exact key range); a successful probe un-ejects.
+* **Retry-on-next-replica** for idempotent reads (warn, match, GETs):
+  a transport failure or 5xx walks the key's stable failover order —
+  the kill-one-replica drill's zero-lost-warns contract. Ingest retries
+  ONLY on connect errors (the request never left), and admin mutations
+  are single-attempt.
+* 429/503 from a replica are passed through untouched: those are
+  admission/degraded verdicts, not router failures — shedding stays
+  end-to-end typed (core/admission.py).
+
+The router is deliberately stateless beyond health/breaker bookkeeping:
+all durable state lives in the replicas, so a router restart only needs
+the backend list to resume identical routing (hashring determinism).
+
+Metrics: the ``kakveda_fleet_*`` family (docs/observability.md) —
+per-replica forwards/ejections/health, reroute counter, router overhead
+histogram and a hot-key skew gauge (max single-key share of routed warn
+traffic).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+import time
+from typing import Dict, List, Optional
+
+from aiohttp import web
+
+from kakveda_tpu.core import faults as _faults
+from kakveda_tpu.core import metrics as _metrics
+from kakveda_tpu.fleet.hashring import HashRing
+
+log = logging.getLogger("kakveda.fleet")
+
+# Chaos site (docs/robustness.md): an armed router.forward fault fails a
+# forward attempt exactly like a transport error — proving the
+# retry-on-next-replica path without killing a process.
+_FAULT_FORWARD = _faults.site("router.forward")
+
+ROUTER_KEY: web.AppKey["Router"] = web.AppKey("fleet_router", object)  # type: ignore[type-var]
+_PROBE_TASK_KEY: web.AppKey[object] = web.AppKey("fleet_probe_task", object)
+_SUPERVISE_TASK_KEY: web.AppKey[object] = web.AppKey("fleet_supervise_task", object)
+
+# Bounded hot-key accounting: enough keys to see real skew, cheap enough
+# to keep on the forward hot path.
+_HOT_KEYS_MAX = 4096
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, str(default)))
+    except ValueError:
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, str(default)))
+    except ValueError:
+        return default
+
+
+class Router:
+    """Routing + health state over a fixed backend map {replica_id: url}."""
+
+    def __init__(
+        self,
+        backends: Dict[str, str],
+        *,
+        vnodes: Optional[int] = None,
+        probe_interval_s: Optional[float] = None,
+        eject_fails: Optional[int] = None,
+        retries: Optional[int] = None,
+        timeout_s: Optional[float] = None,
+    ):
+        if not backends:
+            raise ValueError("router needs at least one backend replica")
+        self.backends = dict(backends)
+        self.ring = HashRing(
+            list(self.backends),
+            vnodes=_env_int("KAKVEDA_FLEET_VNODES", 64) if vnodes is None else vnodes,
+        )
+        self.probe_interval_s = (
+            _env_float("KAKVEDA_ROUTER_PROBE_S", 1.0)
+            if probe_interval_s is None else probe_interval_s
+        )
+        self.eject_fails = (
+            _env_int("KAKVEDA_ROUTER_EJECT_FAILS", 3)
+            if eject_fails is None else eject_fails
+        )
+        # Extra attempts after the owner for idempotent reads.
+        self.retries = (
+            min(_env_int("KAKVEDA_ROUTER_RETRIES", 2), len(self.backends) - 1)
+            if retries is None else retries
+        )
+        self.timeout_s = (
+            _env_float("KAKVEDA_ROUTER_TIMEOUT_S", 15.0)
+            if timeout_s is None else timeout_s
+        )
+        self._state = {
+            rid: {"fails": 0, "ejected": False, "healthy": None, "ready": None}
+            for rid in self.backends
+        }
+        self._client = None  # httpx.AsyncClient, bound at app startup
+        self._hot_keys: Dict[str, int] = {}
+        self._hot_total = 0
+        reg = _metrics.get_registry()
+        fwd = reg.counter(
+            "kakveda_fleet_forwards_total",
+            "Router forwards by replica and outcome (ok|error|passthrough)",
+            ("replica", "outcome"),
+        )
+        self._m_fwd = {
+            rid: {o: fwd.labels(replica=rid, outcome=o)
+                  for o in ("ok", "error", "passthrough")}
+            for rid in self.backends
+        }
+        self._m_reroutes = reg.counter(
+            "kakveda_fleet_reroutes_total",
+            "Requests retried on the next replica after a forward failure",
+        )
+        ej = reg.counter(
+            "kakveda_fleet_ejections_total",
+            "Replica ejections after consecutive forward/probe failures",
+            ("replica",),
+        )
+        self._m_eject = {rid: ej.labels(replica=rid) for rid in self.backends}
+        g_healthy = reg.gauge(
+            "kakveda_fleet_replica_healthy",
+            "1 while a replica answers probes and is not ejected", ("replica",),
+        )
+        self._m_healthy = {rid: g_healthy.labels(replica=rid) for rid in self.backends}
+        load = reg.counter(
+            "kakveda_fleet_shard_load_total",
+            "Key-routed requests per replica (shard balance)", ("replica",),
+        )
+        self._m_load = {rid: load.labels(replica=rid) for rid in self.backends}
+        self._m_overhead = reg.histogram(
+            "kakveda_fleet_router_overhead_seconds",
+            "Wall time the router spends forwarding one request (includes "
+            "the replica's own service time)",
+        )
+        self._m_hot_share = reg.gauge(
+            "kakveda_fleet_hot_key_share",
+            "Share of routed keyed traffic going to the single hottest key "
+            "(hot-key skew indicator)",
+        )
+
+    # -- selection -------------------------------------------------------
+
+    def ejected(self) -> List[str]:
+        return [rid for rid, st in self._state.items() if st["ejected"]]
+
+    def candidates(self, key: str, attempts: int) -> List[str]:
+        """The owner + failover order for ``key``, ejected replicas
+        skipped — unless that empties the list (all ejected), in which
+        case trying beats failing outright."""
+        pref = self.ring.preference(key, limit=attempts)
+        ejected = set(self.ejected())
+        live = [r for r in pref if r not in ejected]
+        return live or pref
+
+    def note_key(self, key: str) -> None:
+        if len(self._hot_keys) >= _HOT_KEYS_MAX and key not in self._hot_keys:
+            return  # bounded: skew among the first 4096 keys is plenty
+        self._hot_keys[key] = self._hot_keys.get(key, 0) + 1
+        self._hot_total += 1
+        self._m_hot_share.set(max(self._hot_keys.values()) / self._hot_total)
+
+    # -- failure accounting ---------------------------------------------
+
+    def note_result(self, rid: str, ok: bool) -> None:
+        st = self._state[rid]
+        if ok:
+            st["fails"] = 0
+            return
+        st["fails"] += 1
+        if st["fails"] >= self.eject_fails and not st["ejected"]:
+            st["ejected"] = True
+            self._m_eject[rid].inc()
+            self._m_healthy[rid].set(0.0)
+            log.warning(
+                "replica %s ejected after %d consecutive failures", rid, st["fails"]
+            )
+
+    # -- forwarding ------------------------------------------------------
+
+    async def forward(
+        self,
+        method: str,
+        path: str,
+        body: Optional[bytes],
+        key: str,
+        *,
+        idempotent: bool,
+        retry_connect_only: bool = False,
+    ) -> web.Response:
+        """Forward one request along ``key``'s candidate list. Transport
+        failures (and 5xx on idempotent routes) walk to the next replica;
+        HTTP verdicts — including 429 shed and 503 degraded — pass through
+        untouched. The forward client is aiohttp (the platform's native
+        HTTP stack): on a shared-core box its per-request cost is roughly
+        half httpx's, which directly bounds router overhead."""
+        import aiohttp
+
+        attempts = 1 + (self.retries if (idempotent or retry_connect_only) else 0)
+        cands = self.candidates(key, attempts)
+        t0 = time.perf_counter()
+        last_err: Optional[str] = None
+        for i, rid in enumerate(cands):
+            if i > 0:
+                self._m_reroutes.inc()
+            url = self.backends[rid] + path
+            try:
+                _FAULT_FORWARD.fire()
+                async with self._client.request(
+                    method, url, data=body,
+                    headers={"Content-Type": "application/json"} if body else None,
+                ) as r:
+                    content = await r.read()
+                    status = r.status
+                    ctype = r.headers.get("Content-Type", "application/json")
+                    retry_after = r.headers.get("Retry-After")
+            except (aiohttp.ClientError, asyncio.TimeoutError,
+                    _faults.FaultInjected) as e:
+                self.note_result(rid, False)
+                self._m_fwd[rid]["error"].inc()
+                last_err = f"{type(e).__name__}: {e}"
+                continue
+            if status >= 500 and idempotent and i + 1 < len(cands):
+                # A dying replica can serve 500s before its socket closes;
+                # an idempotent read is safe to answer from the next one.
+                self.note_result(rid, False)
+                self._m_fwd[rid]["error"].inc()
+                last_err = f"HTTP {status}"
+                continue
+            self.note_result(rid, status < 500)
+            self._m_fwd[rid]["ok" if status < 500 else "passthrough"].inc()
+            if key:
+                self._m_load[rid].inc()
+            self._m_overhead.observe(time.perf_counter() - t0)
+            headers = {}
+            if retry_after is not None:
+                headers["Retry-After"] = retry_after
+            return web.Response(
+                body=content,
+                status=status,
+                content_type=ctype.split(";")[0],
+                headers=headers,
+            )
+        self._m_overhead.observe(time.perf_counter() - t0)
+        return web.json_response(
+            {"ok": False, "error": f"no replica reachable ({last_err})"},
+            status=502,
+        )
+
+    # -- probing ---------------------------------------------------------
+
+    async def probe_once(self) -> None:
+        import aiohttp
+
+        for rid, url in self.backends.items():
+            st = self._state[rid]
+            try:
+                async with self._client.get(
+                    url + "/readyz",
+                    timeout=aiohttp.ClientTimeout(total=min(2.0, self.timeout_s)),
+                ) as r:
+                    if r.status != 200:
+                        raise ValueError(f"readyz HTTP {r.status}")
+                    st["ready"] = await r.json()
+                st["healthy"] = True
+                st["fails"] = 0
+                if st["ejected"]:
+                    st["ejected"] = False
+                    log.warning("replica %s re-admitted (probe ok)", rid)
+                self._m_healthy[rid].set(1.0)
+            except (aiohttp.ClientError, asyncio.TimeoutError, ValueError) as e:
+                st["healthy"] = False
+                self._m_healthy[rid].set(0.0)
+                self.note_result(rid, False)
+                st["ready"] = None
+                log.debug("probe %s failed: %s", rid, e)
+
+    async def probe_loop(self) -> None:
+        while True:
+            try:
+                await self.probe_once()
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001 — probe must never die
+                log.warning("probe loop error: %s: %s", type(e).__name__, e)
+            await asyncio.sleep(self.probe_interval_s)
+
+    # -- fleet report ----------------------------------------------------
+
+    def report(self) -> dict:
+        """Per-replica health + fleet admission mode — the router /readyz
+        body (and what `cli doctor` prints for a running fleet)."""
+        replicas = {}
+        worst = {"state": "normal", "step": 0}
+        degraded_any = False
+        for rid, st in self._state.items():
+            ready = st["ready"] or {}
+            adm = ready.get("admission") or {}
+            step = int(adm.get("brownout_step", 0) or 0)
+            if st["healthy"] and step > worst["step"]:
+                worst = {"state": adm.get("brownout", "?"), "step": step}
+            dev = ready.get("device") or {}
+            degraded_any = degraded_any or bool(dev.get("degraded"))
+            replicas[rid] = {
+                "url": self.backends[rid],
+                "healthy": st["healthy"],
+                "ejected": st["ejected"],
+                "gfkb_count": ready.get("gfkb_count"),
+                "brownout": adm.get("brownout"),
+                "degraded": bool(dev.get("degraded")),
+            }
+        healthy = [r for r in replicas.values() if r["healthy"]]
+        return {
+            "ok": bool(healthy),
+            "replicas": replicas,
+            "fleet": {
+                "size": len(replicas),
+                "healthy": len(healthy),
+                "brownout": worst["state"],
+                "degraded_any": degraded_any,
+            },
+        }
+
+
+def _route_key(path: str, body: Optional[bytes]) -> str:
+    """The shard key for a request: app_id when the body carries one,
+    signature_text for raw match calls, first trace's app for batches.
+    Unparseable bodies route by empty key (stable arbitrary owner)."""
+    if not body:
+        return ""
+    try:
+        obj = json.loads(body)
+    except ValueError:
+        return ""
+    if not isinstance(obj, dict):
+        return ""
+    if isinstance(obj.get("app_id"), str):
+        return obj["app_id"]
+    tr = obj.get("trace")
+    if isinstance(tr, dict) and isinstance(tr.get("app_id"), str):
+        return tr["app_id"]
+    trs = obj.get("traces")
+    if isinstance(trs, list) and trs and isinstance(trs[0], dict):
+        aid = trs[0].get("app_id")
+        if isinstance(aid, str):
+            return aid
+    sig = obj.get("signature_text")
+    if isinstance(sig, str):
+        return sig
+    return ""
+
+
+def make_router_app(
+    backends: Dict[str, str],
+    *,
+    supervisor=None,
+    **router_kw,
+) -> web.Application:
+    """Build the front-router app over ``{replica_id: base_url}``.
+
+    ``supervisor`` (optional, a :class:`fleet.supervisor.FleetSupervisor`)
+    enables the supervise loop: dead replica processes are restarted up to
+    ``KAKVEDA_FLEET_RESTARTS`` times each (default 0 — route around only)."""
+    router = Router(backends, **router_kw)
+    app = web.Application()
+    app[ROUTER_KEY] = router
+
+    async def _startup(app):
+        import aiohttp
+
+        router._client = aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=router.timeout_s),
+            connector=aiohttp.TCPConnector(limit=256),
+        )
+        await router.probe_once()
+        app[_PROBE_TASK_KEY] = asyncio.get_running_loop().create_task(
+            router.probe_loop()
+        )
+        if supervisor is not None:
+            app[_SUPERVISE_TASK_KEY] = asyncio.get_running_loop().create_task(
+                _supervise_loop(router, supervisor)
+            )
+
+    async def _cleanup(app):
+        for key in (_PROBE_TASK_KEY, _SUPERVISE_TASK_KEY):
+            t = app.get(key)
+            if t is not None:
+                t.cancel()
+                try:
+                    await t
+                except asyncio.CancelledError:
+                    pass
+        if router._client is not None:
+            await router._client.close()
+
+    app.on_startup.append(_startup)
+    app.on_cleanup.append(_cleanup)
+
+    def _keyed(idempotent: bool, retry_connect_only: bool = False):
+        async def handler(request: web.Request):
+            body = await request.read()
+            key = _route_key(request.path, body)
+            if key:
+                router.note_key(key)
+            return await router.forward(
+                request.method, request.path, body or None, key,
+                idempotent=idempotent, retry_connect_only=retry_connect_only,
+            )
+
+        return handler
+
+    async def healthz(request):
+        return web.json_response({"ok": True, "role": "router"})
+
+    async def readyz(request):
+        rep = router.report()
+        return web.json_response(rep, status=200 if rep["ok"] else 503)
+
+    async def metrics_ep(request):
+        return web.Response(
+            body=_metrics.get_registry().render().encode("utf-8"),
+            headers={"Content-Type": _metrics.PROMETHEUS_CONTENT_TYPE},
+        )
+
+    warm = _keyed(idempotent=True)
+    ingest = _keyed(idempotent=False, retry_connect_only=True)
+    admin = _keyed(idempotent=False)
+    reads = _keyed(idempotent=True)
+
+    app.add_routes(
+        [
+            web.get("/healthz", healthz),
+            web.get("/readyz", readyz),
+            web.get("/metrics", metrics_ep),
+            # Sharded, idempotent: retry-on-next-replica.
+            web.post("/warn", warm),
+            web.post("/failures/match", warm),
+            # Sharded ingest: retried only when the connect itself failed.
+            web.post("/ingest", ingest),
+            web.post("/ingest/batch", ingest),
+            # Reads: any healthy replica (replicated GFKB), retryable.
+            web.get("/failures", reads),
+            web.get("/patterns", reads),
+            web.get("/topics", reads),
+            web.get("/health/{app_id}", reads),
+            # Admin mutations: single attempt, owner-routed.
+            web.post("/failures/upsert", admin),
+            web.post("/patterns/upsert", admin),
+            web.post("/patterns/mine", admin),
+            web.post("/snapshot", admin),
+            web.post("/subscribe", admin),
+            web.post("/unsubscribe", admin),
+            web.post("/publish", admin),
+        ]
+    )
+    return app
+
+
+async def _supervise_loop(router: Router, supervisor) -> None:
+    """Restart dead replica processes within the KAKVEDA_FLEET_RESTARTS
+    budget (per replica). Routing already survives the gap (ejection +
+    retry-on-next); this closes the loop for unattended fleets."""
+    budget = _env_int("KAKVEDA_FLEET_RESTARTS", 0)
+    restarts: Dict[int, int] = {}
+    while True:
+        await asyncio.sleep(max(0.5, router.probe_interval_s))
+        try:
+            for idx in supervisor.poll_dead():
+                used = restarts.get(idx, 0)
+                if used >= budget:
+                    continue
+                restarts[idx] = used + 1
+                log.warning(
+                    "replica %d died; restarting (%d/%d)", idx, used + 1, budget
+                )
+                await asyncio.get_running_loop().run_in_executor(
+                    None, supervisor.start, idx
+                )
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # noqa: BLE001 — supervision must never die
+            log.warning("supervise loop error: %s: %s", type(e).__name__, e)

@@ -1,0 +1,248 @@
+"""Replica process lifecycle — spawn, watch, tear down.
+
+``cli up --replicas N --port-base P`` (and the fleet bench / chaos drill)
+drive fleets through this one class so the file conventions stay uniform
+with the single-process server (cli/main.py):
+
+    <root>/replica-<i>.pid     child pid (written by the child itself,
+                               like server.pid)
+    <root>/replica-<i>.log     child stdout/stderr
+    <root>/data/replica-<i>/   the child's private data_dir (per-host
+                               GFKB data-dir invariant — replicas must
+                               never share an append log)
+    <root>/fleet.json          manifest {router_port, replicas:[{id,url,…}]}
+                               read by `cli doctor` / `cli status`
+
+Each child is a plain single-process server (``cli up --replica-index i``)
+with its fleet identity in env: ``KAKVEDA_REPLICA_ID``,
+``KAKVEDA_FLEET_SELF``, ``KAKVEDA_FLEET_PEERS`` — the service app wires
+gossip + replication from those (service/app.py).
+
+Teardown is SIGTERM + bounded wait (never SIGKILL first — a replica
+holding a real TPU lease must exit cleanly or be left alone, CLAUDE.md).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+from pathlib import Path
+from typing import Dict, List, Optional
+
+log = logging.getLogger("kakveda.fleet")
+
+
+def pick_port_base(n: int, host: str = "127.0.0.1") -> int:
+    """Find a base port with ``n`` consecutive free ports — bench/tests
+    allocate fleets on ephemeral ranges without clashing."""
+    for _ in range(64):
+        with socket.socket() as s:
+            s.bind((host, 0))
+            base = s.getsockname()[1]
+        if base + n >= 65535:
+            continue
+        ok = True
+        for p in range(base, base + n):
+            with socket.socket() as s:
+                try:
+                    s.bind((host, p))
+                except OSError:
+                    ok = False
+                    break
+        if ok:
+            return base
+    raise RuntimeError("could not find a free consecutive port range")
+
+
+class FleetSupervisor:
+    """Spawn/supervise/tear down N replica processes under one root."""
+
+    def __init__(
+        self,
+        root: str | Path,
+        *,
+        host: str = "127.0.0.1",
+        port_base: int,
+        replicas: int,
+        env: Optional[Dict[str, str]] = None,
+        router_port: Optional[int] = None,
+    ):
+        self.root = Path(root)
+        self.host = host
+        self.port_base = int(port_base)
+        self.n = int(replicas)
+        self.extra_env = dict(env or {})
+        self.router_port = router_port
+        self.procs: Dict[int, subprocess.Popen] = {}
+        self.root.mkdir(parents=True, exist_ok=True)
+
+    # -- identity --------------------------------------------------------
+
+    def replica_id(self, i: int) -> str:
+        return f"r{i}"
+
+    def url(self, i: int) -> str:
+        return f"http://{self.host}:{self.port_base + i}"
+
+    def urls(self) -> List[str]:
+        return [self.url(i) for i in range(self.n)]
+
+    def backend_map(self) -> Dict[str, str]:
+        """{replica_id: url} — what make_router_app consumes."""
+        return {self.replica_id(i): self.url(i) for i in range(self.n)}
+
+    def pid_file(self, i: int) -> Path:
+        return self.root / f"replica-{i}.pid"
+
+    def log_file(self, i: int) -> Path:
+        return self.root / f"replica-{i}.log"
+
+    def data_dir(self, i: int) -> Path:
+        return self.root / "data" / f"replica-{i}"
+
+    # -- spawn -----------------------------------------------------------
+
+    def _child_env(self, i: int) -> Dict[str, str]:
+        env = dict(os.environ)
+        # Never override PYTHONPATH bare (CLAUDE.md): prepend the repo root
+        # this package was imported from, keep whatever else is there.
+        import kakveda_tpu
+
+        repo = str(Path(kakveda_tpu.__file__).resolve().parents[1])
+        parts = [p for p in env.get("PYTHONPATH", "").split(os.pathsep) if p]
+        if repo not in parts:
+            parts.append(repo)
+        env["PYTHONPATH"] = os.pathsep.join(parts)
+        peers = [self.url(j) for j in range(self.n) if j != i]
+        env.update(
+            KAKVEDA_REPLICA_ID=self.replica_id(i),
+            KAKVEDA_FLEET_SELF=self.url(i),
+            KAKVEDA_FLEET_PEERS=",".join(peers),
+        )
+        env.update(self.extra_env)
+        return env
+
+    def start(self, i: int) -> subprocess.Popen:
+        """Spawn replica ``i`` detached-ish (own session so a router
+        SIGINT doesn't tear the fleet down un-supervised)."""
+        cmd = [
+            sys.executable, "-m", "kakveda_tpu.cli", "up",
+            "--dir", str(self.root),
+            "--host", self.host,
+            "--port", str(self.port_base + i),
+            "--dashboard-port", "0",
+            "--replica-index", str(i),
+        ]
+        self.data_dir(i).mkdir(parents=True, exist_ok=True)
+        logf = open(self.log_file(i), "ab")
+        proc = subprocess.Popen(
+            cmd, stdout=logf, stderr=subprocess.STDOUT,
+            env=self._child_env(i), start_new_session=True,
+        )
+        logf.close()
+        self.procs[i] = proc
+        return proc
+
+    def start_all(self) -> None:
+        for i in range(self.n):
+            self.start(i)
+        self.write_manifest()
+
+    # -- watch -----------------------------------------------------------
+
+    def alive(self, i: int) -> bool:
+        p = self.procs.get(i)
+        return p is not None and p.poll() is None
+
+    def poll_dead(self) -> List[int]:
+        return [i for i in range(self.n) if i in self.procs and not self.alive(i)]
+
+    def wait_ready(self, timeout_s: float = 180.0) -> None:
+        """Block until every replica's /readyz answers — replica startup
+        (jax import + platform build) dominates fleet bring-up."""
+        import httpx
+
+        deadline = time.monotonic() + timeout_s
+        pending = set(range(self.n))
+        while pending:
+            for i in sorted(pending):
+                if not self.alive(i):
+                    tail = ""
+                    try:
+                        tail = self.log_file(i).read_text(errors="replace")[-2000:]
+                    except OSError:
+                        pass
+                    raise RuntimeError(
+                        f"replica {i} exited during startup; log tail:\n{tail}"
+                    )
+                try:
+                    r = httpx.get(self.url(i) + "/readyz", timeout=2.0)
+                    if r.status_code == 200:
+                        pending.discard(i)
+                except httpx.HTTPError:
+                    pass
+            if pending:
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"replicas {sorted(pending)} not ready within {timeout_s}s"
+                    )
+                time.sleep(0.25)
+
+    # -- teardown --------------------------------------------------------
+
+    def stop(self, i: int, timeout_s: float = 20.0, sig: int = signal.SIGTERM) -> None:
+        p = self.procs.get(i)
+        if p is None or p.poll() is not None:
+            return
+        try:
+            p.send_signal(sig)
+        except ProcessLookupError:
+            return
+        try:
+            p.wait(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            log.warning("replica %d did not exit within %.0fs; leaving it "
+                        "(never SIGKILL a process that may hold a device "
+                        "lease)", i, timeout_s)
+
+    def stop_all(self, timeout_s: float = 20.0) -> None:
+        for i in list(self.procs):
+            self.stop(i, timeout_s=timeout_s)
+        for i in list(self.procs):
+            self.pid_file(i).unlink(missing_ok=True)
+        (self.root / "fleet.json").unlink(missing_ok=True)
+
+    # -- manifest --------------------------------------------------------
+
+    def write_manifest(self) -> None:
+        manifest = {
+            "router_port": self.router_port,
+            "host": self.host,
+            "port_base": self.port_base,
+            "replicas": [
+                {
+                    "id": self.replica_id(i),
+                    "url": self.url(i),
+                    "pid_file": str(self.pid_file(i)),
+                    "log_file": str(self.log_file(i)),
+                    "data_dir": str(self.data_dir(i)),
+                }
+                for i in range(self.n)
+            ],
+        }
+        (self.root / "fleet.json").write_text(json.dumps(manifest, indent=2))
+
+
+def read_manifest(root: str | Path) -> Optional[dict]:
+    """The fleet manifest written at spawn, or None (single-process)."""
+    p = Path(root) / "fleet.json"
+    try:
+        return json.loads(p.read_text())
+    except (OSError, ValueError):
+        return None

@@ -114,6 +114,15 @@ class GFKB:
             self.data_dir.mkdir(parents=True, exist_ok=True)
         self.failures_path = self.data_dir / "failures.jsonl"
         self.patterns_path = self.data_dir / "patterns.jsonl"
+        # Replication idempotency (fleet ingest fan-in, docs/scale-out.md):
+        # bus events applied through upsert_failures_batch(event_id=…) are
+        # dedup'd on this set, persisted as their own append log so DLQ
+        # replay and at-least-once redelivery stay dedup-safe ACROSS
+        # restarts. Bounded (KAKVEDA_GFKB_APPLIED_MAX, FIFO eviction) —
+        # far larger than any plausible redelivery window.
+        self.applied_path = self.data_dir / "applied_events.jsonl"
+        self._applied_events: "OrderedDict[str, bool]" = OrderedDict()
+        self._applied_max = int(os.environ.get("KAKVEDA_GFKB_APPLIED_MAX", "65536"))
 
         self.mesh = mesh if mesh is not None else create_mesh("data:-1")
         self.featurizer = featurizer or HashedNGramFeaturizer(dim=dim)
@@ -200,6 +209,13 @@ class GFKB:
             "Warn verdicts served by the host-side fallback index while "
             "the backend is degraded",
         )
+        _rep = _metrics.get_registry().counter(
+            "kakveda_gfkb_replicate_apply_total",
+            "Bus-replicated ingest events applied to this GFKB by outcome "
+            "(applied|dedup)", ("outcome",),
+        )
+        self._m_rep_applied = _rep.labels(outcome="applied")
+        self._m_rep_dedup = _rep.labels(outcome="dedup")
 
         # Incremental mining state (KAKVEDA_MINE_INCREMENTAL=0 restores
         # the full-sweep-only behavior bit-for-bit: no state, no cache, no
@@ -398,6 +414,17 @@ class GFKB:
                 lambda t: PatternEntity.model_validate(json.loads(t)),
             ):
                 self._merge_pattern_line(p)
+
+        if self.applied_path.exists():
+            # Replication dedup set: replayed whole (the log is ids only,
+            # ~40 bytes/event) with the same torn-tail tolerance. A torn
+            # final id means that event's rows may replay once more — an
+            # occurrence bump, never a duplicate record (upserts key on
+            # (failure_type, signature_text)).
+            for rec in self._iter_log_lines(self.applied_path, 0, json.loads):
+                eid = rec.get("id") if isinstance(rec, dict) else None
+                if isinstance(eid, str):
+                    self._applied_note_locked(eid)
 
     # --- snapshot / restore --------------------------------------------
 
@@ -1104,17 +1131,46 @@ class GFKB:
             self._embed_new_slots([slot], [signature_text], [tid], gen)
         return rec, created
 
-    def upsert_failures_batch(self, items: Sequence[dict]) -> List[Tuple[CanonicalFailureRecord, bool]]:
+    def _applied_note_locked(self, event_id: str) -> None:
+        """Record an applied replication event id in the bounded dedup set
+        (caller holds ``_lock``, or is single-threaded construction)."""
+        self._applied_events[event_id] = True
+        self._applied_events.move_to_end(event_id)
+        while len(self._applied_events) > self._applied_max:
+            self._applied_events.popitem(last=False)
+
+    def apply_replication(self, rows: Sequence[dict], event_id: str) -> int:
+        """Apply one bus-replicated ingest event (fleet fan-in) through the
+        normal tiered insert path, idempotently by event id: at-least-once
+        redelivery and DLQ replay of an already-applied event are no-ops.
+        Returns the number of rows applied (0 on dedup)."""
+        out = self.upsert_failures_batch(rows, event_id=event_id)
+        if out:
+            self._m_rep_applied.inc()
+        else:
+            self._m_rep_dedup.inc()
+        return len(out)
+
+    def upsert_failures_batch(
+        self, items: Sequence[dict], event_id: Optional[str] = None
+    ) -> List[Tuple[CanonicalFailureRecord, bool]]:
         """Batched upsert for the streaming-ingest path.
 
         New signatures are embedded in one ``encode_batch`` and written to the
         device in one scatter — the 10k traces/sec path.
+
+        ``event_id`` (replication apply): when set and already applied, the
+        whole batch is a dedup no-op; otherwise the id is appended to its
+        own log AFTER the row lines, so a crash between the two replays the
+        rows on redelivery (an occurrence bump) rather than losing them.
         """
         out: List[Tuple[CanonicalFailureRecord, bool]] = []
         new_slots: List[int] = []
         new_texts: List[str] = []
         new_tids: List[int] = []
         with self._lock:
+            if event_id is not None and event_id in self._applied_events:
+                return []
             gen = self._generation
             now = utcnow()
             for item in items:
@@ -1169,6 +1225,9 @@ class GFKB:
                     self._records[slot] = rec
                     out.append((rec, False))
                 self._append_line(self.failures_path, rec.model_dump_json())
+            if event_id is not None:
+                self._applied_note_locked(event_id)
+                self._append_line(self.applied_path, json.dumps({"id": event_id}))
             self._flush_logs()
             if new_slots:
                 self._pending_embeds += 1

@@ -37,6 +37,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from kakveda_tpu.parallel.mesh import shard_map as _shard_map
+
 Params = Dict[str, Any]
 
 _NEG_INF = -1e30
@@ -566,7 +568,7 @@ def _attention_block(
             k = _repeat_kv(k, cfg.n_heads // cfg.n_kv_heads)
             v = _repeat_kv(v, cfg.n_heads // cfg.n_kv_heads)
         spec = P("dp", cp_axis, tp, None)
-        attn = jax.shard_map(
+        attn = _shard_map(
             partial(
                 ring_attention_local,
                 axis_name=cp_axis,

@@ -28,6 +28,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from kakveda_tpu.ops import pallas_knn
+from kakveda_tpu.parallel.mesh import shard_map as _shard_map
 
 # Sentinel below any reachable cosine score (valid range [-1, 1]).
 _NEG = -2.0
@@ -342,7 +343,7 @@ class ShardedKnn:
         # check_vma=False: after the all_gather every shard computes the
         # identical merged top-k, so the outputs are replicated by
         # construction, but the static analysis can't prove it.
-        return jax.shard_map(
+        return _shard_map(
             local,
             mesh=self.mesh,
             in_specs=(P(self.axis, None), P(self.axis), P()),

@@ -41,8 +41,10 @@ from kakveda_tpu.core.schemas import (
 )
 from kakveda_tpu.events.bus import (
     TOPIC_FAILURE_DETECTED,
+    TOPIC_GFKB_REPLICATE,
     TOPIC_TRACE_INGESTED,
     EventBus,
+    new_event_id,
 )
 from kakveda_tpu.index.gfkb import GFKB
 from kakveda_tpu.pipeline.classifier import RuleClassifier
@@ -100,6 +102,10 @@ class Platform:
         # Internal pipeline reactors ride the same bus external subscribers use.
         self.bus.subscribe(TOPIC_TRACE_INGESTED, self._on_trace_event)
         self.bus.subscribe(TOPIC_FAILURE_DETECTED, self._on_failure_event)
+
+        # Fleet identity (docs/scale-out.md): set per-replica by the fleet
+        # supervisor; stamps replication events with their origin.
+        self.replica_id = os.environ.get("KAKVEDA_REPLICA_ID", "")
 
         # Pipeline counters on the process-global metrics plane (scraped
         # at GET /metrics; children resolved once, not per batch).
@@ -170,6 +176,22 @@ class Platform:
         ]
         await loop.run_in_executor(None, self.gfkb.upsert_failures_batch, rows)
         signals_found = [s for _, s in found]
+        # Fleet ingest fan-in: the rows this replica just accepted ARE the
+        # replication log entry — published at-least-once to every peer's
+        # /replicate (retry → breaker → DLQ; `dlq replay` converges
+        # stragglers). The event id makes peer application idempotent
+        # (GFKB.apply_replication). publish() never raises — a peer outage
+        # dead-letters the event, it never fails THIS ingest.
+        if self.bus.has_subscribers(TOPIC_GFKB_REPLICATE):
+            await self.bus.publish(
+                TOPIC_GFKB_REPLICATE,
+                {
+                    "id": new_event_id(),
+                    "origin": self.replica_id,
+                    "ts": time.time(),
+                    "rows": rows,
+                },
+            )
         # Batch-aware reactors run once per batch (one GFKB scan for pattern
         # detection, one health append) — the O(N²) trap of reacting per
         # event is what keeps the reference from streaming throughput. The

@@ -50,11 +50,17 @@ log = logging.getLogger("kakveda.service")
 
 PLATFORM_KEY: web.AppKey[Platform] = web.AppKey("platform", Platform)
 WARN_BATCHER_KEY: web.AppKey[MicroBatcher] = web.AppKey("warn_batcher", MicroBatcher)
+_GOSSIP_TASK_KEY: web.AppKey[object] = web.AppKey("fleet_gossip_task", object)
 
 # Chaos site for the HTTP tier, resolved once at import: an armed
 # service.handler fault turns a request into a clean 500 before its
 # handler runs — proving callers survive the platform's own API failing.
 _FAULT_HANDLER = _faults.site("service.handler")
+# Fleet replication apply (docs/robustness.md): armed, a peer's
+# /replicate apply dies with a clean 500 — the publishing bus retries,
+# breaks, dead-letters, and `dlq replay` converges the gap later. Never
+# a lost row, never a failed ingest at the origin.
+_FAULT_REPLICATE = _faults.site("fleet.replicate_apply")
 
 
 def _json_error(status: int, message: str) -> web.Response:
@@ -206,16 +212,86 @@ def make_app(
     app = web.Application(middlewares=middlewares)
     app[PLATFORM_KEY] = plat
 
+    # Micro-batcher shape is operator surface now that fleets tune it per
+    # replica (docs/scale-out.md): KAKVEDA_WARN_MAX_BATCH coalesced
+    # requests per device call, KAKVEDA_WARN_DEADLINE_MS straggler wait.
+    warn_max_batch = int(os.environ.get("KAKVEDA_WARN_MAX_BATCH", "64") or 64)
+    warn_deadline_s = float(os.environ.get("KAKVEDA_WARN_DEADLINE_MS", "2") or 2) / 1e3
+    run_warn_batch = plat.warn_batch
+    rtt_emu_ms = float(os.environ.get("KAKVEDA_WARN_RTT_EMU_MS", "0") or 0)
+    if rtt_emu_ms > 0:
+        # Dev/bench emulation of the tunneled-accelerator dispatch RTT
+        # (CLAUDE.md: ~70-90 ms wire RTT per dispatch/fetch on the remote
+        # TPU). On a local CPU backend the warn batch returns in
+        # microseconds, which hides the production bottleneck the fleet
+        # exists to parallelize; this adds one blocking RTT per BATCHED
+        # device call (it runs in the batcher's executor thread and
+        # releases the GIL, exactly like a real wire wait). Never set in
+        # production — the real wire provides it.
+        def run_warn_batch(reqs, _inner=plat.warn_batch, _rtt=rtt_emu_ms / 1e3):
+            time.sleep(_rtt)
+            return _inner(reqs)
+
     warn_batcher: MicroBatcher = MicroBatcher(
-        plat.warn_batch, max_batch=64, deadline_s=0.002,
+        run_warn_batch, max_batch=warn_max_batch, deadline_s=warn_deadline_s,
         max_queue=adm.limits["warn"], admission=adm,
     )
     app[WARN_BATCHER_KEY] = warn_batcher
 
+    # Fleet wiring (docs/scale-out.md): a replica spawned by
+    # `cli up --replicas N` carries its identity in env. Peers are
+    # subscribed on the local bus so accepted ingest replicates out
+    # (gfkb.replicate, at-least-once) and control state gossips out
+    # (fleet.control, ephemeral); stale fleet subscriptions from a
+    # previous topology are pruned so dead URLs don't burn the breaker.
+    from kakveda_tpu.events.bus import TOPIC_FLEET_CONTROL, TOPIC_GFKB_REPLICATE
+    from kakveda_tpu.fleet.gossip import FleetView, GossipPublisher
+
+    replica_id = os.environ.get("KAKVEDA_REPLICA_ID", "")
+    fleet_peers = [
+        u.strip().rstrip("/")
+        for u in (os.environ.get("KAKVEDA_FLEET_PEERS", "") or "").split(",")
+        if u.strip()
+    ]
+    gossip_ttl = float(os.environ.get("KAKVEDA_FLEET_GOSSIP_TTL_S", "5") or 5)
+    fleet_view = FleetView(ttl_s=gossip_ttl)
+    gossip: Optional[GossipPublisher] = None
+    if fleet_peers:
+        plat.bus.mark_ephemeral(TOPIC_FLEET_CONTROL)
+        for topic, suffix in (
+            (TOPIC_FLEET_CONTROL, "/fleet/gossip"),
+            (TOPIC_GFKB_REPLICATE, "/replicate"),
+        ):
+            want = {p + suffix for p in fleet_peers}
+            for url in plat.bus.url_subscribers(topic):
+                if url not in want:
+                    plat.bus.unsubscribe(topic, url)
+            for url in sorted(want):
+                plat.bus.subscribe(topic, url)
+        gossip = GossipPublisher(
+            plat.bus, adm, health, replica_id or "r?", fleet_view,
+            interval_s=float(os.environ.get("KAKVEDA_FLEET_GOSSIP_S", "1") or 1),
+        )
+
     async def _on_startup(app):
         warn_batcher.start()
+        if gossip is not None:
+            import asyncio as _asyncio
+
+            app[_GOSSIP_TASK_KEY] = _asyncio.get_running_loop().create_task(
+                gossip.run()
+            )
 
     async def _on_cleanup(app):
+        t = app.get(_GOSSIP_TASK_KEY)
+        if t is not None:
+            import asyncio as _asyncio
+
+            t.cancel()
+            try:
+                await t
+            except _asyncio.CancelledError:
+                pass
         await warn_batcher.stop()
 
     app.on_startup.append(_on_startup)
@@ -231,15 +307,21 @@ def make_app(
         brownout ladder are operating states a balancer/operator must see
         — a degraded platform still answers warns (host fallback), so
         ok stays true; routing decisions read the mode fields."""
-        return web.json_response(
-            {
-                "ok": True,
-                "gfkb_count": plat.gfkb.count,
-                "device": health.info(),
-                "admission": adm.info(),
-                "tiers": plat.gfkb.tiers_info(),
-            }
-        )
+        body = {
+            "ok": True,
+            "gfkb_count": plat.gfkb.count,
+            "device": health.info(),
+            "admission": adm.info(),
+            "tiers": plat.gfkb.tiers_info(),
+        }
+        body["fleet"] = {
+            "replica_id": replica_id,
+            "peers": len(fleet_peers),
+            "view": fleet_view.peers(),
+            "degraded_any": fleet_view.any_degraded(),
+            "worst_brownout": fleet_view.worst_brownout(),
+        }
+        return web.json_response(body)
 
     # --- ingest ---------------------------------------------------------
 
@@ -277,6 +359,53 @@ def make_app(
         return web.json_response(
             {"ok": True, "n": len(req.traces), "failures": len(signals)}
         )
+
+    # --- fleet (replication fan-in + control gossip) --------------------
+
+    async def replicate(request):
+        """Apply one bus-replicated ingest event from a peer replica —
+        idempotent by event id (GFKB dedup set), through the tiered
+        insert path. A failure here (chaos: fleet.replicate_apply) is a
+        clean 500 back to the peer's bus, whose retry/breaker/DLQ policy
+        owns redelivery; a 429 shed behaves the same way. Either way the
+        event converges later — it is never silently dropped here."""
+        try:
+            body = await request.json()
+        except ValueError as e:
+            return _json_error(422, str(e))
+        event_id, rows = body.get("id"), body.get("rows")
+        if not isinstance(event_id, str) or not isinstance(rows, list):
+            return _json_error(422, "id (str) and rows (list) required")
+        _FAULT_REPLICATE.fire()
+        import asyncio as _asyncio
+
+        loop = _asyncio.get_running_loop()
+        with adm.slot("ingest"):
+            try:
+                applied = await loop.run_in_executor(
+                    None, plat.gfkb.apply_replication, rows, event_id
+                )
+            except (KeyError, ValueError) as e:  # malformed row payload
+                return _json_error(422, f"bad replication rows: {e}")
+        return web.json_response(
+            {"ok": True, "applied": applied, "deduped": applied == 0}
+        )
+
+    async def fleet_gossip(request):
+        """Fold one peer control sample into the fleet view and re-feed
+        the folded pressure into the local admission controller (an input
+        — gate state only ever moves through the controller's own
+        single-writer helpers)."""
+        try:
+            body = await request.json()
+        except ValueError as e:
+            return _json_error(422, str(e))
+        fresh = fleet_view.fold(body) if isinstance(body, dict) else False
+        if fresh:
+            adm.note_fleet_pressure(
+                fleet_view.fleet_pressure(), ttl_s=fleet_view.ttl_s
+            )
+        return web.json_response({"ok": True, "fresh": fresh})
 
     # --- warn (micro-batched) -------------------------------------------
 
@@ -321,6 +450,30 @@ def make_app(
             )
         except (KeyError, ValueError, ValidationError) as e:
             return _json_error(422, str(e))
+        # Manual upserts replicate like ingest-classified rows do — an
+        # operator correction must not diverge the fleet's shards.
+        if plat.bus.has_subscribers(TOPIC_GFKB_REPLICATE):
+            from kakveda_tpu.events.bus import new_event_id
+
+            await plat.bus.publish(
+                TOPIC_GFKB_REPLICATE,
+                {
+                    "id": new_event_id(),
+                    "origin": plat.replica_id,
+                    "ts": time.time(),
+                    "rows": [
+                        {
+                            "failure_type": body["failure_type"],
+                            "signature_text": body["signature_text"],
+                            "app_id": body["app_id"],
+                            "impact_severity": body["impact_severity"],
+                            "context_signature": body.get("context_signature"),
+                            "root_cause": body.get("root_cause"),
+                            "resolution": body.get("resolution"),
+                        }
+                    ],
+                },
+            )
         return web.json_response(
             {"ok": True, "created": created, "failure": rec.model_dump(mode="json")}
         )
@@ -447,6 +600,8 @@ def make_app(
             web.post("/unsubscribe", unsubscribe),
             web.post("/publish", publish),
             web.get("/topics", topics),
+            web.post("/replicate", replicate),
+            web.post("/fleet/gossip", fleet_gossip),
         ]
     )
     app.add_routes(metrics_routes())

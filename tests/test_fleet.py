@@ -1,0 +1,685 @@
+"""Replica-fleet tests (kakveda_tpu/fleet/, docs/scale-out.md):
+consistent-hash properties, router sharding/ejection/retry, control-state
+gossip feeding the brownout ladder, idempotent bus-replicated ingest, and
+the kill-one-replica chaos drill over real subprocess replicas."""
+
+import asyncio
+import json
+import time
+import uuid
+from datetime import datetime, timezone
+
+import pytest
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+
+from kakveda_tpu.core import admission as _adm
+from kakveda_tpu.core import faults
+from kakveda_tpu.fleet.hashring import HashRing
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+# ---------------------------------------------------------------------------
+# hash ring properties
+# ---------------------------------------------------------------------------
+
+KEYS = [f"app-{i}" for i in range(2000)]
+
+
+def test_hashring_stable_across_instances():
+    """Assignment is a pure function of (key, membership): a restarted
+    router (fresh ring object) must route every key identically —
+    Python's salted hash() would not."""
+    nodes = [f"r{i}" for i in range(4)]
+    a, b = HashRing(nodes), HashRing(list(reversed(nodes)))
+    for k in KEYS[:500]:
+        assert a.assign(k) == b.assign(k)
+        assert a.preference(k) == b.preference(k)
+
+
+def test_hashring_remap_fraction_on_replica_loss():
+    """Removing one of N nodes remaps ~1/N of keys — and ONLY keys the
+    lost node owned (everyone else keeps their assignment)."""
+    nodes = [f"r{i}" for i in range(4)]
+    ring = HashRing(nodes)
+    smaller = HashRing([n for n in nodes if n != "r2"])
+    moved = 0
+    for k in KEYS:
+        before, after = ring.assign(k), smaller.assign(k)
+        if before != "r2":
+            assert after == before  # survivors keep their keys
+        else:
+            moved += 1
+    # E[moved] = 1/4; allow generous slack for vnode variance.
+    assert 0.10 < moved / len(KEYS) < 0.45, moved / len(KEYS)
+
+
+def test_hashring_balance_and_exclusion():
+    ring = HashRing([f"r{i}" for i in range(4)])
+    counts = {}
+    for k in KEYS:
+        counts[ring.assign(k)] = counts.get(ring.assign(k), 0) + 1
+    assert len(counts) == 4
+    assert max(counts.values()) / (len(KEYS) / 4) < 2.0, counts
+    # Ejection spills to the failover successor, never to nothing.
+    k = KEYS[0]
+    owner = ring.assign(k)
+    spill = ring.assign(k, exclude=(owner,))
+    assert spill is not None and spill != owner
+    assert ring.assign(k, exclude=tuple(ring.nodes)) is None
+
+
+# ---------------------------------------------------------------------------
+# fleet view + gossip → brownout input
+# ---------------------------------------------------------------------------
+
+
+def _sample(replica="rX", seq=1, occ=0.0, **kw):
+    s = {
+        "replica": replica, "seq": seq, "ts": time.time(),
+        "occupancy": occ, "brownout": "normal", "brownout_step": 0,
+        "degraded": False,
+    }
+    s.update(kw)
+    return s
+
+
+def test_fleet_view_freshness_discipline():
+    from kakveda_tpu.fleet.gossip import FleetView
+
+    view = FleetView(ttl_s=0.4)
+    assert view.fold(_sample(seq=2, occ=0.5))
+    # seq regress = at-least-once redelivery / DLQ replay: dropped.
+    assert not view.fold(_sample(seq=2, occ=0.9))
+    assert not view.fold(_sample(seq=1, occ=0.9))
+    assert view.fleet_pressure() == pytest.approx(0.5)
+    # Stale wall-clock ts (a replayed ancient sample): dropped.
+    assert not view.fold(_sample(replica="rY", seq=9, ts=time.time() - 60))
+    # TTL expiry: a silent peer stops contributing pressure.
+    time.sleep(0.5)
+    assert view.fleet_pressure() == 0.0
+    assert view.peers() == {}
+    # Degraded + worst-brownout folds.
+    assert view.fold(_sample(replica="rZ", seq=1, occ=0.2, degraded=True,
+                             brownout="clamped", brownout_step=2))
+    assert view.any_degraded()
+    assert view.worst_brownout() == {"state": "clamped", "step": 2}
+
+
+def test_fleet_pressure_drives_local_ladder():
+    """The gossip input steps the LOCAL ladder (fleet-wide brownout)
+    through the sanctioned note_pressure path, and expires so a dead
+    peer cannot pin the fleet browned-out."""
+    brown = _adm.BrownoutController(enabled=True, enter=0.85, exit=0.3, dwell_s=0.0)
+    adm = _adm.AdmissionController(
+        limits={"warn": 4, "ingest": 2, "interactive": 2, "background": 1},
+        enabled=True, brownout=brown,
+    )
+    adm.note_fleet_pressure(0.95, ttl_s=0.3)
+    assert brown.step == 1  # no_spec — fleet-wide degradation
+    assert adm.pressure() == pytest.approx(0.95)
+    assert adm.info()["fleet_pressure"] == pytest.approx(0.95)
+    time.sleep(0.35)
+    assert adm.pressure() == 0.0  # floor expired
+    adm.note_fleet_pressure(0.0, ttl_s=1.0)
+    assert brown.step == 0  # stepped back down
+
+
+def test_gossip_endpoint_feeds_private_admission(tmp_path):
+    """POST /fleet/gossip folds a peer sample and the ladder follows —
+    end to end through the service app, with a private controller."""
+    from kakveda_tpu.platform import Platform
+    from kakveda_tpu.service.app import make_app
+
+    brown = _adm.BrownoutController(enabled=True, enter=0.85, exit=0.3, dwell_s=0.0)
+    adm = _adm.AdmissionController(enabled=True, brownout=brown)
+    plat = Platform(data_dir=tmp_path / "d", capacity=256, dim=1024)
+    app = make_app(platform=plat, admission=adm)
+
+    async def go(client):
+        r = await client.post("/fleet/gossip", json=_sample(seq=1, occ=0.97))
+        body = await r.json()
+        assert r.status == 200 and body["fresh"]
+        assert brown.state == "no_spec"
+        r = await client.get("/readyz")
+        ready = await r.json()
+        assert ready["admission"]["fleet_pressure"] == pytest.approx(0.97)
+        assert ready["fleet"]["view"]["rX"]["occupancy"] == pytest.approx(0.97)
+        # Replayed sample: not fresh, no double effect.
+        r = await client.post("/fleet/gossip", json=_sample(seq=1, occ=0.97))
+        assert not (await r.json())["fresh"]
+
+    run(_with_client(app, go))
+
+
+# ---------------------------------------------------------------------------
+# replication: idempotent apply + bus fan-in
+# ---------------------------------------------------------------------------
+
+
+def _rows(n, tag):
+    return [
+        {
+            "failure_type": "TIMEOUT",
+            "signature_text": f"{tag} timeout calling service {i}",
+            "app_id": f"app-{i % 4}",
+            "impact_severity": "medium",
+            "context_signature": {},
+            "root_cause": None,
+            "resolution": None,
+        }
+        for i in range(n)
+    ]
+
+
+def test_gfkb_apply_replication_idempotent_across_restart(tmp_path):
+    from kakveda_tpu.index.gfkb import GFKB
+
+    kb = GFKB(data_dir=tmp_path / "d", capacity=128, dim=512)
+    assert kb.apply_replication(_rows(4, "ev1"), "evt-1") == 4
+    assert kb.count == 4
+    # Double delivery: the regression the invariant demands — no double
+    # insert, no occurrence inflation.
+    assert kb.apply_replication(_rows(4, "ev1"), "evt-1") == 0
+    assert kb.count == 4
+    assert all(r.occurrences == 1 for r in kb.list_failures())
+    kb.close()
+    # The dedup set survives restart (applied_events.jsonl replays).
+    kb2 = GFKB(data_dir=tmp_path / "d", capacity=128, dim=512)
+    assert kb2.count == 4
+    assert kb2.apply_replication(_rows(4, "ev1"), "evt-1") == 0
+    assert all(r.occurrences == 1 for r in kb2.list_failures())
+    # A new event id applies normally.
+    assert kb2.apply_replication(_rows(2, "ev2"), "evt-2") == 2
+    assert kb2.count == 6
+    kb2.close()
+
+
+def _trace(app_id, prompt):
+    from kakveda_tpu.models.runtime import STUB_RESPONSE
+
+    return {
+        "trace_id": str(uuid.uuid4()),
+        "ts": datetime.now(timezone.utc).isoformat(),
+        "app_id": app_id,
+        "agent_id": "agent-1",
+        "prompt": prompt,
+        "response": STUB_RESPONSE,
+        "model": "stub",
+        "tools": [],
+        "env": {"os": "linux"},
+    }
+
+
+async def _with_client(app, fn):
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    try:
+        return await fn(client)
+    finally:
+        await client.close()
+
+
+def test_ingest_replicates_to_peer_and_dedups(tmp_path):
+    """Ingest accepted by replica A fans in to replica B over the bus
+    topic; a duplicate POST of the same event (redelivery) is a no-op."""
+    from kakveda_tpu.events.bus import TOPIC_GFKB_REPLICATE
+    from kakveda_tpu.platform import Platform
+    from kakveda_tpu.service.app import make_app
+
+    plat_a = Platform(data_dir=tmp_path / "a", capacity=256, dim=1024)
+    plat_b = Platform(data_dir=tmp_path / "b", capacity=256, dim=1024)
+
+    async def go():
+        app_a, app_b = make_app(platform=plat_a), make_app(platform=plat_b)
+        ca, cb = TestClient(TestServer(app_a)), TestClient(TestServer(app_b))
+        await ca.start_server()
+        await cb.start_server()
+        try:
+            plat_a.bus.subscribe(
+                TOPIC_GFKB_REPLICATE, str(cb.make_url("/replicate"))
+            )
+            traces = [
+                _trace(f"app-{i % 3}", f"Cite sources for claim {i} even if unavailable.")
+                for i in range(8)
+            ]
+            r = await ca.post("/ingest/batch", json={"traces": traces})
+            body = await r.json()
+            assert r.status == 200 and body["failures"] >= 1
+            assert plat_b.gfkb.count == plat_a.gfkb.count > 0
+            occ_before = [rec.occurrences for rec in plat_b.gfkb.list_failures()]
+
+            # Redeliver the same event by hand — dedup by event id.
+            evt = {"id": "dup-evt", "rows": _rows(3, "dup"), "ts": time.time()}
+            r = await cb.post("/replicate", json=evt)
+            assert (await r.json())["applied"] == 3
+            r = await cb.post("/replicate", json=evt)
+            body = await r.json()
+            assert body["applied"] == 0 and body["deduped"]
+            assert [rec.occurrences for rec in plat_b.gfkb.list_failures()][
+                : len(occ_before)
+            ] == occ_before
+            # Malformed: typed 422, never a 500.
+            r = await cb.post("/replicate", json={"rows": "nope"})
+            assert r.status == 422
+        finally:
+            await ca.close()
+            await cb.close()
+
+    run(go())
+
+
+@pytest.mark.chaos
+def test_replicate_apply_fault_dead_letters_then_replay(tmp_path, monkeypatch):
+    """Armed fleet.replicate_apply: the peer's apply 500s, the origin bus
+    exhausts retries and dead-letters the event; disarm + `dlq replay`
+    converges the peer — at-least-once, never a lost row."""
+    monkeypatch.setenv("KAKVEDA_BUS_RETRIES", "2")
+    monkeypatch.setenv("KAKVEDA_BUS_RETRY_BASE", "0.01")
+    faults.disarm()
+    from kakveda_tpu.events.bus import TOPIC_GFKB_REPLICATE, replay_dlq_file
+    from kakveda_tpu.platform import Platform
+    from kakveda_tpu.service.app import make_app
+
+    plat_a = Platform(data_dir=tmp_path / "a", capacity=256, dim=1024)
+    plat_b = Platform(data_dir=tmp_path / "b", capacity=256, dim=1024)
+    dlq = tmp_path / "a" / "dlq.jsonl"
+
+    async def go():
+        ca = TestClient(TestServer(make_app(platform=plat_a)))
+        cb = TestClient(TestServer(make_app(platform=plat_b)))
+        await ca.start_server()
+        await cb.start_server()
+        try:
+            plat_a.bus.subscribe(
+                TOPIC_GFKB_REPLICATE, str(cb.make_url("/replicate"))
+            )
+            faults.arm("fleet.replicate_apply:1.0:-1")
+            traces = [
+                _trace("app-x", f"Cite sources for claim {i} even if unavailable.")
+                for i in range(4)
+            ]
+            r = await ca.post("/ingest/batch", json={"traces": traces})
+            assert r.status == 200  # origin ingest NEVER fails on peer loss
+            assert plat_a.gfkb.count > 0
+            assert plat_b.gfkb.count == 0  # apply died while armed
+            assert dlq.exists() and dlq.read_text().strip()
+        finally:
+            await ca.close()
+            # replay while B is still up but the fault disarmed (off-loop:
+            # the replay's sync POSTs target a server on THIS loop)
+            faults.disarm()
+            out = await asyncio.get_running_loop().run_in_executor(
+                None, lambda: replay_dlq_file(dlq, timeout=5.0)
+            )
+            assert out["failed"] == 0 and out["replayed"] >= 1
+            assert plat_b.gfkb.count == plat_a.gfkb.count
+            await cb.close()
+
+    run(go())
+    _adm.reset_for_tests()
+
+
+def test_ephemeral_topic_never_dead_letters(tmp_path):
+    """fleet.control is gossip: single-attempt delivery, no DLQ — a dead
+    peer costs one failed POST per tick, not a dead-letter flood."""
+    from kakveda_tpu.events.bus import TOPIC_FLEET_CONTROL, EventBus
+
+    bus = EventBus(
+        delivery_timeout=0.5, persist_path=tmp_path / "subs.jsonl",
+    )
+    bus.mark_ephemeral(TOPIC_FLEET_CONTROL)
+    dead = "http://127.0.0.1:9/fleet/gossip"  # port 9: connection refused
+    bus.subscribe(TOPIC_FLEET_CONTROL, dead)
+    bus.subscribe("real.topic", dead)
+    assert bus.url_subscribers(TOPIC_FLEET_CONTROL) == [dead]
+
+    async def go():
+        delivered = await bus.publish(TOPIC_FLEET_CONTROL, _sample())
+        assert delivered == 0
+        assert not (tmp_path / "dlq.jsonl").exists()  # no DLQ for gossip
+        # The same endpoint on a NON-ephemeral topic still dead-letters.
+        await bus.publish("real.topic", {"x": 1})
+        assert (tmp_path / "dlq.jsonl").exists()
+
+    run(go())
+
+
+# ---------------------------------------------------------------------------
+# router: sharding, ejection, retry-on-next
+# ---------------------------------------------------------------------------
+
+
+def _stub_backend(name, seen, *, fail_with=None, gfkb_count=7):
+    """A minimal replica double: records warn app_ids, answers the
+    /readyz shape the router's probe reads."""
+    app = web.Application()
+
+    async def warn(request):
+        body = await request.json()
+        if fail_with is not None:
+            return web.json_response({"ok": False}, status=fail_with)
+        seen.setdefault(name, []).append(body.get("app_id"))
+        return web.json_response(
+            {"action": "silent", "confidence": 0.0, "references": [],
+             "served_by": name}
+        )
+
+    async def readyz(request):
+        return web.json_response(
+            {"ok": True, "gfkb_count": gfkb_count,
+             "admission": {"brownout": "normal", "brownout_step": 0},
+             "device": {"degraded": False}}
+        )
+
+    async def shed(request):
+        return web.json_response(
+            {"ok": False, "error": "shed", "retry_after": 2.0},
+            status=429, headers={"Retry-After": "2"},
+        )
+
+    app.add_routes([
+        web.post("/warn", warn),
+        web.get("/readyz", readyz),
+        web.post("/ingest", shed),
+    ])
+    return app
+
+
+def test_router_shards_by_app_key_with_affinity(tmp_path):
+    from kakveda_tpu.fleet.router import make_router_app
+
+    seen: dict = {}
+
+    async def go():
+        b0 = TestClient(TestServer(_stub_backend("b0", seen)))
+        b1 = TestClient(TestServer(_stub_backend("b1", seen)))
+        await b0.start_server()
+        await b1.start_server()
+        router = make_router_app(
+            {"r0": str(b0.make_url("")).rstrip("/"),
+             "r1": str(b1.make_url("")).rstrip("/")},
+            probe_interval_s=30.0, eject_fails=3, retries=1,
+        )
+        rc = TestClient(TestServer(router))
+        await rc.start_server()
+        try:
+            owners = {}
+            for i in range(32):
+                app_id = f"app-{i % 16}"
+                r = await rc.post("/warn", json={"app_id": app_id, "prompt": "x"})
+                assert r.status == 200
+                owners.setdefault(app_id, set()).add(
+                    (await r.json())["served_by"]
+                )
+            # Affinity: every app key always lands on ONE replica…
+            assert all(len(v) == 1 for v in owners.values()), owners
+            # …and 16 keys spread over both replicas.
+            assert len(seen) == 2, seen
+            # 429 passes through untouched (a shed is a verdict, not a
+            # router failure) with its Retry-After intact.
+            r = await rc.post("/ingest", json={"trace": {"app_id": "a"}})
+            assert r.status == 429 and r.headers["Retry-After"] == "2"
+        finally:
+            await rc.close()
+            await b0.close()
+            await b1.close()
+
+    run(go())
+
+
+def test_router_retries_next_replica_and_ejects_dead(tmp_path):
+    """One backend is a closed port: every request still answers (from
+    the survivor), and after eject_fails consecutive failures the dead
+    replica is ejected — /readyz reports it."""
+    from kakveda_tpu.fleet.router import ROUTER_KEY, make_router_app
+
+    seen: dict = {}
+
+    async def go():
+        live = TestClient(TestServer(_stub_backend("live", seen)))
+        await live.start_server()
+        router_app = make_router_app(
+            {"r0": "http://127.0.0.1:9",  # connection refused
+             "r1": str(live.make_url("")).rstrip("/")},
+            probe_interval_s=30.0, eject_fails=2, retries=1, timeout_s=3.0,
+        )
+        rc = TestClient(TestServer(router_app))
+        await rc.start_server()
+        try:
+            for i in range(12):
+                r = await rc.post(
+                    "/warn", json={"app_id": f"app-{i}", "prompt": "x"}
+                )
+                assert r.status == 200  # zero lost warns
+                assert (await r.json())["served_by"] == "live"
+            router = router_app[ROUTER_KEY]
+            assert "r0" in router.ejected()
+            r = await rc.get("/readyz")
+            rep = await r.json()
+            assert rep["ok"]
+            assert rep["replicas"]["r0"]["ejected"] is True
+            assert rep["replicas"]["r1"]["healthy"] is True
+            assert rep["fleet"]["healthy"] == 1
+        finally:
+            await rc.close()
+            await live.close()
+
+    run(go())
+
+
+@pytest.mark.chaos
+def test_router_forward_fault_reroutes(tmp_path):
+    """Armed router.forward (count=1): the first forward attempt dies
+    like a transport error and the SAME request answers from the next
+    replica — the retry path proven without killing a process."""
+    from kakveda_tpu.fleet.router import make_router_app
+
+    faults.disarm()
+    seen: dict = {}
+
+    async def go():
+        b0 = TestClient(TestServer(_stub_backend("b0", seen)))
+        b1 = TestClient(TestServer(_stub_backend("b1", seen)))
+        await b0.start_server()
+        await b1.start_server()
+        router = make_router_app(
+            {"r0": str(b0.make_url("")).rstrip("/"),
+             "r1": str(b1.make_url("")).rstrip("/")},
+            probe_interval_s=30.0, eject_fails=5, retries=1,
+        )
+        rc = TestClient(TestServer(router))
+        await rc.start_server()
+        try:
+            faults.arm("router.forward:1.0:1")
+            r = await rc.post("/warn", json={"app_id": "app-z", "prompt": "x"})
+            assert r.status == 200
+        finally:
+            faults.disarm()
+            await rc.close()
+            await b0.close()
+            await b1.close()
+
+    run(go())
+
+
+def test_cli_parser_fleet_flags():
+    from kakveda_tpu.cli.main import build_parser
+
+    args = build_parser().parse_args(
+        ["up", "--replicas", "4", "--port-base", "9000", "--dir", "/tmp/x"]
+    )
+    assert args.replicas == 4 and args.port_base == 9000
+    assert args.replica_index is None
+
+
+# ---------------------------------------------------------------------------
+# the kill-one-replica chaos drill (real subprocess replicas)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_kill_one_replica_drill(tmp_path):
+    """SIGTERM one of two replicas mid-load: zero lost warns (the router
+    re-routes every request to the survivor), the dead replica's GFKB gap
+    is healed by DLQ replay after restart, and the fleet state
+    re-converges (router /readyz healthy, ladder normal)."""
+    import yaml
+
+    from kakveda_tpu.events.bus import replay_dlq_file
+    from kakveda_tpu.fleet.router import ROUTER_KEY, make_router_app
+    from kakveda_tpu.fleet.supervisor import FleetSupervisor, pick_port_base
+
+    root = tmp_path / "fleet"
+    root.mkdir()
+    cfg = root / "config.yaml"
+    cfg.write_text(yaml.safe_dump({
+        "failure_matching": {
+            "similarity_threshold": 0.8, "embedding_dim": 512, "top_k": 5,
+        },
+    }))
+    sup = FleetSupervisor(
+        root,
+        port_base=pick_port_base(2),
+        replicas=2,
+        env={
+            "JAX_PLATFORMS": "cpu",
+            "KAKVEDA_CONFIG_PATH": str(cfg),
+            "KAKVEDA_INDEX_CAPACITY": "1024",
+            "KAKVEDA_FLEET_GOSSIP_S": "0.2",
+            "KAKVEDA_BUS_RETRIES": "2",
+            "KAKVEDA_BUS_RETRY_BASE": "0.01",
+            "KAKVEDA_GC_TUNE": "0",
+        },
+    )
+    import httpx
+
+    gap_prompt = "Cite sources for the postmortem gap report even if unavailable."
+
+    async def go():
+        router_app = make_router_app(
+            sup.backend_map(), probe_interval_s=0.3, eject_fails=2,
+            retries=1, timeout_s=10.0,
+        )
+        rc = TestClient(TestServer(router_app))
+        await rc.start_server()
+        statuses: list = []
+        stop = asyncio.Event()
+        task = None
+
+        def _reroutes():
+            from kakveda_tpu.core import metrics as _metrics
+
+            fam = _metrics.get_registry().snapshot().get(
+                "kakveda_fleet_reroutes_total", {}
+            )
+            return sum(
+                v for v in fam.get("series", {}).values()
+                if isinstance(v, (int, float))
+            )
+
+        async def storm():
+            i = 0
+            while not stop.is_set():
+                r = await rc.post("/warn", json={
+                    "app_id": f"app-{i % 16}",
+                    "prompt": f"Cite sources for claim {i}.",
+                })
+                await r.read()
+                statuses.append(r.status)
+                i += 1
+                await asyncio.sleep(0.01)
+
+        try:
+            # Seed through the router; replication converges both replicas.
+            traces = [
+                _trace(f"app-{i % 8}",
+                       f"Cite sources for claim {i} even if unavailable.")
+                for i in range(16)
+            ]
+            r = await rc.post("/ingest/batch", json={"traces": traces})
+            assert r.status == 200, await r.text()
+            counts = []
+            for u in sup.urls():
+                for _ in range(40):
+                    n = httpx.get(u + "/readyz", timeout=5).json()["gfkb_count"]
+                    if n > 0:
+                        break
+                    await asyncio.sleep(0.25)
+                counts.append(n)
+            assert counts[0] == counts[1] > 0, counts
+
+            reroutes_before = _reroutes()
+            task = asyncio.create_task(storm())
+            await asyncio.sleep(1.0)
+            sup.stop(1)  # SIGTERM replica 1 mid-load
+            await asyncio.sleep(2.0)  # router re-routes around the corpse
+
+            # Gap ingest DIRECT to the survivor: its bus delivery to the
+            # dead peer exhausts retries and dead-letters.
+            r = await rc.post("/ingest/batch", json={
+                "traces": [_trace("app-gap", gap_prompt)]
+            })
+            assert r.status == 200
+            dlq = sup.data_dir(0) / "dlq.jsonl"
+            for _ in range(60):
+                if dlq.exists() and dlq.read_text().strip():
+                    break
+                await asyncio.sleep(0.25)
+            assert dlq.exists() and dlq.read_text().strip(), "no DLQ record"
+
+            stop.set()
+            await task
+            # ZERO lost warns: every request during the kill answered 200.
+            assert statuses and all(s == 200 for s in statuses), (
+                len(statuses), [s for s in statuses if s != 200][:5]
+            )
+            router = router_app[ROUTER_KEY]
+            assert _reroutes() > reroutes_before  # reroute path exercised
+
+            # Restart the dead replica: it replays its own log (gap rows
+            # missing), then DLQ replay converges it.
+            sup.start(1)
+            await asyncio.get_running_loop().run_in_executor(
+                None, sup.wait_ready, 240.0
+            )
+            n0 = httpx.get(sup.url(0) + "/readyz", timeout=5).json()["gfkb_count"]
+            n1 = httpx.get(sup.url(1) + "/readyz", timeout=5).json()["gfkb_count"]
+            assert n1 < n0, (n0, n1)  # the gap is real before replay
+            out = replay_dlq_file(dlq, timeout=10.0)
+            assert out["failed"] == 0 and out["replayed"] >= 1, out
+            n1 = httpx.get(sup.url(1) + "/readyz", timeout=5).json()["gfkb_count"]
+            assert n1 == n0, (n0, n1)  # healed
+
+            # The healed replica answers a warn for a gap-row signature.
+            r = httpx.post(sup.url(1) + "/warn", json={
+                "app_id": "probe", "prompt": gap_prompt,
+            }, timeout=30)
+            assert r.status_code == 200
+            body = r.json()
+            assert body["references"], body
+
+            # Fleet state re-converged: probes see both healthy + normal.
+            await router.probe_once()
+            rep = router.report()
+            assert rep["fleet"]["healthy"] == 2, rep
+            assert rep["fleet"]["brownout"] == "normal", rep
+        finally:
+            stop.set()
+            if task is not None and not task.done():
+                task.cancel()
+                try:
+                    await task
+                except asyncio.CancelledError:
+                    pass
+            await rc.close()
+
+    try:
+        sup.start_all()
+        sup.wait_ready(timeout_s=300.0)
+        run(go())
+    finally:
+        sup.stop_all()
