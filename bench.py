@@ -6,11 +6,14 @@ warn-under-decode and pattern mining, and prints ONE JSON line —
 headline = the warn north star, with the rest under ``extra_metrics`` so
 the driver's BENCH_r{N}.json carries every number.
 ``KAKVEDA_BENCH_METRIC=warn|ingest|decode|spec|continuous|mixed|
-mixed-decode|mine|serve|overload|tiered`` runs a single metric instead
-(``overload`` floods the HTTP tier past its admission bounds and proves
-shedding keeps warn p95 bounded; ``tiered`` A/Bs the IVF-routed tiered
-GFKB against the exact oracle at 1M rows plus a 10M host/disk arm —
-docs/robustness.md, docs/performance.md § tiered).
+mixed-decode|mine|serve|overload|tiered|fleet|storm`` runs a single
+metric instead (``overload`` floods the HTTP tier past its admission
+bounds and proves shedding keeps warn p95 bounded; ``tiered`` A/Bs the
+IVF-routed tiered GFKB against the exact oracle at 1M rows plus a 10M
+host/disk arm — docs/robustness.md, docs/performance.md § tiered;
+``storm`` replays the seeded hot-key-skew + failure-storm scenario with
+its chaos timeline through the traffic harness and self-certifies the
+SLO gates — kakveda_tpu/traffic/, docs/robustness.md § traffic harness).
 
 == warn: pre-flight warning p50 latency at a 1M-entry GFKB.
 
@@ -2198,6 +2201,220 @@ def _bench_fleet(backend: str) -> dict:
     }
 
 
+def _bench_storm(backend: str) -> dict:
+    """SLO-gated storm drill (kakveda_tpu/traffic/, docs/robustness.md §
+    traffic harness): replay the composed hot-key-skew + failure-storm
+    scenario open-loop through the real HTTP tier and self-certify the
+    graceful-degradation contract IN-RUN.
+
+    Arm A (single process): seeded `storm` scenario — 90% hot-key warn
+    at capacity, a background mine flood past its class bound, and the
+    chaos timeline (a device-loss window armed via core/faults.py plus
+    gossiped fleet-pressure ticks). The SLO gates assert: zero hung
+    requests, zero lost warns, sheds confined to sheddable classes (warn
+    and ingest NEVER shed), storm-phase warn p95 within the declared
+    multiple of the same run's baseline p95, and the brownout ladder back
+    at `normal` within the gossip TTL of the storm window closing.
+
+    Arm B (fleet): the same scenario against a replica fleet behind the
+    front router with one replica KILLED mid-storm (SIGTERM via the
+    supervisor — the chaos timeline's kill_replica action). Gates: zero
+    hung, zero lost warns (the router retries idempotent reads onto the
+    survivor), warn keeps flowing after the kill.
+
+    Any gate failing raises — a storm row whose degradation was not
+    graceful is not a result."""
+    import asyncio
+    import tempfile
+    from pathlib import Path
+
+    import yaml
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from kakveda_tpu.core import admission as _adm
+    from kakveda_tpu.core import faults as _faults
+    from kakveda_tpu import traffic as _traffic
+    from kakveda_tpu.traffic.slo import percentile as _pct
+
+    seed = int(os.environ.get("KAKVEDA_BENCH_STORM_SEED", 5))
+    duration = float(os.environ.get("KAKVEDA_BENCH_STORM_DUR", 8.0))
+    speed = float(os.environ.get("KAKVEDA_BENCH_STORM_SPEED", 1.0))
+    gossip_ttl = float(os.environ.get("KAKVEDA_BENCH_STORM_TTL", 3.0))
+    p95x = float(os.environ.get("KAKVEDA_BENCH_STORM_P95X", 50.0))
+    fleet_on = os.environ.get("KAKVEDA_BENCH_STORM_FLEET", "1") != "0"
+
+    tmp = Path(tempfile.mkdtemp(prefix="kakveda-bench-storm-"))
+
+    # ---- arm A: single process, full SLO certification ----------------
+    from kakveda_tpu.platform import Platform
+    from kakveda_tpu.service.app import make_app as make_service_app
+
+    sc = _traffic.make_scenario(
+        "storm", seed=seed, duration_s=duration,
+        gossip_ttl_s=gossip_ttl, warn_p95_x=p95x,
+    )
+    brown = _adm.BrownoutController(
+        enabled=True, enter=0.85, exit=0.5, dwell_s=0.25,
+    )
+    # warn sized for DEGRADED throughput (during the device-loss window
+    # the queue absorbs the warm-tier drain rate — warn must never shed);
+    # background at 1 makes the mine flood the sheddable excess.
+    adm = _adm.AdmissionController(
+        limits={"warn": 64, "ingest": 2, "interactive": 8, "background": 1},
+        enabled=True, brownout=brown,
+    )
+    plat = Platform(data_dir=tmp / "data", capacity=1 << 10, dim=1024)
+    svc = make_service_app(platform=plat, admission=adm)
+
+    async def solo():
+        client = TestClient(TestServer(svc))
+        await client.start_server()
+        try:
+            async def post(path, body):
+                resp = await client.post(path, json=body)
+                await resp.read()
+                return resp.status
+
+            return await _traffic.run_scenario(
+                sc, post=post, speed=speed, admission=adm,
+            )
+        finally:
+            await client.close()
+
+    try:
+        res = asyncio.run(solo())
+    finally:
+        _faults.disarm()  # never leak a chaos window into later metrics
+    report = _traffic.evaluate(sc.slo, res)
+    base_p95 = _pct(res.latencies_ms("warn", phase="baseline"), 95)
+    storm_p95 = _pct(res.latencies_ms("warn", phase="storm"), 95)
+    print(
+        f"bench[storm]: solo — {len(res.records)} dispatched, "
+        f"warn p95 baseline {base_p95:.1f} ms / storm {storm_p95:.1f} ms, "
+        f"ladder recovery {res.ladder_recovery_s and round(res.ladder_recovery_s, 2)}s "
+        f"(ttl {gossip_ttl}s); {report.summary()}",
+        file=sys.stderr,
+    )
+    if not report.ok:
+        raise AssertionError(f"storm drill failed its SLO — {report.summary()}")
+
+    # ---- arm B: fleet with one replica killed mid-storm ----------------
+    fleet_out: dict = {"skipped": True}
+    if fleet_on:
+        from kakveda_tpu.fleet.router import make_router_app
+        from kakveda_tpu.fleet.supervisor import FleetSupervisor, pick_port_base
+
+        n_replicas = int(os.environ.get("KAKVEDA_BENCH_STORM_REPLICAS", 2))
+        cfg = tmp / "config.yaml"
+        cfg.write_text(yaml.safe_dump({
+            "failure_matching": {
+                "similarity_threshold": 0.8, "embedding_dim": 512, "top_k": 5,
+            },
+        }))
+        replica_env = {
+            "JAX_PLATFORMS": "cpu" if not _on_tpu(backend) else "",
+            "KAKVEDA_CONFIG_PATH": str(cfg),
+            "KAKVEDA_INDEX_CAPACITY": "2048",
+            "KAKVEDA_LOG_LEVEL": "WARNING",
+            "KAKVEDA_GC_TUNE": "0",
+        }
+        replica_env = {k: v for k, v in replica_env.items() if v != ""}
+        fsc = _traffic.make_scenario(
+            "storm", seed=seed + 1, duration_s=duration,
+            gossip_ttl_s=gossip_ttl, warn_p95_x=p95x,
+            device_loss=False, fleet_pressure=False,
+            kill_replica=n_replicas - 1,
+        )
+        sup = FleetSupervisor(
+            tmp / "fleet", port_base=pick_port_base(n_replicas),
+            replicas=n_replicas, env=replica_env,
+        )
+        sup.start_all()
+
+        async def fleet():
+            router_app = make_router_app(
+                sup.backend_map(), probe_interval_s=0.5, eject_fails=2,
+                retries=1, timeout_s=20.0,
+            )
+            rc = TestClient(TestServer(router_app))
+            await rc.start_server()
+            try:
+                async def post(path, body):
+                    resp = await rc.post(path, json=body)
+                    await resp.read()
+                    return resp.status
+
+                return await _traffic.run_scenario(
+                    fsc, post=post, speed=speed, supervisor=sup,
+                )
+            finally:
+                await rc.close()
+
+        try:
+            sup.wait_ready(timeout_s=300.0)
+            fres = asyncio.run(fleet())
+        finally:
+            sup.stop_all()
+        kill_t = next(
+            c["t"] for c in fsc.chaos if c["action"] == "kill_replica"
+        )
+        after_kill_ok = sum(
+            1 for r in fres.records
+            if r["klass"] == "warn" and r["status"] == "ok"
+            and r["phase"] in ("storm", "recovery")
+        )
+        counts = fres.class_counts()
+        warn_c = counts.get("warn", {})
+        lost = fres.generated("warn") - sum(warn_c.values())
+        hung = sum(c.get("hung", 0) for c in counts.values())
+        bad_shed = {k: c.get("shed", 0) for k, c in counts.items()
+                    if c.get("shed", 0) and k in ("warn", "ingest")}
+        errors = warn_c.get("error", 0)
+        print(
+            f"bench[storm]: fleet — {n_replicas} replicas, replica "
+            f"{n_replicas - 1} killed at t={kill_t}s; warn counts {warn_c}, "
+            f"{after_kill_ok} warns ok during/after the kill window",
+            file=sys.stderr,
+        )
+        if hung or lost > 0 or errors or bad_shed or not after_kill_ok:
+            raise AssertionError(
+                f"fleet storm arm broke the degradation contract: hung={hung} "
+                f"lost={lost} warn_errors={errors} bad_sheds={bad_shed} "
+                f"after_kill_ok={after_kill_ok}"
+            )
+        fleet_out = {
+            "replicas": n_replicas,
+            "killed_replica_at_s": kill_t,
+            "warn_counts": warn_c,
+            "warn_ok_after_kill": after_kill_ok,
+            "late_p95_ms": fres.late_p95_ms(),
+        }
+
+    ratio = round(storm_p95 / max(base_p95, 1e-9), 2)
+    return {
+        "metric": "storm_warn_p95_degradation",
+        "value": ratio,
+        "unit": "x_baseline",
+        "vs_baseline": ratio,
+        "slo_ok": report.ok,
+        "slo": report.to_dict(),
+        "scenario": {"name": "storm", "seed": seed, "duration_s": duration,
+                     "speed": speed, "gossip_ttl_s": gossip_ttl},
+        "warn_p95_baseline_ms": round(base_p95, 2),
+        "warn_p95_storm_ms": round(storm_p95, 2),
+        "ladder_recovery_s": res.ladder_recovery_s
+        and round(res.ladder_recovery_s, 3),
+        "dispatched": len(res.records),
+        "class_counts": res.class_counts(),
+        "shed_counts": adm.shed_counts(),
+        "brownout_occupancy": {
+            k: round(v, 2) for k, v in adm.brownout.occupancy().items()
+        },
+        "late_p95_ms": res.late_p95_ms(),
+        "fleet": fleet_out,
+    }
+
+
 def _bench_mine(backend: str) -> dict:
     n = int(os.environ.get("KAKVEDA_BENCH_MINE_N", 500_000 if _on_tpu(backend) else 20_000))
     dim = int(os.environ.get("KAKVEDA_BENCH_DIM", 2048))
@@ -2743,6 +2960,7 @@ def main() -> int:
         "overload": _bench_overload,
         "tiered": _bench_tiered,
         "fleet": _bench_fleet,
+        "storm": _bench_storm,
     }
     if which in fns:
         out = fns[which](backend)
@@ -2787,6 +3005,7 @@ def main() -> int:
         _bench_mine,
         _bench_tiered,
         _bench_fleet,
+        _bench_storm,
     )
     for fn in order:
         if fn.__name__ in done:

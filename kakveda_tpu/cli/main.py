@@ -1,4 +1,4 @@
-"""`kakveda-tpu` CLI: init | up | down | status | reset | logs | dlq | doctor | version.
+"""`kakveda-tpu` CLI: init | up | down | status | reset | logs | dlq | traffic | doctor | version.
 
 Verb parity with the reference CLI (reference: kakveda_cli/cli.py:46-409),
 re-targeted at the single-process TPU platform: where the reference
@@ -498,6 +498,127 @@ def _cmd_dlq(args: argparse.Namespace) -> int:
     return 0
 
 
+def _cmd_traffic(args: argparse.Namespace) -> int:
+    """Record-replay traffic harness (kakveda_tpu/traffic/,
+    docs/robustness.md § traffic harness):
+
+    * ``record`` — pull GET /flightrecorder from a live server and convert
+      its ``traffic`` ring into a replayable JSONL traffic log.
+    * ``replay`` — drive a traffic log (or a named ``--scenario``)
+      open-loop against a live server at ``--speed``; prints the replay
+      accounting and the SLO report; rc 1 on SLO failure.
+    * ``storm`` — hermetic in-process storm drill: private platform +
+      admission controller, the composed hot-key-skew + failure-storm
+      scenario WITH its chaos timeline (device-loss window, gossiped
+      fleet pressure), SLO-gated. The same harness the `storm` bench row
+      runs; this verb is the operator-sized version.
+
+    Chaos ``faults`` actions arm `core/faults.py` IN THIS PROCESS — they
+    reach a remote server only via its own ``KAKVEDA_FAULTS_TIMELINE``
+    env; ``replay --url`` therefore replays traffic faithfully but leaves
+    remote fault windows to the server's timeline.
+    """
+    import asyncio
+
+    from kakveda_tpu import traffic as T
+
+    if args.action == "record":
+        import urllib.request
+
+        with urllib.request.urlopen(args.url.rstrip("/") + "/flightrecorder",
+                                    timeout=args.timeout) as r:
+            payload = json.loads(r.read().decode("utf-8"))
+        events = T.from_flightrecorder(payload, seed=args.seed)
+        n = T.write_log(args.out, events,
+                        meta={"source": args.url, "seed": args.seed})
+        print(json.dumps({"out": str(args.out), "events": n}))
+        return 0 if n else 1
+
+    async def _replay_against_url(events, chaos=None, notes=None):
+        import aiohttp
+
+        base = args.url.rstrip("/")
+        async with aiohttp.ClientSession() as sess:
+            async def post(path, body):
+                async with sess.post(base + path, json=body) as resp:
+                    await resp.read()
+                    return resp.status
+
+            sc = T.Scenario(name="cli", seed=args.seed, duration_s=0.0,
+                            events=events, chaos=chaos or [],
+                            notes=notes or {})
+            return await T.run_scenario(
+                sc, post=post, speed=args.speed,
+                max_concurrency=args.max_concurrency,
+                timeout_s=args.timeout)
+
+    if args.action == "replay":
+        if args.scenario:
+            sc = T.make_scenario(args.scenario, seed=args.seed,
+                                 duration_s=args.duration)
+            events, chaos, notes, slo = sc.events, sc.chaos, sc.notes, sc.slo
+        else:
+            if not args.log:
+                print("replay needs --log or --scenario", file=sys.stderr)
+                return 2
+            meta, events = T.read_log(args.log)
+            chaos, notes, slo = [], {}, T.SLO()
+        res = asyncio.run(_replay_against_url(events, chaos, notes))
+        import dataclasses
+
+        rep = T.evaluate(dataclasses.replace(slo, recovery_s=None), res)
+        print(json.dumps({"replay": res.to_dict(), "slo": rep.to_dict()},
+                         indent=2))
+        print(rep.summary(), file=sys.stderr)
+        return 0 if rep.ok else 1
+
+    # storm: hermetic in-process drill (TestServer — no port, no detach).
+    import tempfile
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from kakveda_tpu.core import admission as _adm
+    from kakveda_tpu.platform import Platform
+    from kakveda_tpu.service.app import make_app
+
+    sc = T.make_scenario("storm", seed=args.seed, duration_s=args.duration,
+                         gossip_ttl_s=args.gossip_ttl)
+    brown = _adm.BrownoutController(enabled=True, enter=0.85, exit=0.5,
+                                    dwell_s=0.25)
+    # warn sized for DEGRADED throughput: during the device-loss window
+    # the queue must absorb the warm-tier drain rate without shedding
+    # (warn never sheds is a gate); background at 1 so the mine flood is
+    # the sheddable excess.
+    adm = _adm.AdmissionController(
+        limits={"warn": 64, "ingest": 2, "interactive": 8, "background": 1},
+        enabled=True, brownout=brown)
+    tmp = Path(tempfile.mkdtemp(prefix="kakveda-traffic-storm-"))
+
+    async def _storm():
+        plat = Platform(data_dir=tmp / "data", capacity=1 << 10, dim=256)
+        client = TestClient(TestServer(make_app(platform=plat, admission=adm)))
+        await client.start_server()
+        try:
+            async def post(path, body):
+                resp = await client.post(path, json=body)
+                await resp.read()
+                return resp.status
+
+            return await T.run_scenario(
+                sc, post=post, speed=args.speed,
+                max_concurrency=args.max_concurrency,
+                timeout_s=args.timeout, admission=adm)
+        finally:
+            await client.close()
+
+    res = asyncio.run(_storm())
+    rep = T.evaluate(sc.slo, res)
+    print(json.dumps({"replay": res.to_dict(), "slo": rep.to_dict()},
+                     indent=2))
+    print(rep.summary(), file=sys.stderr)
+    return 0 if rep.ok else 1
+
+
 def _cmd_logs(args: argparse.Namespace) -> int:
     """Tail server.log (written by `up --detach`), optionally following —
     the reference's `logs` verb over a file instead of docker-compose
@@ -578,6 +699,34 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--dir", default=".")
     sp.add_argument("--timeout", type=float, default=5.0, help="per-POST replay timeout")
     sp.set_defaults(fn=_cmd_dlq)
+
+    sp = sub.add_parser(
+        "traffic",
+        help="record / replay traffic logs, run SLO-gated storm drills",
+    )
+    sp.add_argument("action", choices=("record", "replay", "storm"))
+    sp.add_argument("--url", default="http://127.0.0.1:8000",
+                    help="server base URL (record/replay)")
+    sp.add_argument("--out", default="traffic.jsonl",
+                    help="record: output traffic log path")
+    sp.add_argument("--log", default=None,
+                    help="replay: traffic log to drive")
+    sp.add_argument("--scenario", default=None,
+                    help="replay: named scenario instead of a log "
+                         "(diurnal|hot_key|failure_storm|near_dup|mixed|storm)")
+    sp.add_argument("--seed", type=int, default=0)
+    sp.add_argument("--duration", type=float, default=12.0,
+                    help="scenario duration in seconds")
+    sp.add_argument("--speed", type=float, default=1.0,
+                    help="replay speed factor (2 = twice real time)")
+    sp.add_argument("--max-concurrency", type=int, default=None,
+                    help="bounded client concurrency "
+                         "(default KAKVEDA_TRAFFIC_MAX_CONC)")
+    sp.add_argument("--timeout", type=float, default=15.0,
+                    help="per-request timeout seconds (hung past this)")
+    sp.add_argument("--gossip-ttl", type=float, default=5.0,
+                    help="storm: gossip TTL / ladder recovery bound")
+    sp.set_defaults(fn=_cmd_traffic)
 
     sp = sub.add_parser("doctor", help="check the runtime environment")
     sp.add_argument("--dir", default=".", help="project root (for .env)")

@@ -51,6 +51,7 @@ from __future__ import annotations
 
 import logging
 import os
+import random
 import threading
 import time
 from collections import deque
@@ -325,6 +326,11 @@ class AdmissionController:
         self._done_count: Dict[str, int] = {k: 0 for k in CLASSES}
         self._done_t0: Dict[str, float] = {k: time.monotonic() for k in CLASSES}
         self._drain_rate: Dict[str, float] = {k: 0.0 for k in CLASSES}
+        # Bounded multiplicative Retry-After jitter fraction (see
+        # retry_after): 0 disables, clamped to [0, 1).
+        self._ra_jitter = min(0.99, max(
+            0.0, _env_float("KAKVEDA_ADMIT_RA_JITTER", 0.25)
+        ))
         self._waits: Dict[str, deque] = {k: deque(maxlen=self._WAIT_WINDOW) for k in CLASSES}
         reg = _metrics.get_registry()
         g_inflight = reg.gauge(
@@ -406,8 +412,16 @@ class AdmissionController:
 
     def retry_after(self, klass: str) -> float:
         """Seconds until the class's backlog plausibly drains: in-flight /
-        observed drain rate, clamped to [0.5, 30]. With no rate measured
-        yet, a 1 s default — honest enough for a fresh process."""
+        observed drain rate, clamped to [0.5, 30], then spread by a bounded
+        multiplicative jitter (±``KAKVEDA_ADMIT_RA_JITTER``, default 0.25).
+
+        The jitter is load-bearing, not cosmetic: without it every client
+        shed in the same saturation window gets the SAME drain-derived
+        hint, and the ones that honor it re-arrive in lockstep — a
+        metastable retry storm that re-saturates the gate exactly one
+        Retry-After later. Spreading the hint de-phases the retry wave.
+        With no rate measured yet the base is a 1 s default — honest
+        enough for a fresh process, and jittered for the same reason."""
         with self._lock:
             rate = self._drain_rate[klass]
             if rate <= 0.0:
@@ -417,15 +431,30 @@ class AdmissionController:
                     rate = self._done_count[klass] / dt
             backlog = self._inflight[klass]
         if rate <= 0.0:
-            return 1.0
-        return min(30.0, max(0.5, backlog / rate))
+            base = 1.0
+        else:
+            base = min(30.0, max(0.5, backlog / rate))
+        if self._ra_jitter <= 0.0:
+            return base
+        # Uniform in [1-j, 1+j]: bounded (a client never waits more than
+        # (1+j)x the honest estimate) and multiplicative (the spread scales
+        # with the backlog it is de-phasing). Floor at the OverloadError
+        # minimum so the typed 429 shape is unchanged.
+        return max(0.1, base * (1.0 + self._ra_jitter * (2.0 * random.random() - 1.0)))
 
     def note_wait(self, klass: str, wait_s: float) -> None:
         """Feed one observed downstream queue wait (engine admission,
-        micro-batcher drain) — the live histogram deadline shedding reads."""
+        micro-batcher drain) — the live histogram deadline shedding reads.
+        Also re-evaluates the brownout ladder: warn traffic flows through
+        the micro-batcher's own bounded queue, never try_admit/release, so
+        without this a warn-only recovery tail produced ZERO pressure
+        samples and the ladder froze at its storm step (caught by the
+        traffic harness's ladder-recovery SLO gate)."""
         self._m_wait[klass].observe(wait_s)
         with self._lock:
             self._waits[klass].append(wait_s)
+            pressure = self._pressure_locked()
+        self.brownout.note_pressure(pressure)
 
     def predicted_wait(self, klass: str) -> float:
         """Pessimistic queue-wait estimate for a NEW request of ``klass``:

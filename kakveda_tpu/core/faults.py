@@ -21,6 +21,11 @@ Design (mirrors the metrics plane's resolve-once pattern):
   ``arm()`` still inject.
 * The RNG is seeded (``KAKVEDA_FAULTS_SEED``, default 0) so a probabilistic
   chaos run replays the same injection sequence.
+* **Timed arming** (:func:`schedule` / ``KAKVEDA_FAULTS_TIMELINE``): a
+  chaos *timeline* applies full arm specs at scheduled offsets — the
+  traffic replayer (kakveda_tpu/traffic) opens and closes outage windows
+  mid-storm with it, and the env form gives subprocess fleet replicas the
+  same capability without any admin API.
 * An injection raises :class:`FaultInjected` at the site and increments
   ``kakveda_faults_injected_total{site=…}`` — chaos runs are observable on
   the same /metrics plane as the recovery they exercise.
@@ -41,11 +46,21 @@ import logging
 import os
 import random
 import threading
+import time
 from typing import Dict, Optional
 
 log = logging.getLogger("kakveda.faults")
 
-__all__ = ["FaultInjected", "FaultSite", "site", "arm", "disarm", "armed_sites"]
+__all__ = [
+    "FaultInjected",
+    "FaultSite",
+    "FaultSchedule",
+    "site",
+    "arm",
+    "disarm",
+    "armed_sites",
+    "schedule",
+]
 
 
 class FaultInjected(RuntimeError):
@@ -169,8 +184,113 @@ def armed_sites() -> Dict[str, FaultSite]:
         return {n: s for n, s in _sites.items() if s.armed}
 
 
+class FaultSchedule:
+    """Timed arming — a chaos *timeline*: apply full :func:`arm` specs at
+    scheduled offsets from ``start()``.
+
+    Entries are ``{"t": offset_s, "spec": "site:prob:count,…"}`` dicts (or
+    ``(t, spec)`` pairs), applied in offset order by a daemon thread. Each
+    entry carries a COMPLETE arming state — :func:`arm` replaces, so an
+    entry with ``spec=""`` is how an outage window closes (the same
+    disarm-ends-the-outage shape as a manual chaos run). ``speed`` divides
+    the offsets, matching the traffic replayer's speed factor
+    (kakveda_tpu/traffic): a 2x replay runs its chaos timeline at 2x too.
+
+    ``cancel()`` stops FUTURE entries only; it deliberately does not
+    disarm — the caller owns terminal cleanup (tests use the standard
+    ``faults.disarm()`` teardown)."""
+
+    def __init__(self, entries, *, speed: float = 1.0, seed: Optional[int] = None):
+        norm = []
+        for e in entries:
+            if isinstance(e, dict):
+                t, spec = float(e["t"]), str(e.get("spec", ""))
+            else:
+                t, spec = float(e[0]), str(e[1])
+            # Parse eagerly so a bad timeline fails at construction, not
+            # mid-run inside a daemon thread nobody is watching.
+            arm_spec_check(spec)
+            norm.append((t, spec))
+        self.entries = sorted(norm, key=lambda p: p[0])
+        self.speed = max(1e-6, float(speed))
+        self.seed = seed
+        self.applied = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "FaultSchedule":
+        if self._thread is not None:
+            return self
+        self._thread = threading.Thread(
+            target=self._run, name="kakveda-fault-schedule", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        t0 = time.monotonic()
+        if self.seed is not None:
+            with _lock:
+                _rng.seed(self.seed)
+        for t, spec in self.entries:
+            delay = t0 + t / self.speed - time.monotonic()
+            if delay > 0 and self._stop.wait(delay):
+                return
+            if self._stop.is_set():
+                return
+            arm(spec)
+            self.applied += 1
+
+    def cancel(self) -> None:
+        self._stop.set()
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    @property
+    def done(self) -> bool:
+        return self._thread is not None and not self._thread.is_alive()
+
+
+def arm_spec_check(spec: str) -> None:
+    """Validate a ``site:prob:count,…`` spec without touching site state
+    (schedule construction, timeline env parse)."""
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        fields = part.split(":")
+        try:
+            float(fields[1]) if len(fields) > 1 and fields[1] else 1.0
+            int(fields[2]) if len(fields) > 2 and fields[2] else 1
+        except ValueError as e:
+            raise ValueError(f"bad fault spec entry {part!r}: {e}") from e
+
+
+def schedule(entries, *, speed: float = 1.0, seed: Optional[int] = None,
+             start: bool = True) -> FaultSchedule:
+    """Build (and by default start) a :class:`FaultSchedule`."""
+    sched = FaultSchedule(entries, speed=speed, seed=seed)
+    return sched.start() if start else sched
+
+
 # Env arming at import: components resolving sites later still see it, and
 # a process started with KAKVEDA_FAULTS set injects from its first event.
 _env_spec = os.environ.get("KAKVEDA_FAULTS", "")
 if _env_spec:
     arm(_env_spec, seed=int(os.environ.get("KAKVEDA_FAULTS_SEED", "0")))
+
+# Env chaos timeline: KAKVEDA_FAULTS_TIMELINE is a JSON array of
+# {"t": offset_s, "spec": "site:prob:count,…"} entries, offsets relative to
+# import. This is how a SUBPROCESS (fleet replica under the storm bench /
+# traffic replayer) gets a mid-run outage window without an admin API: the
+# parent sets the env, the child arms and disarms itself on schedule.
+_env_timeline = os.environ.get("KAKVEDA_FAULTS_TIMELINE", "")
+if _env_timeline:
+    import json as _json
+
+    schedule(
+        _json.loads(_env_timeline),
+        seed=int(os.environ.get("KAKVEDA_FAULTS_SEED", "0")),
+    )

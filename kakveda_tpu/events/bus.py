@@ -112,6 +112,14 @@ class EventBus:
         self._breaker_cooldown = float(
             os.environ.get("KAKVEDA_BUS_BREAKER_COOLDOWN", "30")
         )
+        # DLQ auto-replay (KAKVEDA_DLQ_AUTO_S > 0): when a URL's breaker
+        # RE-closes (open/half_open -> closed — the peer demonstrably
+        # healed), re-deliver the dead-letter queue after that many
+        # seconds, unprompted. Safe because replay is idempotent for
+        # subscribers by contract (gfkb.replicate dedups by event id;
+        # docs/robustness.md). 0 = off: `dlq replay` stays manual.
+        self._dlq_auto_s = float(os.environ.get("KAKVEDA_DLQ_AUTO_S", "0"))
+        self._dlq_auto_pending = False  # guarded by _breaker_lock (coalesce)
         # Per-URL breaker state: {"state": closed|open|half_open,
         # "fails": consecutive failed events, "opened_at": monotonic ts}.
         # A threading lock, not asyncio: publish_sync spins private loops,
@@ -157,6 +165,11 @@ class EventBus:
             "kakveda_bus_dlq_total",
             "Events dead-lettered after retries were exhausted or the "
             "breaker short-circuited",
+        )
+        self._m_dlq_auto = reg.counter(
+            "kakveda_bus_dlq_auto_total",
+            "Automatic DLQ replays triggered by a breaker re-close "
+            "(KAKVEDA_DLQ_AUTO_S), by result", ("result",),
         )
         # Fan-out backpressure gauge: how many deliveries are in flight
         # right now (bounded by MAX_CONCURRENT_DELIVERIES per publish).
@@ -283,7 +296,14 @@ class EventBus:
             br["probing"] = False
             if ok:
                 br["fails"] = 0
+                # A RE-close (open/half_open -> closed) means the peer
+                # healed: the events its outage dead-lettered are now
+                # deliverable, so schedule the auto-replay. A plain ok on
+                # an already-closed breaker is just steady state.
+                reclosed = br["state"] != "closed"
                 self._set_breaker(br, "closed")
+                if reclosed:
+                    self._schedule_dlq_auto_locked()
                 return
             if br["state"] == "half_open":
                 br["opened_at"] = time.monotonic()
@@ -338,6 +358,42 @@ class EventBus:
                     br["probing"] = False
                     self._set_breaker(br, "closed")
         return out
+
+    def _schedule_dlq_auto_locked(self) -> None:
+        """Arm ONE delayed auto-replay after a breaker re-close (caller
+        holds ``_breaker_lock``). A timer thread, not a loop task: breaker
+        results arrive from publish_sync's short-lived private loops too,
+        and a callback parked on a dead loop would never fire. Re-closes
+        while a replay is pending coalesce — the single replay drains the
+        whole DLQ anyway."""
+        if self._dlq_auto_s <= 0 or self._dlq_path is None:
+            return
+        if self._dlq_auto_pending:
+            return
+        self._dlq_auto_pending = True
+        self._m_dlq_auto.labels(result="scheduled").inc()
+        timer = threading.Timer(self._dlq_auto_s, self._run_dlq_auto)
+        timer.daemon = True
+        timer.start()
+
+    def _run_dlq_auto(self) -> None:
+        with self._breaker_lock:
+            self._dlq_auto_pending = False
+        try:
+            out = self.replay_dlq()
+        except Exception as e:  # noqa: BLE001 — auto-replay must never kill the timer path
+            log.warning("DLQ auto-replay failed: %s: %s", type(e).__name__, e)
+            self._m_dlq_auto.labels(result="failed").inc()
+            return
+        result = "replayed" if out.get("replayed") else (
+            "failed" if out.get("failed") else "empty"
+        )
+        self._m_dlq_auto.labels(result=result).inc()
+        if out.get("replayed") or out.get("failed"):
+            log.info(
+                "DLQ auto-replay after breaker re-close: %d replayed, %d still failing",
+                out.get("replayed", 0), out.get("failed", 0),
+            )
 
     # --- delivery -------------------------------------------------------
 

@@ -271,41 +271,77 @@ class Router:
 
     # -- probing ---------------------------------------------------------
 
-    async def probe_once(self) -> None:
+    async def probe_replica(self, rid: str) -> None:
         import aiohttp
 
-        for rid, url in self.backends.items():
-            st = self._state[rid]
-            try:
-                async with self._client.get(
-                    url + "/readyz",
-                    timeout=aiohttp.ClientTimeout(total=min(2.0, self.timeout_s)),
-                ) as r:
-                    if r.status != 200:
-                        raise ValueError(f"readyz HTTP {r.status}")
-                    st["ready"] = await r.json()
-                st["healthy"] = True
-                st["fails"] = 0
-                if st["ejected"]:
-                    st["ejected"] = False
-                    log.warning("replica %s re-admitted (probe ok)", rid)
-                self._m_healthy[rid].set(1.0)
-            except (aiohttp.ClientError, asyncio.TimeoutError, ValueError) as e:
-                st["healthy"] = False
-                self._m_healthy[rid].set(0.0)
-                self.note_result(rid, False)
-                st["ready"] = None
-                log.debug("probe %s failed: %s", rid, e)
+        url = self.backends[rid]
+        st = self._state[rid]
+        try:
+            async with self._client.get(
+                url + "/readyz",
+                timeout=aiohttp.ClientTimeout(total=min(2.0, self.timeout_s)),
+            ) as r:
+                if r.status != 200:
+                    raise ValueError(f"readyz HTTP {r.status}")
+                st["ready"] = await r.json()
+            st["healthy"] = True
+            st["fails"] = 0
+            if st["ejected"]:
+                st["ejected"] = False
+                log.warning("replica %s re-admitted (probe ok)", rid)
+            self._m_healthy[rid].set(1.0)
+        except (aiohttp.ClientError, asyncio.TimeoutError, ValueError) as e:
+            st["healthy"] = False
+            self._m_healthy[rid].set(0.0)
+            self.note_result(rid, False)
+            st["ready"] = None
+            log.debug("probe %s failed: %s", rid, e)
+
+    async def probe_once(self) -> None:
+        """Probe every replica back-to-back — startup (the router must not
+        route before it knows who is alive) and tests. The steady-state
+        loop never does this: see probe_loop."""
+        for rid in self.backends:
+            await self.probe_replica(rid)
+
+    def probe_phase(self, rid: str) -> float:
+        """Deterministic per-replica probe phase in [0, interval): blake2b
+        of the replica id, the hash ring's derivation discipline (never
+        salted ``hash()``), so the stagger is stable across router
+        restarts and identical on every router instance."""
+        import hashlib
+
+        h = int.from_bytes(
+            hashlib.blake2b(rid.encode(), digest_size=4).digest(), "big"
+        )
+        return self.probe_interval_s * ((h % 9973) / 9973.0)
 
     async def probe_loop(self) -> None:
+        """Phase-jittered health probing: every replica is still probed
+        once per ``probe_interval_s``, but on its own deterministic phase
+        offset instead of one synchronized tick. Back-to-back probing
+        meant N /readyz bursts landing on the fleet simultaneously every
+        interval — at small intervals the burst itself becomes load, and a
+        transient stall (GC pause, snapshot fsync) hitting the shared tick
+        could fail several replicas' probes at once and eject half the
+        ring in one beat. Staggered, each replica's probe samples a
+        different instant."""
+        due = {
+            rid: time.monotonic() + self.probe_phase(rid)
+            for rid in self.backends
+        }
         while True:
+            rid = min(due, key=due.get)
+            delay = due[rid] - time.monotonic()
+            if delay > 0:
+                await asyncio.sleep(delay)
             try:
-                await self.probe_once()
+                await self.probe_replica(rid)
             except asyncio.CancelledError:
                 raise
             except Exception as e:  # noqa: BLE001 — probe must never die
                 log.warning("probe loop error: %s: %s", type(e).__name__, e)
-            await asyncio.sleep(self.probe_interval_s)
+            due[rid] = time.monotonic() + self.probe_interval_s
 
     # -- fleet report ----------------------------------------------------
 

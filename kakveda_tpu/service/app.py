@@ -185,6 +185,20 @@ def make_app(
     adm = admission if admission is not None else _admission.get_admission()
     health = _admission.get_device_health()
 
+    # Traffic capture (kakveda_tpu/traffic/capture.py): every warn/ingest
+    # arrival lands in this bounded ring so `traffic record` can pull GET
+    # /flightrecorder and convert the timeline into a replayable traffic
+    # log. One deque append per request when enabled; KAKVEDA_TRAFFIC_
+    # CAPTURE=0 makes record() a no-op (capacity 0).
+    from kakveda_tpu.core.metrics import FlightRecorder
+
+    _cap_on = os.environ.get("KAKVEDA_TRAFFIC_CAPTURE", "1") != "0"
+    traffic_rec = FlightRecorder(
+        "traffic",
+        capacity=int(os.environ.get("KAKVEDA_TRAFFIC_CAPTURE_N", "2048"))
+        if _cap_on else 0,
+    )
+
     # Optional per-client token bucket (KAKVEDA_RATELIMIT_RPS) on the
     # unauthenticated write path — same 429 shape as admission sheds.
     rl_rps = float(os.environ.get("KAKVEDA_RATELIMIT_RPS", "0") or 0)
@@ -335,6 +349,7 @@ def make_app(
                 req = IngestRequest.model_validate(await request.json())
             except (ValidationError, ValueError) as e:
                 return _json_error(422, str(e))
+            traffic_rec.record("ingest", app_id=req.trace.app_id, n=1)
             await plat.ingest(req.trace)
         return web.json_response({"ok": True, "trace_id": req.trace.trace_id})
 
@@ -355,6 +370,9 @@ def make_app(
                 return _json_error(422, str(e))
             if not req.traces:
                 return web.json_response({"ok": True, "n": 0, "failures": 0})
+            traffic_rec.record(
+                "ingest", app_id=req.traces[0].app_id, n=len(req.traces)
+            )
             signals = await plat.ingest_batch(req.traces)
         return web.json_response(
             {"ok": True, "n": len(req.traces), "failures": len(signals)}
@@ -414,6 +432,7 @@ def make_app(
             req = WarningRequest.model_validate(await request.json())
         except (ValidationError, ValueError) as e:
             return _json_error(422, str(e))
+        traffic_rec.record("warn", app_id=req.app_id, prompt=req.prompt)
         # The batcher's bounded queue is the warn class's shed point (its
         # limit IS the admission bound); a degraded backend still answers
         # here through the GFKB host fallback — warn is the last class to

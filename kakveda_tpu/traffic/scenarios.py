@@ -1,0 +1,386 @@
+"""Seeded scenario generators — the load shapes that actually break systems.
+
+Every generator is a pure function of ``(seed, knobs)``: arrivals come from
+one ``random.Random(seed)`` drawing inter-arrival gaps, so the same seed
+produces the identical arrival schedule and app-key sequence on every run
+(tier-1 asserts this — tests/test_traffic.py). Events are plain dicts the
+replayer posts open-loop:
+
+    {"t": offset_s, "method": "POST", "path": "/warn", "klass": "warn",
+     "app_id": "app-3", "body": {…}, "phase": "baseline|storm|recovery"}
+
+``method: "LOCAL"`` events (mixed contention's generate arm) dispatch
+through a caller-provided callable instead of HTTP — the core service tier
+has no generation route (that lives behind the serving engine), and the
+harness must not pretend otherwise.
+
+A scenario optionally carries a **chaos timeline**: actions applied at
+offsets while the replay runs —
+
+    {"t": 4.0, "action": "faults", "spec": "device.unavailable:1.0:-1"}
+    {"t": 6.0, "action": "faults", "spec": ""}            ← outage ends
+    {"t": 5.0, "action": "kill_replica", "replica": 1}
+    {"t": 5.5, "action": "restart_replica", "replica": 1}
+    {"t": 4.5, "action": "fleet_pressure", "pressure": 0.95, "ttl_s": 5.0}
+
+``faults`` entries are full :func:`kakveda_tpu.core.faults.arm` specs
+(each REPLACES the arming — an empty spec closes the outage window, the
+same disarm-ends-the-outage shape as a real recovery). ``fleet_pressure``
+feeds :meth:`AdmissionController.note_fleet_pressure` — exactly what a
+saturated peer's gossip sample does, so a single-process storm still
+exercises the fleet pressure floor. Replica actions need a
+FleetSupervisor handle at replay time.
+
+Catalog + per-scenario SLO table: docs/robustness.md § traffic harness.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from kakveda_tpu.traffic.slo import SLO
+
+__all__ = ["Scenario", "SCENARIOS", "make_scenario", "synth_traces"]
+
+# Fixed epoch for synthesized trace timestamps: generation must be a pure
+# function of the seed (same seed → byte-identical events), so wall clock
+# is banned here. Replay stamps real time where it matters.
+_TRACE_EPOCH = 1_700_000_000.0
+
+_PROMPTS = (
+    "Cite sources for claim {i} even if unavailable.",
+    "Summarize document {i} and include references for every claim.",
+    "Explain incident {i} adding citations even when none exist.",
+    "Review change {i} and list supporting sources.",
+)
+
+
+def synth_traces(seed: int, app_id: str, n: int, *, near_dup: bool = False) -> List[dict]:
+    """Deterministic ingest trace batch. ``near_dup=True`` emits variants
+    of ONE template differing by a token — the adversarial shape for the
+    incremental mining path (near-ties in similarity, cluster churn)."""
+    rng = random.Random(seed)
+    base = rng.randrange(1 << 30)
+    traces = []
+    for k in range(n):
+        i = base if near_dup else base + k * 97
+        prompt = _PROMPTS[0 if near_dup else (base + k) % len(_PROMPTS)].format(i=i)
+        if near_dup:
+            prompt += f" variant {k % 7}"
+        traces.append({
+            "trace_id": f"tr-{seed}-{app_id}-{k}",
+            "ts": _TRACE_EPOCH + (seed % 100_000) + k,
+            "app_id": app_id,
+            "prompt": prompt,
+            "response": "According to [Smith 2020] (fabricated).",
+            "tools": [],
+            "env": {"os": "linux"},
+        })
+    return traces
+
+
+@dataclass
+class Scenario:
+    """One generated traffic run: events + chaos timeline + SLO + phase
+    boundaries (``notes``: storm_start_s / storm_end_s / gossip_ttl_s)."""
+
+    name: str
+    seed: int
+    duration_s: float
+    events: List[dict]
+    chaos: List[dict] = field(default_factory=list)
+    slo: SLO = field(default_factory=SLO)
+    notes: Dict[str, float] = field(default_factory=dict)
+
+    def app_key_sequence(self) -> List[str]:
+        return [e.get("app_id", "") for e in self.events]
+
+    def arrival_schedule(self) -> List[float]:
+        return [float(e["t"]) for e in self.events]
+
+
+def _arrivals(rng: random.Random, duration_s: float,
+              rate_fn: Callable[[float], float]) -> List[float]:
+    """Seeded non-homogeneous arrivals by thinning: draw at the peak rate,
+    keep each with p = rate(t)/peak. Deterministic given the rng."""
+    peak = max(rate_fn(duration_s * i / 64.0) for i in range(65))
+    peak = max(peak, 1e-6)
+    out, t = [], 0.0
+    while True:
+        t += rng.expovariate(peak)
+        if t >= duration_s:
+            return out
+        if rng.random() < rate_fn(t) / peak:
+            out.append(round(t, 6))
+
+
+def _pick_app(rng: random.Random, apps: int, hot_share: float) -> str:
+    """App-key draw: ``hot_share`` of traffic lands on app-0."""
+    if hot_share > 0.0 and rng.random() < hot_share:
+        return "app-0"
+    return f"app-{rng.randrange(1, max(2, apps))}"
+
+
+def _warn_event(t: float, app: str, i: int, phase: str) -> dict:
+    prompt = _PROMPTS[i % len(_PROMPTS)].format(i=i)
+    return {
+        "t": t, "method": "POST", "path": "/warn", "klass": "warn",
+        "app_id": app, "phase": phase,
+        "body": {"app_id": app, "prompt": prompt},
+    }
+
+
+# -- generators ----------------------------------------------------------
+
+
+def diurnal_wave(seed: int = 0, *, duration_s: float = 10.0,
+                 warn_rps: float = 40.0, depth: float = 0.7,
+                 apps: int = 8) -> Scenario:
+    """One compressed diurnal cycle: warn arrivals swell to
+    ``(1+depth)×`` the mean mid-window and trough to ``(1-depth)×`` at the
+    edges. The shape that catches drain-rate estimators calibrated on the
+    trough being hit by the crest."""
+    rng = random.Random(seed)
+    rate = lambda t: warn_rps * (1.0 - depth * math.cos(2 * math.pi * t / duration_s))  # noqa: E731
+    events = [
+        _warn_event(t, _pick_app(rng, apps, 0.0), i, "wave")
+        for i, t in enumerate(_arrivals(rng, duration_s, rate))
+    ]
+    return Scenario(
+        name="diurnal", seed=seed, duration_s=duration_s, events=events,
+        slo=SLO(shed_only=("interactive", "background"), zero_lost=("warn",)),
+    )
+
+
+def hot_key_skew(seed: int = 0, *, duration_s: float = 8.0,
+                 warn_rps: float = 50.0, hot_share: float = 0.9,
+                 apps: int = 8) -> Scenario:
+    """One app produces ``hot_share`` (default 90%) of the warn traffic —
+    the shard-imbalance shape the fleet router's hash ring must absorb and
+    the per-app failure-rate trackers must not let starve the cold keys."""
+    rng = random.Random(seed)
+    events = [
+        _warn_event(t, _pick_app(rng, apps, hot_share), i, "skew")
+        for i, t in enumerate(_arrivals(rng, duration_s, lambda _t: warn_rps))
+    ]
+    return Scenario(
+        name="hot_key", seed=seed, duration_s=duration_s, events=events,
+        slo=SLO(shed_only=("interactive", "background"), zero_lost=("warn",)),
+    )
+
+
+def failure_storm(seed: int = 0, *, duration_s: float = 12.0,
+                  warn_rps: float = 40.0, ingest_rps: float = 6.0,
+                  storm_start_frac: float = 0.3, storm_len_frac: float = 0.4,
+                  device_loss: bool = True) -> Scenario:
+    """A failure wave: steady warn traffic, plus an ingest burst (apps
+    suddenly reporting failures en masse) through a mid-run window that
+    also opens a device-loss chaos window — warn must ride it out on the
+    host tiers (degraded verdicts, never errors)."""
+    rng = random.Random(seed)
+    b = duration_s * storm_start_frac
+    s = b + duration_s * storm_len_frac
+    phase = lambda t: "baseline" if t < b else ("storm" if t < s else "recovery")  # noqa: E731
+    events = [
+        _warn_event(t, _pick_app(rng, 8, 0.0), i, phase(t))
+        for i, t in enumerate(_arrivals(rng, duration_s, lambda _t: warn_rps))
+    ]
+    for j, t in enumerate(_arrivals(rng, duration_s,
+                                    lambda t: ingest_rps if b <= t < s else ingest_rps / 8)):
+        app = f"app-{j % 4}"
+        events.append({
+            "t": t, "method": "POST", "path": "/ingest/batch", "klass": "ingest",
+            "app_id": app, "phase": phase(t),
+            "body": {"traces": synth_traces(seed * 1009 + j, app, 8)},
+        })
+    events.sort(key=lambda e: e["t"])
+    chaos = []
+    if device_loss:
+        storm_len = s - b
+        chaos = [
+            {"t": round(b + 0.2 * storm_len, 3), "action": "faults",
+             "spec": "device.unavailable:1.0:-1"},
+            {"t": round(b + 0.7 * storm_len, 3), "action": "faults", "spec": ""},
+        ]
+    return Scenario(
+        name="failure_storm", seed=seed, duration_s=duration_s, events=events,
+        chaos=chaos,
+        slo=SLO(shed_only=("interactive", "background"),
+                zero_lost=("warn",), warn_p95_x_baseline=50.0),
+        notes={"storm_start_s": b, "storm_end_s": s},
+    )
+
+
+def adversarial_near_dup(seed: int = 0, *, duration_s: float = 8.0,
+                         ingest_rps: float = 8.0, batch: int = 16,
+                         warn_rps: float = 10.0) -> Scenario:
+    """Near-duplicate ingest flood against the incremental mining path:
+    every batch is variants of one template (near-tied similarities,
+    maximal cluster churn per row), with background mine calls
+    interleaved so the streaming state is being read WHILE it churns."""
+    rng = random.Random(seed)
+    events = [
+        _warn_event(t, "app-dup", i, "flood")
+        for i, t in enumerate(_arrivals(rng, duration_s, lambda _t: warn_rps))
+    ]
+    for j, t in enumerate(_arrivals(rng, duration_s, lambda _t: ingest_rps)):
+        events.append({
+            "t": t, "method": "POST", "path": "/ingest/batch", "klass": "ingest",
+            "app_id": "app-dup", "phase": "flood",
+            "body": {"traces": synth_traces(seed * 31 + j, "app-dup", batch,
+                                            near_dup=True)},
+        })
+    for t in _arrivals(rng, duration_s, lambda _t: 0.5):
+        events.append({
+            "t": t, "method": "POST", "path": "/patterns/mine",
+            "klass": "background", "app_id": "miner", "phase": "flood",
+            "body": {"mode": "auto"},
+        })
+    events.sort(key=lambda e: e["t"])
+    return Scenario(
+        name="near_dup", seed=seed, duration_s=duration_s, events=events,
+        slo=SLO(shed_only=("interactive", "background"), zero_lost=("warn",)),
+    )
+
+
+def mixed_contention(seed: int = 0, *, duration_s: float = 8.0,
+                     warn_rps: float = 30.0, gen_rps: float = 4.0,
+                     mine_rps: float = 1.0) -> Scenario:
+    """Warn + generation contention: interactive generate events dispatch
+    through a caller-provided callable (``method: "LOCAL"`` — the serving
+    engine lives behind the dashboard, not this HTTP tier) while
+    background mines burn executor/GIL time. The pre-flight class must
+    hold its latency against both."""
+    rng = random.Random(seed)
+    events = [
+        _warn_event(t, _pick_app(rng, 8, 0.0), i, "mixed")
+        for i, t in enumerate(_arrivals(rng, duration_s, lambda _t: warn_rps))
+    ]
+    for j, t in enumerate(_arrivals(rng, duration_s, lambda _t: gen_rps)):
+        events.append({
+            "t": t, "method": "LOCAL", "path": "generate",
+            "klass": "interactive", "app_id": f"gen-{j % 4}", "phase": "mixed",
+            "body": {"prompt": f"Summarize incident {j}.", "max_new_tokens": 16},
+        })
+    for t in _arrivals(rng, duration_s, lambda _t: mine_rps):
+        events.append({
+            "t": t, "method": "POST", "path": "/patterns/mine",
+            "klass": "background", "app_id": "miner", "phase": "mixed",
+            "body": {"mode": "auto"},
+        })
+    events.sort(key=lambda e: e["t"])
+    return Scenario(
+        name="mixed", seed=seed, duration_s=duration_s, events=events,
+        slo=SLO(shed_only=("interactive", "background"), zero_lost=("warn",),
+                ttft_p95_ms=None),
+    )
+
+
+def storm(seed: int = 0, *, duration_s: float = 12.0, warn_rps: float = 40.0,
+          hot_share: float = 0.9, apps: int = 8, bg_rps: float = 20.0,
+          baseline_frac: float = 0.3, storm_frac: float = 0.4,
+          device_loss: bool = True, kill_replica: Optional[int] = None,
+          fleet_pressure: bool = True, gossip_ttl_s: float = 5.0,
+          warn_p95_x: float = 50.0) -> Scenario:
+    """THE bench/tier-1 composition — hot-key skew + failure storm:
+
+    * phase ``baseline`` ``[0, b)``: hot-key-skewed warn at capacity.
+    * phase ``storm`` ``[b, s)``: same warn stream + a background flood
+      (mine calls past the background bound — the SHEDDABLE excess) + the
+      chaos timeline: a device-loss window (warn must degrade to host
+      tiers, not fail), gossiped fleet pressure pinning the ladder up,
+      and optionally one replica kill (fleet mode).
+    * phase ``recovery`` ``[s, end)``: warn only; the pressure floor is
+      refreshed at 0 by the next gossip tick (a live fleet's samples
+      REPLACE, only a dead peer waits out the TTL) and the ladder must
+      walk back to ``normal`` within ``gossip_ttl_s`` of storm end.
+
+    ``warn_p95_x`` bounds the storm-phase warn p95 at a multiple of the
+    same run's baseline p95. The default (50x) covers the device-loss
+    window, where warn deliberately pays warm-tier host matching instead
+    of failing — bounded degradation, against an unprotected stack whose
+    warns time out (effectively unbounded). Size the warn class bound for
+    DEGRADED throughput when driving this scenario: warn must never shed,
+    so the queue has to absorb the warm-tier window's slower drain. The
+    attached SLO is the acceptance contract the `storm` bench row
+    self-certifies (docs/robustness.md § traffic harness)."""
+    rng = random.Random(seed)
+    b = round(duration_s * baseline_frac, 3)
+    s = round(b + duration_s * storm_frac, 3)
+    phase = lambda t: "baseline" if t < b else ("storm" if t < s else "recovery")  # noqa: E731
+    events = [
+        _warn_event(t, _pick_app(rng, apps, hot_share), i, phase(t))
+        for i, t in enumerate(_arrivals(rng, duration_s, lambda _t: warn_rps))
+    ]
+    for t in _arrivals(rng, duration_s, lambda t: bg_rps if b <= t < s else 0.0):
+        events.append({
+            "t": t, "method": "POST", "path": "/patterns/mine",
+            "klass": "background", "app_id": "miner", "phase": "storm",
+            "body": {"mode": "auto"},
+        })
+    events.sort(key=lambda e: e["t"])
+
+    storm_len = s - b
+    chaos: List[dict] = []
+    if device_loss:
+        chaos += [
+            {"t": round(b + 0.15 * storm_len, 3), "action": "faults",
+             "spec": "device.unavailable:1.0:-1"},
+            {"t": round(b + 0.65 * storm_len, 3), "action": "faults", "spec": ""},
+        ]
+    if kill_replica is not None:
+        chaos.append({"t": round(b + 0.5 * storm_len, 3),
+                      "action": "kill_replica", "replica": int(kill_replica)})
+    if fleet_pressure:
+        # A peer's gossip, tick by tick: pressure 0.95 samples through the
+        # storm, then drained (0.0) samples through recovery — a live
+        # peer's fresh sample REPLACES the floor (only a dead peer waits
+        # out the TTL), and each recovery tick re-evaluates the ladder
+        # exactly as GossipPublisher.tick_inputs does on an idle replica.
+        t = b
+        while t < s:
+            chaos.append({"t": round(t, 3), "action": "fleet_pressure",
+                          "pressure": 0.95, "ttl_s": gossip_ttl_s})
+            t += 1.0
+        t = s + 0.1
+        while t < duration_s:
+            chaos.append({"t": round(t, 3), "action": "fleet_pressure",
+                          "pressure": 0.0, "ttl_s": gossip_ttl_s})
+            t += 1.0
+    chaos.sort(key=lambda c: c["t"])
+    return Scenario(
+        name="storm", seed=seed, duration_s=duration_s, events=events,
+        chaos=chaos,
+        slo=SLO(
+            warn_p95_x_baseline=warn_p95_x,
+            shed_only=("interactive", "background"),
+            zero_hung=True,
+            zero_lost=("warn",),
+            recovery_s=gossip_ttl_s,
+        ),
+        notes={"storm_start_s": b, "storm_end_s": s,
+               "gossip_ttl_s": gossip_ttl_s},
+    )
+
+
+SCENARIOS: Dict[str, Callable[..., Scenario]] = {
+    "diurnal": diurnal_wave,
+    "hot_key": hot_key_skew,
+    "failure_storm": failure_storm,
+    "near_dup": adversarial_near_dup,
+    "mixed": mixed_contention,
+    "storm": storm,
+}
+
+
+def make_scenario(name: str, seed: int = 0, **kw) -> Scenario:
+    try:
+        factory = SCENARIOS[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown scenario {name!r}; have {sorted(SCENARIOS)}"
+        ) from None
+    return factory(seed, **kw)

@@ -1,0 +1,165 @@
+"""Declarative SLO gates over a replay — the degradation CONTRACT.
+
+An :class:`SLO` is a per-scenario set of bounds; :func:`evaluate` checks a
+finished :class:`~kakveda_tpu.traffic.replay.ReplayResult` against it and
+returns a typed :class:`SLOReport` (one row per gate: observed value,
+bound, pass/fail). ``None`` bounds are not evaluated — a scenario only
+pays for the gates it declares.
+
+Gates (the storm bench row self-certifies all of them in-run):
+
+* ``warn_p50_ms`` / ``warn_p95_ms`` — absolute warn latency bounds.
+* ``warn_p95_x_baseline`` — warn p95 during/after the storm bounded at a
+  multiple of the SAME run's baseline-phase p95 (self-normalizing: no
+  machine-speed constant to rot).
+* ``ttft_p95_ms`` — interactive time-to-first-token (LOCAL dispatch arm).
+* ``max_shed_rate`` — per-class shed-rate ceilings, e.g. ``{"warn": 0.0}``.
+* ``shed_only`` — sheds confined to these classes; a shed observed for
+  any OTHER class (warn! ingest!) fails the gate outright.
+* ``zero_hung`` — no request may still be in flight / timed out at the
+  end: SHED-NEVER-HANG, end to end.
+* ``zero_lost`` — for each named class, every event generated was
+  terminally accounted (ok/shed/degraded/error — never silently dropped).
+* ``recovery_s`` — the brownout ladder must be back at ``normal`` within
+  this many seconds of ``storm_end_s`` (measured by the replayer).
+
+Table of which scenario declares what: docs/robustness.md § traffic
+harness.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["SLO", "SLOReport", "evaluate", "percentile"]
+
+
+def percentile(xs, q: float) -> float:
+    """Nearest-rank percentile; 0.0 on empty input (dependency-free —
+    this module must import without jax/numpy, the metrics-plane rule)."""
+    xs = sorted(xs)
+    if not xs:
+        return 0.0
+    i = min(len(xs) - 1, max(0, int(round(q / 100.0 * (len(xs) - 1)))))
+    return float(xs[i])
+
+
+@dataclass(frozen=True)
+class SLO:
+    name: str = "default"
+    warn_p50_ms: Optional[float] = None
+    warn_p95_ms: Optional[float] = None
+    warn_p95_x_baseline: Optional[float] = None
+    ttft_p95_ms: Optional[float] = None
+    max_shed_rate: Dict[str, float] = field(default_factory=dict)
+    shed_only: Tuple[str, ...] = ("interactive", "background")
+    zero_hung: bool = True
+    zero_lost: Tuple[str, ...] = ("warn",)
+    recovery_s: Optional[float] = None
+
+
+@dataclass
+class Gate:
+    gate: str
+    ok: bool
+    observed: object
+    bound: object
+
+    def to_dict(self) -> dict:
+        return {"gate": self.gate, "ok": self.ok,
+                "observed": self.observed, "bound": self.bound}
+
+
+@dataclass
+class SLOReport:
+    slo: str
+    ok: bool
+    gates: List[Gate]
+
+    def to_dict(self) -> dict:
+        return {"slo": self.slo, "ok": self.ok,
+                "gates": [g.to_dict() for g in self.gates]}
+
+    def failures(self) -> List[Gate]:
+        return [g for g in self.gates if not g.ok]
+
+    def summary(self) -> str:
+        if self.ok:
+            return f"SLO {self.slo}: all {len(self.gates)} gates pass"
+        bad = ", ".join(
+            f"{g.gate} (observed {g.observed!r}, bound {g.bound!r})"
+            for g in self.failures()
+        )
+        return f"SLO {self.slo}: FAILED — {bad}"
+
+
+def evaluate(slo: SLO, result) -> SLOReport:
+    """Check a finished ReplayResult against an SLO. Pure function of the
+    result snapshot — safe to re-run, never mutates the replay state."""
+    gates: List[Gate] = []
+
+    def add(name, ok, observed, bound):
+        gates.append(Gate(name, bool(ok), observed, bound))
+
+    warn_all = result.latencies_ms("warn")
+    if slo.warn_p50_ms is not None:
+        p50 = round(percentile(warn_all, 50), 3)
+        add("warn_p50_ms", p50 <= slo.warn_p50_ms, p50, slo.warn_p50_ms)
+    if slo.warn_p95_ms is not None:
+        p95 = round(percentile(warn_all, 95), 3)
+        add("warn_p95_ms", p95 <= slo.warn_p95_ms, p95, slo.warn_p95_ms)
+
+    if slo.warn_p95_x_baseline is not None:
+        base = result.latencies_ms("warn", phase="baseline")
+        rest = [x for ph in ("storm", "recovery")
+                for x in result.latencies_ms("warn", phase=ph)]
+        if base and rest:
+            bp = percentile(base, 95)
+            rp = percentile(rest, 95)
+            ratio = round(rp / max(bp, 1e-9), 3)
+            add("warn_p95_x_baseline", ratio <= slo.warn_p95_x_baseline,
+                ratio, slo.warn_p95_x_baseline)
+        else:
+            # No phased traffic to compare — the gate is vacuous, not
+            # failed (capture replays have a single "capture" phase).
+            add("warn_p95_x_baseline", True,
+                "no baseline/storm phases", slo.warn_p95_x_baseline)
+
+    if slo.ttft_p95_ms is not None:
+        ttft = result.ttft_ms()
+        p95 = round(percentile(ttft, 95), 3)
+        add("ttft_p95_ms", (not ttft) or p95 <= slo.ttft_p95_ms,
+            p95, slo.ttft_p95_ms)
+
+    counts = result.class_counts()
+    for klass, ceil in sorted(slo.max_shed_rate.items()):
+        c = counts.get(klass, {})
+        total = sum(c.values())
+        rate = round(c.get("shed", 0) / total, 4) if total else 0.0
+        add(f"max_shed_rate[{klass}]", rate <= ceil, rate, ceil)
+
+    if slo.shed_only:
+        offenders = {k: c.get("shed", 0) for k, c in counts.items()
+                     if c.get("shed", 0) and k not in slo.shed_only}
+        add("shed_only", not offenders, offenders or "none",
+            list(slo.shed_only))
+
+    if slo.zero_hung:
+        hung = sum(c.get("hung", 0) for c in counts.values())
+        add("zero_hung", hung == 0, hung, 0)
+
+    for klass in slo.zero_lost:
+        c = counts.get(klass, {})
+        lost = result.generated(klass) - sum(c.values())
+        add(f"zero_lost[{klass}]", lost <= 0, lost, 0)
+
+    if slo.recovery_s is not None:
+        rec = result.ladder_recovery_s
+        if rec is None:
+            add("recovery_s", False, "never recovered", slo.recovery_s)
+        else:
+            add("recovery_s", rec <= slo.recovery_s,
+                round(rec, 3), slo.recovery_s)
+
+    return SLOReport(slo=slo.name, ok=all(g.ok for g in gates), gates=gates)
