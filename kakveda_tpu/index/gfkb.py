@@ -1330,10 +1330,13 @@ class GFKB:
                         j for j, i in enumerate(delta_rows) if int(slots[i]) >= hot
                     ]
                     if ovf and self._tiers is not None:
-                        for j in ovf:
-                            nscores, nslots, _mode = self._tiers.match_host(
-                                d_idx[j], d_val[j], m.k + 1
-                            )
+                        # One batched host match for every overflow row —
+                        # the candidate gather and (native) scoring run
+                        # once per ingest batch, not once per row.
+                        batch = self._tiers.match_host_batch(
+                            d_idx[ovf], d_val[ovf], m.k + 1
+                        )
+                        for j, (nscores, nslots, _mode) in zip(ovf, batch):
                             tier_attach.append(
                                 (int(slots[delta_rows[j]]), nslots, nscores)
                             )
@@ -1573,10 +1576,10 @@ class GFKB:
         out: List[List[FailureMatch]] = []
         k = self.top_k
         routed = False
-        for r in range(q_idx.shape[0]):
-            scores, slots, mode = self._tiers.match_host(
-                q_idx[r], q_val[r], max(k, 1)
-            )
+        # One batched host match: candidate dedup + the cold tier's
+        # coalesced read plan + (native) scoring run once per warn batch.
+        batch = self._tiers.match_host_batch(q_idx, q_val, max(k, 1))
+        for scores, slots, mode in batch:
             routed = routed or mode == "routed"
             row: List[FailureMatch] = []
             for s, slot in zip(scores.tolist(), slots.tolist()):
@@ -1694,10 +1697,9 @@ class GFKB:
             modes: set = set()
             m_scores, m_slots = [], []
             k = scores.shape[1]
+            overflow = self._tiers.match_host_batch(q_idx, q_val, k, min_slot=hot)
             for i in range(b):
-                o_s, o_sl, mode = self._tiers.match_host(
-                    q_idx[i], q_val[i], k, min_slot=hot
-                )
+                o_s, o_sl, mode = overflow[i]
                 modes.add(mode)
                 if tid is not None and len(o_sl):
                     keep = np.asarray(

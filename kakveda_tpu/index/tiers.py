@@ -61,10 +61,12 @@ import shutil
 import threading
 from collections import OrderedDict
 from pathlib import Path
+from time import perf_counter
 from typing import Callable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from kakveda_tpu import native as _native
 from kakveda_tpu.core import faults as _faults
 from kakveda_tpu.core import metrics as _metrics
 
@@ -72,6 +74,7 @@ log = logging.getLogger("kakveda.tiers")
 
 __all__ = [
     "TierConfig",
+    "NativeScorer",
     "WarmTier",
     "ColdTier",
     "CoarseRouter",
@@ -101,6 +104,17 @@ def _env_int(name: str, default: int) -> int:
         return int(os.environ.get(name, str(default)))
     except ValueError:
         return default
+
+
+def _topk_desc(scores: np.ndarray, k: int) -> np.ndarray:
+    """Top-k indices by descending score: O(m) partition + O(k log k)
+    sort of the survivors, vs the full argsort's O(m log m). Tie order
+    among exactly-equal scores can differ from a full argsort — native
+    paths only; the numpy fallback keeps the historical argsort."""
+    if k >= len(scores):
+        return np.argsort(-scores)
+    part = np.argpartition(-scores, k)[:k]
+    return part[np.argsort(-scores[part])]
 
 
 class TierConfig:
@@ -144,6 +158,116 @@ class TierConfig:
 
 
 # ---------------------------------------------------------------------------
+# native scoring seam
+# ---------------------------------------------------------------------------
+
+
+class NativeScorer:
+    """The one gate between host-tier scoring and the C++ library.
+
+    Every method returns scores or ``None`` — None means "run the numpy
+    path", and the numpy paths are byte-identical to the pre-native code,
+    so ``KAKVEDA_NATIVE=0``, a missing library, a failed call and an armed
+    ``native.score`` fault all reproduce today's results bit-for-bit. A
+    scoring problem is NEVER a failed warn: the worst outcome is the
+    pre-native latency. Fault site and metric children resolve once here
+    (construction), per the fault-site / hot-path invariants."""
+
+    def __init__(self) -> None:
+        try:
+            self.enabled = _native.load() is not None
+        except RuntimeError:
+            # KAKVEDA_NATIVE=require propagates from consumers' own load()
+            # calls (featurizer, tests); the scorer itself just degrades.
+            self.enabled = False
+        self.min_rows = _native.score_min_rows()
+        self._fault = _faults.site("native.score")
+        reg = _metrics.get_registry()
+        h = reg.histogram(
+            "kakveda_native_score_seconds",
+            "Native host-tier scoring call duration by path (warm = warm "
+            "exact scan, cold = cold-shard exact scan, ivf = routed "
+            "candidate scoring)", ("path",),
+        )
+        self._h = {p: h.labels(path=p) for p in ("warm", "cold", "ivf")}
+        c = reg.counter(
+            "kakveda_native_fallback_total",
+            "Host-tier scoring calls served by the numpy fallback by reason "
+            "(unavailable = library off/absent, fault = chaos site "
+            "native.score, error = native call failed)", ("reason",),
+        )
+        self._c_fb = {r: c.labels(reason=r) for r in ("unavailable", "fault", "error")}
+
+    def _admit(self, total_rows: int) -> bool:
+        """Common gate: tiny scans stay numpy (no fallback counted — a
+        policy choice, not a degradation); disabled/armed/failed calls
+        count their reason."""
+        if total_rows < self.min_rows:
+            return False
+        if not self.enabled:
+            self._c_fb["unavailable"].inc()
+            return False
+        try:
+            self._fault.fire()
+        except Exception:  # noqa: BLE001 — FaultInjected → numpy, never a failed warn
+            self._c_fb["fault"].inc()
+            return False
+        return True
+
+    def score_block(
+        self, qdense: np.ndarray, idx: np.ndarray, val: np.ndarray,
+        dim: int, path: str,
+    ) -> Optional[np.ndarray]:
+        b = qdense.shape[0] if qdense.ndim == 2 else 1
+        if not self._admit(b * idx.shape[0]):
+            return None
+        t0 = perf_counter()
+        out = _native.score_block(qdense, idx, val, dim)
+        if out is None:
+            self._c_fb["error"].inc()
+            return None
+        self._h[path].observe(perf_counter() - t0)
+        return out
+
+    def score_candidates(
+        self, qdense: np.ndarray, idx: np.ndarray, val: np.ndarray,
+        offsets: np.ndarray, dim: int,
+    ) -> Optional[np.ndarray]:
+        if not self._admit(int(offsets[-1])):
+            return None
+        t0 = perf_counter()
+        out = _native.score_candidates(qdense, idx, val, offsets, dim)
+        if out is None:
+            self._c_fb["error"].inc()
+            return None
+        self._h["ivf"].observe(perf_counter() - t0)
+        return out
+
+    def score_gather_segments(
+        self, qdense: np.ndarray,
+        segments: List[Tuple[np.ndarray, np.ndarray, np.ndarray]],
+        dim: int,
+    ) -> Optional[List[np.ndarray]]:
+        """One query against row ids gathered in place from several base
+        arrays (warm arrays + one per cold shard) — the zero-copy routed
+        scoring plan. Admission once over the total row count; any
+        segment failing falls the whole query back to the materialized
+        path (never a partial result)."""
+        if not self._admit(sum(len(s[2]) for s in segments)):
+            return None
+        t0 = perf_counter()
+        outs: List[np.ndarray] = []
+        for idx, val, rows in segments:
+            res = _native.score_gather(qdense, idx, val, rows, dim)
+            if res is None:
+                self._c_fb["error"].inc()
+                return None
+            outs.append(res)
+        self._h["ivf"].observe(perf_counter() - t0)
+        return outs
+
+
+# ---------------------------------------------------------------------------
 # host-warm tier
 # ---------------------------------------------------------------------------
 
@@ -163,9 +287,10 @@ class WarmTier:
 
     _GROW = 1 << 12
 
-    def __init__(self, dim: int):
+    def __init__(self, dim: int, scorer: Optional[NativeScorer] = None):
         self.dim = dim
         self.k = 64  # matches the sparse encoders' starting width
+        self.scorer = scorer
         self._idx = np.full((0, self.k), dim, np.int32)
         self._val = np.zeros((0, self.k), np.float32)
         # rows [0, n) are present except slots the owner never stored
@@ -249,9 +374,26 @@ class WarmTier:
         return inv
 
     def score_all(self, q_idx: np.ndarray, q_val: np.ndarray, n: int) -> np.ndarray:
-        """Exact scores [n] for one sparse query over every resident row —
-        one inverted-index walk (O(query nnz · postings)), the degraded
-        mode scorer since PR 5."""
+        """Exact scores [n] for one sparse query over every resident row.
+
+        Native path: one SIMD sparse-dot sweep over the fixed-width row
+        arrays (O(n·K), the degraded-window warn cost). Fallback (and the
+        ``KAKVEDA_NATIVE=0`` bit-for-bit contract): the inverted-index
+        walk (O(query nnz · postings)), the degraded mode scorer since
+        PR 5. Slots past the stored range (pure-cold rows) score 0 on
+        both paths."""
+        sc = self.scorer
+        if sc is not None and n > 0:
+            m = min(n, self.n, len(self._idx))
+            if m > 0:
+                qd = np.zeros(self.dim + 1, np.float32)
+                np.add.at(qd, np.minimum(q_idx, self.dim), q_val)
+                qd[self.dim] = 0.0
+                out = sc.score_block(qd, self._idx[:m], self._val[:m], self.dim, "warm")
+                if out is not None:
+                    if m < n:
+                        out = np.concatenate([out, np.zeros(n - m, np.float32)])
+                    return out
         inv = self._extend_inv(n)
         scores = np.zeros(n, np.float32)
         keep = q_idx < self.dim
@@ -260,7 +402,12 @@ class WarmTier:
             if ent is not None:
                 sl = np.asarray(ent[0])
                 m = sl < n
-                scores[sl[m]] += v * np.asarray(ent[1], np.float32)[m]
+                # add.at, not fancy +=: a row holding the same feature
+                # twice posts two entries for the same slot, and buffered
+                # fancy indexing would drop all but one — silently
+                # undercounting vs the dense-gather semantics every other
+                # scoring path (hot scan, routed candidates, native) uses.
+                np.add.at(scores, sl[m], v * np.asarray(ent[1], np.float32)[m])
         return scores
 
 
@@ -279,10 +426,12 @@ class ColdTier:
     Reads touch only the candidate rows (mmap pages fault in on demand);
     a bounded LRU (:attr:`promoted`) keeps recently paged rows hot."""
 
-    def __init__(self, root: Path, dim: int, base_slot: int, promote_cache: int):
+    def __init__(self, root: Path, dim: int, base_slot: int, promote_cache: int,
+                 scorer: Optional[NativeScorer] = None):
         self.root = Path(root)
         self.dim = dim
         self.base = base_slot
+        self.scorer = scorer
         self.n = 0  # rows appended (slot s ↔ cold row s - base)
         self._shards: List[dict] = []  # {k, rows, idx(memmap), val(memmap)}
         self._promote_max = promote_cache
@@ -405,9 +554,16 @@ class ColdTier:
         return row
 
     def rows_block(self, slots: np.ndarray, k_out: int) -> Tuple[np.ndarray, np.ndarray]:
-        """Fixed-width gather of cold rows, grouped per shard so the
-        memmap fancy-index read touches only the candidates' pages —
-        the vectorized page-in-on-demand path candidate scoring uses."""
+        """Fixed-width gather of cold rows via a coalesced read plan.
+
+        Candidates are grouped per shard, sorted, and split into
+        contiguous runs; each run is ONE basic-slice memmap read (a single
+        large pread through the page cache) scattered back into position.
+        IVF lists extend in slot-append order, so routed candidate lists
+        are dominated by long runs — the pathological per-row fancy-index
+        paging this replaces only survives as the fallback for genuinely
+        scattered gathers (mean run < 4 rows), where run reads degenerate
+        to the same row-by-row cost plus Python loop overhead."""
         idx = np.full((len(slots), k_out), self.dim, np.int32)
         val = np.zeros((len(slots), k_out), np.float32)
         r = slots - self.base
@@ -416,21 +572,44 @@ class ColdTier:
             rows = sh["rows"]
             sel = (r >= off) & (r < off + rows)
             if sel.any():
-                rr = (r[sel] - off).astype(np.int64)
+                pos = np.flatnonzero(sel)
+                rr = (r[pos] - off).astype(np.int64)
                 k = min(sh["k"], k_out)
-                idx[sel, :k] = np.asarray(sh["idx"][rr][:, :k])
-                val[sel, :k] = np.asarray(sh["val"][rr][:, :k])
+                order = np.argsort(rr, kind="stable")
+                rs, ps = rr[order], pos[order]
+                cut = np.flatnonzero(np.r_[True, np.diff(rs) != 1])
+                if len(rs) >= 4 * len(cut):
+                    bounds = np.r_[cut, len(rs)]
+                    for a, z in zip(bounds[:-1], bounds[1:]):
+                        r0 = int(rs[a])
+                        blk_i = np.asarray(sh["idx"][r0 : r0 + (z - a), :k])
+                        blk_v = np.asarray(sh["val"][r0 : r0 + (z - a), :k])
+                        idx[ps[a:z], :k] = blk_i
+                        val[ps[a:z], :k] = blk_v
+                else:
+                    idx[pos, :k] = np.asarray(sh["idx"][rr][:, :k])
+                    val[pos, :k] = np.asarray(sh["val"][rr][:, :k])
             off += rows
         return idx, val
 
     def score_all(self, qdense: np.ndarray) -> np.ndarray:
-        """Exact scores [n] over EVERY cold row, chunk-streamed from the
-        memmaps (the oracle / degraded-exact path; routed queries never
-        pay this)."""
+        """Exact scores [n] over EVERY cold row (the oracle /
+        degraded-exact path; routed queries never pay this). Native path:
+        one threaded sweep per shard reading straight through the memmap
+        (no RAM copy — the shard slice is already contiguous); fallback
+        chunk-streams through numpy exactly as before."""
         out = np.zeros(self.n, np.float32)
         off = 0
         for sh in self._shards:
             rows = sh["rows"]
+            if self.scorer is not None and rows:
+                res = self.scorer.score_block(
+                    qdense, sh["idx"][:rows], sh["val"][:rows], self.dim, "cold"
+                )
+                if res is not None:
+                    out[off : off + rows] = res
+                    off += rows
+                    continue
             for c0 in range(0, rows, 1 << 14):
                 c1 = min(rows, c0 + (1 << 14))
                 idx = np.asarray(sh["idx"][c0:c1])
@@ -769,7 +948,8 @@ class TieredIndex:
         self.cfg = config or TierConfig()
         self.dim = dim
         self.lock = threading.RLock()
-        self.warm = WarmTier(dim)
+        self.scorer = NativeScorer()
+        self.warm = WarmTier(dim, self.scorer)
         self._data_dir = Path(data_dir) if data_dir is not None else None
         self.cold: Optional[ColdTier] = None
         self.router = CoarseRouter(dim, self.cfg.max_list) if self.cfg.tiered else None
@@ -829,7 +1009,7 @@ class TieredIndex:
         if self.cold is None and self._cold_enabled():
             self.cold = ColdTier(
                 self._cold_root(), self.dim, self.cfg.warm_rows,
-                self.cfg.promote_cache,
+                self.cfg.promote_cache, self.scorer,
             )
         return self.cold
 
@@ -1012,8 +1192,11 @@ class TieredIndex:
                     cands = cands[cands >= min_slot]
                     self._h_cands.observe(float(len(cands)))
                     if len(cands):
-                        scores = self._score_candidates(q_idx, q_val, cands)
-                        order = np.argsort(-scores)[:k]
+                        scores, native = self._score_candidates(q_idx, q_val, cands)
+                        order = (
+                            _topk_desc(scores, k) if native
+                            else np.argsort(-scores)[:k]
+                        )
                         self._c_route["routed"].inc()
                         return scores[order], cands[order], "routed"
                     # empty candidate set: fall through to exact (a
@@ -1030,10 +1213,195 @@ class TieredIndex:
             self._c_route["exact"].inc()
             return scores, slots, "exact"
 
-    def _score_candidates(self, q_idx, q_val, cands: np.ndarray) -> np.ndarray:
+    def _gather_scores_native(self, qd: np.ndarray, cands: np.ndarray) -> Optional[np.ndarray]:
+        """Native zero-copy candidate scoring: split candidate slots into
+        (warm arrays, per-cold-shard) segments of in-range row ids and
+        score them IN PLACE — no [B, K] materialization, cold pages fault
+        in during the C scan. Candidates are sorted ONCE up front: tier/
+        shard segmentation becomes O(shards) searchsorted cuts instead of
+        per-shard boolean masks over the whole list, and the kernel walks
+        each mapping monotonically (measurably faster than a random-order
+        gather on a latency-bound sweep). None (→ the materialized path)
+        when the scorer is off, a segment fails, or warm-overflow rows
+        exist (they need the rows_block patch logic — a degraded/chaos
+        condition where the routed hot path no longer matters)."""
+        sc = self.scorer
+        if sc is None or not sc.enabled or self._warm_overflow:
+            return None
+        m = len(cands)
+        order = np.argsort(cands)
+        srt = cands[order]
+        out_sorted = np.zeros(m, np.float32)
+        segments: List[Tuple[np.ndarray, np.ndarray, np.ndarray]] = []
+        spans: List[Tuple[int, int]] = []
+        # warm segment: rows below the warm boundary AND present in the
+        # store (not-yet-grown slots stay 0, like the materialized gather)
+        n_warm = int(np.searchsorted(
+            srt, min(self.cfg.warm_rows, len(self.warm._idx))
+        ))
+        if n_warm:
+            segments.append((self.warm._idx, self.warm._val, srt[:n_warm]))
+            spans.append((0, n_warm))
+        if int(np.searchsorted(srt, self.cfg.warm_rows)) < m:
+            if self.cold is None:
+                return None  # cold-region slots with no cold tier: let rows_block decide
+            base = self.cold.base
+            off = 0
+            for sh in self.cold._shards:
+                a = int(np.searchsorted(srt, base + off))
+                z = int(np.searchsorted(srt, base + off + sh["rows"]))
+                if z > a:
+                    segments.append(
+                        (sh["idx"], sh["val"],
+                         (srt[a:z] - (base + off)).astype(np.int64))
+                    )
+                    spans.append((a, z))
+                off += sh["rows"]
+            # slots past every shard (not yet spilled) stay 0 — the same
+            # all-pad score the materialized gather returns for them
+        outs = sc.score_gather_segments(qd, segments, self.dim)
+        if outs is None:
+            return None
+        for (a, z), res in zip(spans, outs):
+            out_sorted[a:z] = res
+        out = np.empty(m, np.float32)
+        out[order] = out_sorted
+        return out
+
+    def _score_candidates(self, q_idx, q_val, cands: np.ndarray) -> Tuple[np.ndarray, bool]:
+        """Score routed candidates; returns ``(scores, native)``.
+
+        ``native`` tells the caller whether a native path served — those
+        callers may then take the cheaper partition top-k, while the
+        numpy fallback keeps the historical full argsort so
+        ``KAKVEDA_NATIVE=0`` ordering stays bit-for-bit."""
         qd = self.densify_query(q_idx, q_val)
+        out = self._gather_scores_native(qd, cands)
+        if out is not None:
+            return out, True
         idx, val = self._rows_block(cands)
-        return (qd[np.minimum(idx, self.dim)] * val).sum(axis=1).astype(np.float32)
+        out = self.scorer.score_block(qd, idx, val, self.dim, "ivf")
+        if out is not None:
+            return out, True
+        return (
+            (qd[np.minimum(idx, self.dim)] * val).sum(axis=1).astype(np.float32),
+            False,
+        )
+
+    def match_host_batch(
+        self,
+        q_idx: np.ndarray,
+        q_val: np.ndarray,
+        k: int,
+        *,
+        min_slot: int = 0,
+        exact: Optional[bool] = None,
+    ) -> List[Tuple[np.ndarray, np.ndarray, str]]:
+        """Batched :meth:`match_host`: one ``(scores, slots, mode)`` per
+        query row, same per-query contract (mode provenance, routing
+        fault degrades THAT query to the exact scan).
+
+        The batch form exists for the shared scoring plan: all routed
+        queries' candidate lists are deduplicated into ONE row gather
+        (the cold tier's coalesced read plan runs once per batch, not per
+        query) and ONE thread-pooled native scoring call over the
+        concatenated candidates. The numpy fallback scores per query over
+        the same gathered rows — identical values to the per-query path,
+        so ``KAKVEDA_NATIVE=0`` keeps bit-for-bit parity."""
+        b = q_idx.shape[0]
+        with self.lock:
+            n = self.n
+            if n <= min_slot:
+                return [
+                    (np.zeros(0, np.float32), np.zeros(0, np.int64), "exact")
+                ] * b
+            want_routed = (
+                exact is False
+                or (
+                    exact is None
+                    and self.router is not None
+                    and n - min_slot > _ROUTE_MIN_ROWS
+                    and self.router.covers(n)
+                )
+            )
+            results: List[Optional[Tuple[np.ndarray, np.ndarray, str]]] = [None] * b
+            routed_q: List[int] = []
+            cand_lists: List[np.ndarray] = []
+            if want_routed and self.router is not None:
+                for i in range(b):
+                    try:
+                        self._fault_route.fire()
+                        cands = self.router.route(q_idx[i], q_val[i], self.cfg.nprobe)
+                        cands = cands[cands >= min_slot]
+                        self._h_cands.observe(float(len(cands)))
+                        if len(cands):
+                            routed_q.append(i)
+                            cand_lists.append(cands)
+                        # empty candidate set falls through to exact below
+                    except Exception as e:  # noqa: BLE001 — degrade, never lie
+                        log.warning(
+                            "tier routing failed (%s: %s); serving this query "
+                            "from the exact scan", type(e).__name__, e,
+                        )
+                        scores, slots = self._exact_topk(q_idx[i], q_val[i], k, min_slot)
+                        self._c_route["fault_exact"].inc()
+                        results[i] = (scores, slots, "fault_exact")
+            if routed_q:
+                # Native plan: zero-copy gather-scoring per query (the
+                # shared materialized gather below exists for the numpy
+                # fallback, where the row copy is the dominant cost worth
+                # amortizing across the batch). All-or-nothing: a failed
+                # query discards the native attempt so the fallback plan
+                # runs over the whole batch unchanged.
+                native_res: List[Tuple[np.ndarray, np.ndarray, str]] = []
+                for j, i in enumerate(routed_q):
+                    qd1 = self.densify_query(q_idx[i], q_val[i])
+                    scores = self._gather_scores_native(qd1, cand_lists[j])
+                    if scores is None:
+                        native_res = []
+                        break
+                    order = _topk_desc(scores, k)
+                    native_res.append(
+                        (scores[order], cand_lists[j][order], "routed")
+                    )
+                if native_res:
+                    for j, i in enumerate(routed_q):
+                        self._c_route["routed"].inc()
+                        results[i] = native_res[j]
+                    routed_q = []
+            if routed_q:
+                counts = np.asarray([len(c) for c in cand_lists], np.int64)
+                offsets = np.zeros(len(cand_lists) + 1, np.int64)
+                np.cumsum(counts, out=offsets[1:])
+                flat = np.concatenate(cand_lists)
+                uniq, inv = np.unique(flat, return_inverse=True)
+                u_idx, u_val = self._rows_block(uniq)
+                cat_idx, cat_val = u_idx[inv], u_val[inv]
+                qd = np.stack(
+                    [self.densify_query(q_idx[i], q_val[i]) for i in routed_q]
+                )
+                scores_flat = self.scorer.score_candidates(
+                    qd, cat_idx, cat_val, offsets, self.dim
+                )
+                if scores_flat is None:
+                    scores_flat = np.empty(int(offsets[-1]), np.float32)
+                    for j in range(len(routed_q)):
+                        sl = slice(int(offsets[j]), int(offsets[j + 1]))
+                        scores_flat[sl] = (
+                            qd[j][np.minimum(cat_idx[sl], self.dim)] * cat_val[sl]
+                        ).sum(axis=1).astype(np.float32)
+                for j, i in enumerate(routed_q):
+                    sl = slice(int(offsets[j]), int(offsets[j + 1]))
+                    scores = scores_flat[sl]
+                    order = np.argsort(-scores)[:k]
+                    self._c_route["routed"].inc()
+                    results[i] = (scores[order], cand_lists[j][order], "routed")
+            for i in range(b):
+                if results[i] is None:
+                    scores, slots = self._exact_topk(q_idx[i], q_val[i], k, min_slot)
+                    self._c_route["exact"].inc()
+                    results[i] = (scores, slots, "exact")
+            return results  # type: ignore[return-value]
 
     def _exact_topk(self, q_idx, q_val, k: int, min_slot: int) -> Tuple[np.ndarray, np.ndarray]:
         n = self.n
@@ -1106,7 +1474,7 @@ class TieredIndex:
         """Drop everything (GFKB.reload — the append log was rewritten;
         cold shards describe pre-rewrite slots and must go with it)."""
         with self.lock:
-            self.warm = WarmTier(self.dim)
+            self.warm = WarmTier(self.dim, self.scorer)
             self.router = CoarseRouter(self.dim, self.cfg.max_list) if self.cfg.tiered else None
             self.n = 0
             self._warm_overflow = 0
@@ -1120,6 +1488,7 @@ class TieredIndex:
             cold_n = self.cold.n if self.cold is not None else 0
             return {
                 "tiered": self.cfg.tiered,
+                "native": self.scorer.enabled,
                 "rows": self.n,
                 "hot": self.hot_n,
                 "warm": min(self.n, self.cfg.warm_rows) + self._warm_overflow,

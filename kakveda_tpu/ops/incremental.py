@@ -297,13 +297,21 @@ class ClusterState:
                 return 0
             ids, sims_a = self._ids, self._sims
             row_i, row_s = ids[slot], sims_a[slot]
-            for j, s in zip(neigh, sims):
-                j = int(j)
-                s = float(s)
-                if j == slot or j < 0 or j >= self._n:
-                    continue
-                if not np.isfinite(s) or s < self.threshold:
-                    continue
+            # Vectorized prefilter: attach batches arrive straight from the
+            # (native) host-tier scorer with most candidates below the
+            # threshold — drop them in one pass instead of per-candidate
+            # Python float checks. Survivor order is preserved, so the
+            # evict-worst walk below behaves exactly as before.
+            neigh_a = np.asarray(neigh, np.int64)
+            sims_f = np.asarray(sims, np.float32)
+            keep = (
+                np.isfinite(sims_f)
+                & (sims_f >= self.threshold)
+                & (neigh_a != slot)
+                & (neigh_a >= 0)
+                & (neigh_a < self._n)
+            )
+            for j, s in zip(neigh_a[keep].tolist(), sims_f[keep].tolist()):
                 # slot's own list (candidates arrive best-first)
                 w = int(np.argmin(row_s))
                 if s > row_s[w]:

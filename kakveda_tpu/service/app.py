@@ -48,6 +48,15 @@ from kakveda_tpu.service.batcher import MicroBatcher
 
 log = logging.getLogger("kakveda.service")
 
+
+def _native_status() -> dict:
+    """Native library load/build status for /readyz (ISSUE 11): operators
+    see at a glance whether the host-tier scoring engine is live or the
+    process is running on the numpy fallbacks."""
+    from kakveda_tpu import native as _native
+
+    return _native.status()
+
 PLATFORM_KEY: web.AppKey[Platform] = web.AppKey("platform", Platform)
 WARN_BATCHER_KEY: web.AppKey[MicroBatcher] = web.AppKey("warn_batcher", MicroBatcher)
 _GOSSIP_TASK_KEY: web.AppKey[object] = web.AppKey("fleet_gossip_task", object)
@@ -327,6 +336,7 @@ def make_app(
             "device": health.info(),
             "admission": adm.info(),
             "tiers": plat.gfkb.tiers_info(),
+            "native": _native_status(),
         }
         body["fleet"] = {
             "replica_id": replica_id,

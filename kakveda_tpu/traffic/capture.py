@@ -97,11 +97,20 @@ def from_flightrecorder(payload: dict, *, seed: int = 0,
     so the same dump always converts to the same log."""
     from kakveda_tpu.traffic.scenarios import synth_traces
 
-    ring = []
+    # A real server has exactly one ring per name, but several service
+    # apps can share one process (in-process fleet drills, tests) and
+    # dump_recorders() reports every LIVE ring — pick the most recently
+    # active one (events carry epoch t), never first-match: a stale
+    # empty ring from a torn-down app must not shadow the live capture.
+    ring: list = []
+    ring_t = float("-inf")
     for rec in payload.get("recorders", []):
-        if rec.get("name") == recorder:
-            ring = rec.get("events", [])
-            break
+        if rec.get("name") != recorder:
+            continue
+        events = rec.get("events", [])
+        t = max((float(e.get("t", 0.0)) for e in events), default=float("-inf"))
+        if t > ring_t:
+            ring, ring_t = events, t
     evs: List[dict] = []
     if not ring:
         return evs

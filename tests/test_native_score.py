@@ -1,0 +1,234 @@
+"""Native host-tier scoring engine (ISSUE 11): C++ vs numpy parity over
+the warm / cold / IVF paths, the KAKVEDA_NATIVE=0 bit-for-bit contract,
+the ``require`` build smoke, and the ``native.score`` chaos site
+(armed → numpy fallback, never a failed match).
+"""
+
+import numpy as np
+import pytest
+
+from kakveda_tpu import native
+from kakveda_tpu.core import faults
+from kakveda_tpu.index.tiers import TierConfig, TieredIndex
+
+lib = native.load()
+needs_native = pytest.mark.skipif(lib is None, reason="native library unavailable")
+
+
+@pytest.fixture(autouse=True)
+def _disarmed():
+    faults.disarm()
+    yield
+    faults.disarm()
+
+
+def _clustered_corpus(n, dim, n_templates, k=12, seed=11):
+    rng = np.random.default_rng(seed)
+    tmpl = rng.integers(0, dim, size=(n_templates, k), dtype=np.int64)
+    t = rng.integers(0, n_templates, size=n)
+    idx = tmpl[t].astype(np.int32)
+    val = (1.0 + 0.1 * rng.standard_normal((n, k))).astype(np.float32)
+    val /= np.maximum(np.linalg.norm(val, axis=1, keepdims=True), 1e-9)
+    return idx, val, rng
+
+
+def _build(n, dim, cfg, data_dir=None, seed=11):
+    idx, val, rng = _clustered_corpus(n, dim, n_templates=40, seed=seed)
+    tiers = TieredIndex(dim, cfg, data_dir)
+    for s in range(0, n, 256):
+        e = min(n, s + 256)
+        tiers.insert(np.arange(s, e), idx[s:e], val[s:e])
+    return tiers, idx, val, rng
+
+
+def _queries(idx, val, rng, m):
+    out = []
+    for qi in rng.integers(0, len(idx), size=m).tolist():
+        q_val = val[qi] + 0.05 * rng.standard_normal(idx.shape[1]).astype(np.float32)
+        q_val /= max(float(np.linalg.norm(q_val)), 1e-9)
+        out.append((idx[qi], q_val))
+    return out
+
+
+def _run(tiers, queries, *, exact):
+    return [tiers.match_host(q_idx, q_val, 5, exact=exact) for q_idx, q_val in queries]
+
+
+def _assert_topk_parity(res_a, res_b):
+    """Same top-k ids and scores within 1e-5 (float summation-order ties
+    may swap ids of equal-score rows — accept an id swap only when the
+    scores tie within tolerance)."""
+    for (sc_a, sl_a, _), (sc_b, sl_b, _) in zip(res_a, res_b):
+        np.testing.assert_allclose(sc_a, sc_b, atol=1e-5)
+        for j, (a, b) in enumerate(zip(sl_a, sl_b)):
+            assert a == b or abs(float(sc_a[j]) - float(sc_b[j])) <= 1e-5
+
+
+# ---------------------------------------------------------------------------
+# native vs numpy parity, per path
+# ---------------------------------------------------------------------------
+
+
+@needs_native
+def test_warm_exact_scan_parity():
+    """Warm-tier exact scan: the C++ row sweep and the inverted-index
+    walk must agree on top-k ids and scores."""
+    tiers, idx, val, rng = _build(2500, 512, TierConfig(tiered=True, hot_rows=0, nprobe=8))
+    assert tiers.scorer.enabled
+    qs = _queries(idx, val, rng, 32)
+    before = tiers.scorer._h["warm"].count
+    res_native = _run(tiers, qs, exact=True)
+    assert tiers.scorer._h["warm"].count > before, "native warm path never ran"
+    tiers.scorer.enabled = False
+    res_numpy = _run(tiers, qs, exact=True)
+    tiers.scorer.enabled = True
+    _assert_topk_parity(res_native, res_numpy)
+
+
+@needs_native
+def test_ivf_routed_parity_single_and_batch():
+    """Routed matching: native candidate scoring (per-query block and the
+    batched thread-pooled call) agrees with the numpy fallback."""
+    tiers, idx, val, rng = _build(2500, 512, TierConfig(tiered=True, hot_rows=0, nprobe=8))
+    qs = _queries(idx, val, rng, 32)
+    res_native = _run(tiers, qs, exact=False)
+    q_idx = np.stack([q[0] for q in qs])
+    q_val = np.stack([q[1] for q in qs])
+    before = tiers.scorer._h["ivf"].count
+    res_batch_native = tiers.match_host_batch(q_idx, q_val, 5, exact=False)
+    assert tiers.scorer._h["ivf"].count > before, "native ivf path never ran"
+    tiers.scorer.enabled = False
+    res_numpy = _run(tiers, qs, exact=False)
+    res_batch_numpy = tiers.match_host_batch(q_idx, q_val, 5, exact=False)
+    tiers.scorer.enabled = True
+    _assert_topk_parity(res_native, res_numpy)
+    _assert_topk_parity(res_batch_native, res_batch_numpy)
+    _assert_topk_parity(res_batch_native, res_native)
+
+
+@needs_native
+def test_cold_shard_scan_parity(tmp_path):
+    """Cold memmap shards: native per-shard sweep vs the chunked numpy
+    scan, through the exact match path of a spilled corpus."""
+    cfg = TierConfig(
+        tiered=True, hot_rows=0, warm_rows=512, nprobe=4,
+        cold_dir=tmp_path / "cold",
+    )
+    tiers, idx, val, rng = _build(2000, 512, cfg, data_dir=tmp_path)
+    assert tiers.info()["cold"] > 0, "corpus never spilled to cold"
+    qs = _queries(idx, val, rng, 16)
+    before = tiers.scorer._h["cold"].count
+    res_native = _run(tiers, qs, exact=True)
+    assert tiers.scorer._h["cold"].count > before, "native cold path never ran"
+    tiers.scorer.enabled = False
+    res_numpy = _run(tiers, qs, exact=True)
+    tiers.scorer.enabled = True
+    _assert_topk_parity(res_native, res_numpy)
+
+
+@needs_native
+def test_score_block_clamps_pad_and_negative_ids():
+    """Raw kernel property: pad (== dim) and negative ids score 0 exactly
+    like the numpy clamp expression — malformed rows degrade a score,
+    never read out of bounds."""
+    rng = np.random.default_rng(0)
+    dim, n, k = 64, 300, 8
+    idx = rng.integers(-3, dim + 1, size=(n, k)).astype(np.int32)
+    val = rng.standard_normal((n, k)).astype(np.float32)
+    qd = np.zeros(dim + 1, np.float32)
+    qd[:dim] = rng.standard_normal(dim).astype(np.float32)
+    out = native.score_block(qd, idx, val, dim)
+    assert out is not None
+    clamped = np.where((idx < 0) | (idx >= dim), dim, idx)
+    ref = (qd[clamped] * val).sum(axis=1)
+    np.testing.assert_allclose(out, ref, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# KAKVEDA_NATIVE=0 / require / fault contracts
+# ---------------------------------------------------------------------------
+
+
+def test_native_off_bit_for_bit(monkeypatch):
+    """KAKVEDA_NATIVE=0: the scorer stays disabled and the batch path's
+    numpy fallback reproduces the per-query numpy path EXACTLY (same
+    gathered rows, same expression — bit-for-bit, not just within
+    tolerance)."""
+    monkeypatch.setattr(native, "_lib", None)
+    monkeypatch.setattr(native, "_load_attempted", False)
+    monkeypatch.setenv("KAKVEDA_NATIVE", "0")
+    try:
+        tiers, idx, val, rng = _build(
+            1500, 512, TierConfig(tiered=True, hot_rows=0, nprobe=8)
+        )
+        assert not tiers.scorer.enabled
+        qs = _queries(idx, val, rng, 16)
+        res_single = _run(tiers, qs, exact=False)
+        res_batch = tiers.match_host_batch(
+            np.stack([q[0] for q in qs]), np.stack([q[1] for q in qs]), 5,
+            exact=False,
+        )
+        for (sc_a, sl_a, mode_a), (sc_b, sl_b, mode_b) in zip(res_single, res_batch):
+            assert mode_a == mode_b
+            np.testing.assert_array_equal(sl_a, sl_b)
+            np.testing.assert_array_equal(sc_a, sc_b)  # bit-for-bit
+        # exact scans identical too (scorer off on both paths)
+        e_single = _run(tiers, qs, exact=True)
+        for (sc, sl, mode) in e_single:
+            assert mode == "exact" and len(sl) == 5
+    finally:
+        monkeypatch.setattr(native, "_load_attempted", False)
+
+
+@needs_native
+def test_native_require_smoke(monkeypatch):
+    """KAKVEDA_NATIVE=require must load (the in-tree build works here) and
+    status() reports it."""
+    monkeypatch.setattr(native, "_lib", None)
+    monkeypatch.setattr(native, "_load_attempted", False)
+    monkeypatch.setenv("KAKVEDA_NATIVE", "require")
+    try:
+        assert native.load() is not None
+        st = native.status()
+        assert st["available"] and st["mode"] == "require" and st["threads"] >= 1
+    finally:
+        monkeypatch.setattr(native, "_lib", None)
+        monkeypatch.setattr(native, "_load_attempted", False)
+
+
+@needs_native
+@pytest.mark.chaos
+def test_native_score_fault_falls_back():
+    """Chaos site native.score: armed, every scoring call degrades to the
+    numpy path — identical results, fallback counter incremented, and the
+    match NEVER fails."""
+    tiers, idx, val, rng = _build(2500, 512, TierConfig(tiered=True, hot_rows=0, nprobe=8))
+    qs = _queries(idx, val, rng, 8)
+    res_native = _run(tiers, qs, exact=True)
+    before = tiers.scorer._c_fb["fault"].value
+    faults.arm("native.score:1:-1")
+    try:
+        res_fault = _run(tiers, qs, exact=True)
+        r_sc, r_sl, r_mode = tiers.match_host(qs[0][0], qs[0][1], 5, exact=False)
+        assert r_mode == "routed" and len(r_sl)
+    finally:
+        faults.disarm()
+    assert tiers.scorer._c_fb["fault"].value > before
+    _assert_topk_parity(res_native, res_fault)
+    # disarmed: native serves again
+    res_after = _run(tiers, qs[:2], exact=True)
+    _assert_topk_parity(res_native[:2], res_after)
+
+
+@needs_native
+def test_min_rows_floor_keeps_tiny_scans_numpy():
+    """Scans under KAKVEDA_NATIVE_MIN_ROWS stay on the numpy path — a
+    policy choice, so no fallback is counted either."""
+    tiers, idx, val, rng = _build(64, 256, TierConfig(tiered=True, hot_rows=0, nprobe=4))
+    tiers.scorer.min_rows = 1 << 20
+    h_before = sum(h.count for h in tiers.scorer._h.values())
+    fb_before = sum(c.value for c in tiers.scorer._c_fb.values())
+    sc, sl, _mode = tiers.match_host(idx[5], val[5], 3, exact=True)
+    assert len(sl)
+    assert sum(h.count for h in tiers.scorer._h.values()) == h_before
+    assert sum(c.value for c in tiers.scorer._c_fb.values()) == fb_before
