@@ -2252,6 +2252,18 @@ def _bench_storm(backend: str) -> dict:
         p95x = 8.0 if _native.available() else 50.0
     fleet_on = os.environ.get("KAKVEDA_BENCH_STORM_FLEET", "1") != "0"
 
+    # Arm the runtime concurrency sanitizer for the drill (unless the
+    # operator decided): every lock the solo arm constructs below records
+    # acquisition-order edges, and the row self-certifies the observed
+    # graph is acyclic — the dynamic complement of the static lock-order
+    # rule, under real storm traffic.
+    from kakveda_tpu.core import sanitize as _sanitize
+
+    _sanitize_armed = os.environ.get("KAKVEDA_BENCH_STORM_SANITIZE", "1") != "0"
+    if _sanitize_armed:
+        os.environ.setdefault("KAKVEDA_SANITIZE", "1")
+        _sanitize.reset()
+
     tmp = Path(tempfile.mkdtemp(prefix="kakveda-bench-storm-"))
 
     # ---- arm A: single process, full SLO certification ----------------
@@ -2399,6 +2411,22 @@ def _bench_storm(backend: str) -> dict:
             "late_p95_ms": fres.late_p95_ms(),
         }
 
+    sanitizer_out: dict = {"armed": False}
+    if _sanitize_armed:
+        _rep = _sanitize.sanitizer_report()
+        # Self-certifying like the SLO gates: an observed lock-order cycle
+        # under storm traffic is a latent deadlock, not a result.
+        if _rep["cycles"]:
+            raise AssertionError(
+                f"storm drill observed lock-order cycle(s): {_rep['cycles']}"
+            )
+        sanitizer_out = {
+            "armed": True,
+            "lock_order_edges": len(_rep["edges"]),
+            "lock_order_cycles": 0,
+            "stalls": len(_rep["stalls"]),
+        }
+
     ratio = round(storm_p95 / max(base_p95, 1e-9), 2)
     return {
         "metric": "storm_warn_p95_degradation",
@@ -2423,6 +2451,7 @@ def _bench_storm(backend: str) -> dict:
         },
         "late_p95_ms": res.late_p95_ms(),
         "fleet": fleet_out,
+        "sanitizer": sanitizer_out,
     }
 
 
@@ -2829,6 +2858,46 @@ def _lint_findings() -> int:
         return -1
 
 
+_CONCURRENCY_RULES = ("lockset-race", "lock-order", "event-loop-blocking",
+                      "unjoined-thread")
+
+
+def _concurrency_findings() -> int:
+    """Finding count of the static concurrency pass alone (lockset races,
+    lock-order cycles, event-loop blockers, unjoined threads) — split out
+    from lint_findings so a regression in thread discipline is visible as
+    its own number. 0 = clean; -1 = linter failure."""
+    try:
+        from pathlib import Path
+
+        from kakveda_tpu.analysis.framework import run_lint
+
+        res = run_lint(Path(__file__).resolve().parent,
+                       rule_ids=_CONCURRENCY_RULES)
+        return len(res.findings)
+    except Exception:  # noqa: BLE001 — lint telemetry must never sink a bench line
+        return -1
+
+
+def _sanitizer_plane() -> dict:
+    """Runtime-sanitizer evidence for the bench line, when armed
+    (KAKVEDA_SANITIZE=1): loop stalls seen, distinct lock-order edges
+    observed, and any cycles among them. Empty dict when disarmed."""
+    try:
+        from kakveda_tpu.core import sanitize
+
+        rep = sanitize.sanitizer_report()
+        if not rep["enabled"] and not rep["edges"] and not rep["stalls"]:
+            return {}
+        return {
+            "sanitizer_stalls": len(rep["stalls"]),
+            "lock_order_edges": len(rep["edges"]),
+            "lock_order_cycles": len(rep["cycles"]),
+        }
+    except Exception:  # noqa: BLE001 — telemetry must never sink a bench line
+        return {}
+
+
 def load_resumable_partial(partial_path: str, backend: str) -> dict:
     """Load already-measured metrics from a prior wedged sweep.
 
@@ -3017,6 +3086,8 @@ def main() -> int:
         out = fns[which](backend)
         out["metrics_plane"] = _metrics_plane()
         out["lint_findings"] = _lint_findings()
+        out["concurrency_findings"] = _concurrency_findings()
+        out.update(_sanitizer_plane())
         print(json.dumps(out))
         return 0
 
@@ -3093,6 +3164,8 @@ def main() -> int:
     headline["extra_metrics"] = results[1:]
     headline["metrics_plane"] = _metrics_plane()
     headline["lint_findings"] = _lint_findings()
+    headline["concurrency_findings"] = _concurrency_findings()
+    headline.update(_sanitizer_plane())
     print(json.dumps(headline))
     return 0
 

@@ -1,0 +1,875 @@
+"""Concurrency rules: lockset races, lock-order cycles, event-loop
+blocking, thread lifecycle.
+
+The static half of the concurrency sanitizer (runtime half:
+:mod:`kakveda_tpu.core.sanitize`). Four rules in the PR-6 framework, same
+pragma/baseline/exit-code semantics:
+
+* **lockset-race** — Eraser-style, adapted to this tree's thread-entry
+  seams. Per class, discover the contexts code runs in (``threading.
+  Thread(target=…)``/``Timer`` targets, ``run_in_executor``/``to_thread``
+  callees, ``async def`` = the event-loop plane, everything else = the
+  caller's thread) and the locks the class owns. Flag a ``self._*``
+  attribute that is (a) accessed under a lock somewhere but MUTATED
+  without it elsewhere, or (b) mutated from ≥2 distinct contexts with no
+  common lexical guard. Single-writer-by-design fields document their
+  discipline with ``# kakveda: owned-by[<context>]`` on the mutation or
+  the ``__init__`` declaration — an annotation, not a silent suppression.
+* **lock-order** — build the global lock-acquisition graph (lexical
+  ``with`` nesting, plus calls that transitively acquire: same-class
+  ``self.m()`` and ``self.attr.m()`` where ``__init__`` pins ``attr`` to
+  a known class) and flag cycles. Node ids (``ClassName._attr``) match
+  :func:`kakveda_tpu.core.sanitize.named_lock` names so the runtime edge
+  set cross-checks against this graph.
+* **event-loop-blocking** — sync blocking calls (``time.sleep``,
+  ``.result()``, sync httpx/requests, file I/O, ``lock.acquire()``,
+  device sync, subprocess) lexically inside ``async def`` bodies on the
+  HTTP planes. Code inside a nested ``def``/``lambda`` is exempt — that
+  is exactly the ``run_in_executor``/``to_thread`` thunk idiom. Also
+  flags ``with <lock>`` in an async body when the same file acquires
+  that lock from a spawned worker thread (a loop blocked behind a
+  worker's critical section).
+* **unjoined-thread** — every spawned ``Thread``/``Timer`` must be
+  daemonized (``daemon=True`` kwarg or ``.daemon = True`` before start)
+  or joined/cancelled somewhere on a close path.
+
+Shared idiom notes: methods named ``*_locked`` and methods whose
+docstring says "caller holds …" are treated as running under every lock
+their class owns (the tree's caller-holds convention — single-writer
+helpers like ``_set_brownout_state`` rely on it).
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from kakveda_tpu.analysis.framework import (
+    FileContext,
+    Finding,
+    Rule,
+    TreeContext,
+    register,
+)
+from kakveda_tpu.analysis.rules import _const_str, _parent_map, _self_attr
+from kakveda_tpu.core.sanitize import find_cycles
+
+# Container-mutating method names that count as writes in the lockset
+# analysis. Thread-safe primitives' verbs (Event.set, Queue.put) are
+# deliberately absent — they synchronize internally.
+_MUTATORS = frozenset({
+    "append", "extend", "insert", "add", "discard", "remove", "update",
+    "setdefault", "pop", "popitem", "clear", "appendleft", "popleft",
+})
+
+_INIT_METHODS = frozenset({"__init__", "__new__", "__post_init__", "__del__"})
+
+
+def _docstring(node: ast.AST) -> str:
+    try:
+        return ast.get_docstring(node) or ""
+    except TypeError:
+        return ""
+
+
+def _caller_holds(meth: ast.AST) -> bool:
+    """The tree's caller-holds-the-lock convention: ``*_locked`` names or
+    a docstring saying so."""
+    name = getattr(meth, "name", "")
+    if name.endswith("_locked"):
+        return True
+    doc = _docstring(meth).lower()
+    return "caller holds" in doc or "callers hold" in doc
+
+
+# ---------------------------------------------------------------------------
+# per-file class models (shared by all four rules; cached on the FileContext)
+# ---------------------------------------------------------------------------
+
+
+class _ClassModel:
+    def __init__(self, name: str, node: ast.ClassDef):
+        self.name = name
+        self.node = node
+        # method name -> def node (class-level only; nested defs excluded)
+        self.methods: Dict[str, ast.AST] = {}
+        # lock-holding attr -> stable lock node id. Conditions built over a
+        # class lock alias to the SAME id (``with self._cv`` holds _lock).
+        self.locks: Dict[str, str] = {}
+        # self.attr -> class name candidates from __init__ construction
+        self.attr_types: Dict[str, str] = {}
+        # method -> context labels ("loop"/"thread"/"executor"/"caller")
+        self.labels: Dict[str, Set[str]] = {}
+        # methods directly spawned (Thread/Timer target, executor callee)
+        self.spawn_entries: Set[str] = set()
+
+
+class _FileModel:
+    def __init__(self, fc: FileContext):
+        self.fc = fc
+        self.stem = Path(fc.rel).stem
+        self.classes: Dict[str, _ClassModel] = {}
+        self.module_locks: Dict[str, str] = {}  # module var -> lock id
+        if fc.tree is None:
+            return
+        for node in fc.tree.body:  # type: ignore[union-attr]
+            if isinstance(node, ast.ClassDef):
+                self.classes[node.name] = self._build_class(node)
+            elif isinstance(node, ast.Assign) and len(node.targets) == 1:
+                tgt = node.targets[0]
+                if isinstance(tgt, ast.Name):
+                    lid = _lock_ctor_id(node.value, owner=self.stem,
+                                        attr=tgt.id)
+                    if lid is not None:
+                        self.module_locks[tgt.id] = lid
+
+    def _build_class(self, cnode: ast.ClassDef) -> _ClassModel:
+        cm = _ClassModel(cnode.name, cnode)
+        for item in cnode.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                cm.methods[item.name] = item
+        # Lock attrs + attr types: every `self.X = …` assignment anywhere
+        # in the class (locks are occasionally built outside __init__).
+        for meth in cm.methods.values():
+            for node in ast.walk(meth):
+                if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+                    continue
+                attr = _self_attr(node.targets[0])
+                if attr is None:
+                    continue
+                lid = _lock_ctor_id(node.value, owner=cm.name, attr=attr)
+                if lid is not None:
+                    cm.locks[attr] = lid
+                    continue
+                alias = _condition_over(node.value)
+                if alias is not None and alias in cm.locks:
+                    cm.locks[attr] = cm.locks[alias]
+                    continue
+                ctor = _constructed_class(node.value)
+                if ctor is not None:
+                    cm.attr_types[attr] = ctor
+        _label_contexts(cm)
+        return cm
+
+
+def _lock_ctor_id(value: ast.AST, owner: str, attr: str) -> Optional[str]:
+    """If ``value`` constructs a lock, its stable node id: the
+    ``named_lock("…")`` literal when present (the runtime sanitizer uses
+    the same string), else ``Owner.attr``."""
+    if not isinstance(value, ast.Call):
+        return None
+    fn = value.func
+    fname = fn.attr if isinstance(fn, ast.Attribute) else (
+        fn.id if isinstance(fn, ast.Name) else None)
+    if fname == "named_lock" and value.args:
+        lit = _const_str(value.args[0])
+        if lit:
+            return lit
+    if fname in ("Lock", "RLock"):
+        return f"{owner}.{attr}"
+    return None
+
+
+def _condition_over(value: ast.AST) -> Optional[str]:
+    """``threading.Condition(self.X)`` -> ``X`` (holding the condition IS
+    holding the underlying lock)."""
+    if isinstance(value, ast.Call):
+        fn = value.func
+        fname = fn.attr if isinstance(fn, ast.Attribute) else (
+            fn.id if isinstance(fn, ast.Name) else None)
+        if fname == "Condition" and value.args:
+            return _self_attr(value.args[0])
+    return None
+
+
+def _constructed_class(value: ast.AST) -> Optional[str]:
+    """The single CapWords class constructed anywhere in ``value`` (for
+    ``self.brownout = brownout or BrownoutController(…)``), else None."""
+    names = {
+        n.func.id for n in ast.walk(value)
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Name)
+        and n.func.id[:1].isupper()
+    }
+    return names.pop() if len(names) == 1 else None
+
+
+def _self_method_of(call_arg: ast.AST) -> Optional[str]:
+    return _self_attr(call_arg)
+
+
+def _label_contexts(cm: _ClassModel) -> None:
+    """Assign each method the thread contexts it may run in, propagated
+    through the class's ``self.m()`` call graph."""
+    labels: Dict[str, Set[str]] = {m: set() for m in cm.methods}
+    calls: Dict[str, Set[str]] = {m: set() for m in cm.methods}
+    for mname, meth in cm.methods.items():
+        if isinstance(meth, ast.AsyncFunctionDef):
+            labels[mname].add("loop")
+        for node in ast.walk(meth):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            fname = fn.attr if isinstance(fn, ast.Attribute) else (
+                fn.id if isinstance(fn, ast.Name) else None)
+            if fname in ("Thread", "Timer"):
+                tgt = None
+                for kw in node.keywords:
+                    if kw.arg == "target":
+                        tgt = _self_method_of(kw.value)
+                if fname == "Timer" and tgt is None and len(node.args) >= 2:
+                    tgt = _self_method_of(node.args[1])
+                if tgt in labels:
+                    labels[tgt].add("thread")
+                    cm.spawn_entries.add(tgt)
+            elif fname == "run_in_executor" and len(node.args) >= 2:
+                tgt = _self_method_of(node.args[1])
+                if tgt in labels:
+                    labels[tgt].add("executor")
+                    cm.spawn_entries.add(tgt)
+            elif fname == "to_thread" and node.args:
+                tgt = _self_method_of(node.args[0])
+                if tgt in labels:
+                    labels[tgt].add("executor")
+                    cm.spawn_entries.add(tgt)
+            elif isinstance(fn, ast.Attribute):
+                callee = _self_attr(fn)
+                if callee in calls:
+                    calls[mname].add(callee)
+    # Propagate: a callee runs in every context its callers do.
+    changed = True
+    while changed:
+        changed = False
+        for caller, callees in calls.items():
+            for callee in callees:
+                before = len(labels[callee])
+                labels[callee] |= labels[caller]
+                changed = changed or len(labels[callee]) != before
+    for m in labels:
+        if not labels[m]:
+            labels[m] = {"caller"}
+    cm.labels = labels
+
+
+def _file_model(fc: FileContext) -> _FileModel:
+    fm = getattr(fc, "_concurrency_model", None)
+    if fm is None:
+        fm = _FileModel(fc)
+        fc._concurrency_model = fm  # type: ignore[attr-defined]
+    return fm
+
+
+def _global_maps(ctx: TreeContext):
+    """Tree-wide class map and unique-owner lock-attr map, cached on ctx."""
+    cached = getattr(ctx, "_concurrency_global", None)
+    if cached is not None:
+        return cached
+    class_map: Dict[str, _ClassModel] = {}
+    dropped: Set[str] = set()
+    for fc in ctx.files:
+        for name, cm in _file_model(fc).classes.items():
+            if name in class_map or name in dropped:
+                class_map.pop(name, None)  # ambiguous: two defs share a name
+                dropped.add(name)
+            else:
+                class_map[name] = cm
+    attr_owner: Dict[str, Set[str]] = {}
+    for cm in class_map.values():
+        for attr, lid in cm.locks.items():
+            attr_owner.setdefault(attr, set()).add(lid)
+    unique_owner = {a: next(iter(s)) for a, s in attr_owner.items() if len(s) == 1}
+    ctx._concurrency_global = (class_map, unique_owner)  # type: ignore[attr-defined]
+    return ctx._concurrency_global  # type: ignore[attr-defined]
+
+
+def _resolve_lock(expr: ast.AST, cm: Optional[_ClassModel], fm: _FileModel,
+                  unique_owner: Dict[str, str],
+                  class_map: Dict[str, _ClassModel]) -> Optional[str]:
+    """Lock node id for a ``with``-item context expression, else None."""
+    attr = _self_attr(expr)
+    if attr is not None:
+        if cm is not None and attr in cm.locks:
+            return cm.locks[attr]
+        if "lock" in attr.lower() or attr.endswith("_cv"):
+            owner = cm.name if cm is not None else fm.stem
+            return f"{owner}.{attr}"
+        return None
+    if isinstance(expr, ast.Name):
+        if expr.id in fm.module_locks:
+            return fm.module_locks[expr.id]
+        if "lock" in expr.id.lower():
+            return f"{fm.stem}.{expr.id}"
+        return None
+    if isinstance(expr, ast.Attribute):
+        # self.a.lockattr / obj.lockattr — resolve via __init__-pinned
+        # types first, then the unique global owner of the attr name.
+        base = _self_attr(expr.value)
+        if base is not None and cm is not None:
+            tname = cm.attr_types.get(base)
+            tcm = class_map.get(tname) if tname else None
+            if tcm is not None and expr.attr in tcm.locks:
+                return tcm.locks[expr.attr]
+        if expr.attr in unique_owner:
+            return unique_owner[expr.attr]
+    return None
+
+
+# ---------------------------------------------------------------------------
+# held-stack scanner
+# ---------------------------------------------------------------------------
+
+
+def _scan_held(node: ast.AST, held: List[str], resolve, visit) -> None:
+    """Depth-first walk tracking the lexically-held lock stack. ``visit``
+    is called for every node (with the current stack); nested function
+    bodies are skipped — they run in their own context (and a nested
+    ``def`` is exactly the executor-thunk idiom)."""
+    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+        return
+    if isinstance(node, (ast.With, ast.AsyncWith)):
+        acquired: List[str] = []
+        for item in node.items:
+            _scan_held(item.context_expr, held, resolve, visit)
+            lid = resolve(item.context_expr)
+            if lid is not None:
+                visit("acquire", item.context_expr, lid, held)
+                acquired.append(lid)
+        held.extend(acquired)
+        for child in node.body:
+            _scan_held(child, held, resolve, visit)
+        if acquired:
+            del held[-len(acquired):]
+        return
+    visit("node", node, None, held)
+    for child in ast.iter_child_nodes(node):
+        _scan_held(child, held, resolve, visit)
+
+
+def _scan_function(fn: ast.AST, initial_held: List[str], resolve, visit) -> None:
+    for stmt in fn.body:  # type: ignore[union-attr]
+        _scan_held(stmt, initial_held, resolve, visit)
+
+
+# ---------------------------------------------------------------------------
+# rule: lockset-race
+# ---------------------------------------------------------------------------
+
+
+@register
+class LocksetRace(Rule):
+    id = "lockset-race"
+    invariant = (
+        "a self._attr shared across thread contexts is mutated only under "
+        "its lock (or carries an owned-by[<context>] annotation)"
+    )
+    scope = ("kakveda_tpu",)
+
+    def visit_file(self, fc: FileContext, ctx: TreeContext) -> List[Finding]:
+        fm = _file_model(fc)
+        class_map, unique_owner = _global_maps(ctx)
+        out: List[Finding] = []
+        for cm in fm.classes.values():
+            out.extend(self._check_class(fc, fm, cm, class_map, unique_owner))
+        return out
+
+    def _check_class(self, fc, fm, cm, class_map, unique_owner) -> List[Finding]:
+        if not cm.locks:
+            return []  # no lock discipline to check against
+        # Entry points whose callers are outside the class: public (incl.
+        # dunder) methods, async defs, and direct Thread/executor targets.
+        # Everything else (private helpers) inherits its guards from its
+        # call sites — ``reload()`` holding the lock around ``_replay()``
+        # guards _replay's body even though the ``with`` is in the caller.
+        entries: Set[str] = set(cm.spawn_entries)
+        for mname, meth in cm.methods.items():
+            if mname in _INIT_METHODS:
+                continue
+            if not mname.startswith("_") or (
+                    mname.startswith("__") and mname.endswith("__")):
+                entries.add(mname)
+            if isinstance(meth, ast.AsyncFunctionDef):
+                entries.add(mname)
+
+        # per-method raw accesses: (attr, is_mutation, lexical guards, line)
+        raw: Dict[str, List[Tuple[str, bool, frozenset, int]]] = {}
+        # class-internal call sites: (caller, callee, lexical held)
+        sites: List[Tuple[str, str, frozenset]] = []
+        decl_lines: Dict[str, List[int]] = {}
+
+        def resolve(expr):
+            return _resolve_lock(expr, cm, fm, unique_owner, class_map)
+
+        for mname, meth in cm.methods.items():
+            is_init = mname in _INIT_METHODS
+            if is_init:
+                for node in ast.walk(meth):
+                    if isinstance(node, ast.Assign):
+                        targets = node.targets
+                    elif isinstance(node, ast.AnnAssign):
+                        targets = [node.target]
+                    else:
+                        continue
+                    for tgt in targets:
+                        attr = _self_attr(tgt)
+                        if attr is not None:
+                            decl_lines.setdefault(attr, []).append(node.lineno)
+            base_held = sorted(set(cm.locks.values())) if _caller_holds(meth) else []
+            acc_list = raw.setdefault(mname, [])
+
+            def visit(kind, node, lid, held, _m=mname, _init=is_init,
+                      _accs=acc_list):
+                if kind != "node":
+                    return
+                if isinstance(node, ast.Call) and isinstance(
+                        node.func, ast.Attribute):
+                    callee = _self_attr(node.func)
+                    if callee in cm.methods and not _init:
+                        sites.append((_m, callee, frozenset(held)))
+                if _init:
+                    return  # pre-publication: no shared-state hazard yet
+                guards = frozenset(held)
+                attr = None
+                mutation = False
+                if isinstance(node, ast.Attribute):
+                    attr = _self_attr(node)
+                    mutation = isinstance(node.ctx, (ast.Store, ast.Del))
+                elif isinstance(node, ast.Subscript) and isinstance(
+                        node.ctx, (ast.Store, ast.Del)):
+                    attr = _self_attr(node.value)
+                    mutation = True
+                elif isinstance(node, ast.Call) and isinstance(
+                        node.func, ast.Attribute) and node.func.attr in _MUTATORS:
+                    attr = _self_attr(node.func.value)
+                    mutation = attr is not None
+                if attr is None or not attr.startswith("_"):
+                    return
+                if attr in cm.locks:
+                    return  # the locks themselves
+                _accs.append((attr, mutation, guards, node.lineno))
+
+            _scan_function(meth, list(base_held), resolve, visit)
+
+        # Effective caller-held guards per method, to a fixed point.
+        # None = not yet constrained (⊤); entries start at their own base.
+        eff: Dict[str, Optional[frozenset]] = {}
+        for mname, meth in cm.methods.items():
+            if mname in _INIT_METHODS:
+                continue
+            if mname in entries:
+                eff[mname] = frozenset(cm.locks.values()) if \
+                    _caller_holds(meth) else frozenset()
+            else:
+                eff[mname] = None
+        for _ in range(len(cm.methods) + 1):
+            changed = False
+            for mname in eff:
+                if mname in entries:
+                    continue
+                contribs = []
+                unknown = False
+                for caller, callee, held in sites:
+                    if callee != mname or caller in _INIT_METHODS:
+                        continue
+                    ceff = eff.get(caller, frozenset())
+                    if ceff is None:
+                        unknown = True
+                        continue
+                    contribs.append(held | ceff)
+                if not contribs:
+                    continue  # init-only (or unreached) — resolved below
+                new = contribs[0]
+                for c in contribs[1:]:
+                    new = new & c
+                if unknown and eff[mname] is None:
+                    continue  # wait for callers to settle
+                if eff[mname] is None or new != eff[mname]:
+                    eff[mname] = new
+                    changed = True
+            if not changed:
+                break
+
+        # attr -> list of (is_mutation, effective guards, labels, lineno)
+        accesses: Dict[str, List[Tuple[bool, frozenset, frozenset, int]]] = {}
+        for mname, acc_list in raw.items():
+            if mname in _INIT_METHODS:
+                continue
+            m_eff = eff.get(mname)
+            if m_eff is None:
+                continue  # reachable only from __init__: construction state
+            labels = frozenset(cm.labels.get(mname, {"caller"}))
+            for attr, mutation, guards, line in acc_list:
+                accesses.setdefault(attr, []).append(
+                    (mutation, guards | m_eff, labels, line))
+
+        out: List[Finding] = []
+        for attr, accs in sorted(accesses.items()):
+            if self._owned(fc, accs, decl_lines.get(attr, ())):
+                continue
+            muts = [a for a in accs if a[0]]
+            if not muts:
+                continue
+            guarded = [a for a in accs if a[1]]
+            unguarded_muts = [a for a in muts if not a[1]]
+            if guarded and unguarded_muts:
+                lock = sorted(guarded[0][1])[0]
+                out.append(Finding(
+                    self.id, fc.rel, unguarded_muts[0][3],
+                    f"{cm.name}.{attr} is guarded by {lock} elsewhere but "
+                    f"mutated without it — racy write (guard it or annotate "
+                    f"owned-by[…])",
+                ))
+                continue
+            mut_labels = set()
+            for a in muts:
+                mut_labels |= a[2]
+            common = None
+            for a in muts:
+                common = a[1] if common is None else (common & a[1])
+            if len(mut_labels) >= 2 and not common:
+                out.append(Finding(
+                    self.id, fc.rel, unguarded_muts[0][3] if unguarded_muts
+                    else muts[0][3],
+                    f"{cm.name}.{attr} is mutated from multiple contexts "
+                    f"({', '.join(sorted(mut_labels))}) with no common lock "
+                    f"guard — annotate owned-by[…] if single-writer by design",
+                ))
+        return out
+
+    @staticmethod
+    def _owned(fc: FileContext, accs, decl_lines) -> bool:
+        lines = {a[3] for a in accs} | set(decl_lines)
+        for ln in lines:
+            if ln in fc.owned or (ln - 1) in fc.owned:
+                return True
+        return False
+
+
+# ---------------------------------------------------------------------------
+# rule: lock-order
+# ---------------------------------------------------------------------------
+
+
+def _build_lock_graph(ctx: TreeContext) -> Dict[Tuple[str, str], Tuple[str, int]]:
+    """Global (outer, inner) acquisition edges -> first observed site.
+    Lexical ``with`` nesting plus transitive acquisition through resolved
+    method calls (same-class ``self.m()``, ``__init__``-typed
+    ``self.attr.m()``, same-file ``f()``)."""
+    class_map, unique_owner = _global_maps(ctx)
+    # callable key -> (lexical acquisitions, call edges, held-at events)
+    own_acq: Dict[tuple, Set[str]] = {}
+    call_edges: Dict[tuple, Set[tuple]] = {}
+    held_acqs: List[Tuple[Tuple[str, ...], str, str, int]] = []
+    held_calls: List[Tuple[Tuple[str, ...], tuple, str, int]] = []
+
+    for fc in ctx.files:
+        if fc.tree is None:
+            continue
+        fm = _file_model(fc)
+        module_funcs = {
+            n.name for n in fc.tree.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+
+        def scan(fn_node, key, cm):
+            own_acq.setdefault(key, set())
+            call_edges.setdefault(key, set())
+
+            def resolve(expr):
+                return _resolve_lock(expr, cm, fm, unique_owner, class_map)
+
+            def visit(kind, node, lid, held):
+                if kind == "acquire":
+                    own_acq[key].add(lid)
+                    if held:
+                        held_acqs.append((tuple(held), lid, fc.rel, node.lineno))
+                    return
+                if not isinstance(node, ast.Call):
+                    return
+                callee = None
+                f = node.func
+                if isinstance(f, ast.Attribute):
+                    attr = _self_attr(f)
+                    if attr is not None and cm is not None and attr in cm.methods:
+                        callee = ("m", cm.name, attr)
+                    else:
+                        base = _self_attr(f.value)
+                        if base is not None and cm is not None:
+                            tname = cm.attr_types.get(base)
+                            if tname and tname in class_map and \
+                                    f.attr in class_map[tname].methods:
+                                callee = ("m", tname, f.attr)
+                elif isinstance(f, ast.Name) and f.id in module_funcs:
+                    callee = ("f", fc.rel, f.id)
+                if callee is None:
+                    return
+                call_edges[key].add(callee)
+                if held:
+                    held_calls.append((tuple(held), callee, fc.rel, node.lineno))
+
+            base = []
+            if cm is not None and _caller_holds(fn_node):
+                base = sorted(set(cm.locks.values()))
+            _scan_function(fn_node, base, resolve, visit)
+
+        for cname, cm in fm.classes.items():
+            for mname, meth in cm.methods.items():
+                scan(meth, ("m", cname, mname), cm)
+        for n in fc.tree.body:
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scan(n, ("f", fc.rel, n.name), None)
+
+    # Transitive closure of "may acquire" over the call graph.
+    acq = {k: set(v) for k, v in own_acq.items()}
+    changed = True
+    while changed:
+        changed = False
+        for key, callees in call_edges.items():
+            for callee in callees:
+                extra = acq.get(callee, set()) - acq[key]
+                if extra:
+                    acq[key] |= extra
+                    changed = True
+
+    edges: Dict[Tuple[str, str], Tuple[str, int]] = {}
+    for held, lid, rel, line in held_acqs:
+        for outer in held:
+            if outer != lid:
+                edges.setdefault((outer, lid), (rel, line))
+    for held, callee, rel, line in held_calls:
+        for inner in acq.get(callee, ()):
+            for outer in held:
+                if outer != inner:
+                    edges.setdefault((outer, inner), (rel, line))
+    return edges
+
+
+def static_lock_graph(root) -> List[Tuple[str, str]]:
+    """The tree's static lock-order edges, sorted — the cross-check target
+    for :func:`kakveda_tpu.core.sanitize.lock_order_edges`."""
+    return sorted(_build_lock_graph(TreeContext(Path(root))))
+
+
+@register
+class LockOrder(Rule):
+    id = "lock-order"
+    invariant = "the global lock-acquisition graph stays acyclic"
+    scope = None  # whole-tree
+
+    def check_tree(self, ctx: TreeContext) -> List[Finding]:
+        edges = _build_lock_graph(ctx)
+        out: List[Finding] = []
+        for cycle in find_cycles(edges.keys()):
+            # Normalize rotation so the message (the baseline key) is
+            # stable whatever DFS order found it.
+            body = cycle[:-1]
+            i = body.index(min(body))
+            norm = body[i:] + body[:i] + [body[i]]
+            rel, line = "", 1
+            for a, b in zip(norm, norm[1:]):
+                if (a, b) in edges:
+                    rel, line = edges[(a, b)]
+                    break
+            out.append(Finding(
+                self.id, rel or "kakveda_tpu", line,
+                "lock-order cycle: " + " -> ".join(norm) +
+                " — a thread holding one while another holds the next "
+                "deadlocks; invert one nesting",
+            ))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# rule: event-loop-blocking
+# ---------------------------------------------------------------------------
+
+_HTTP_VERBS = frozenset({"get", "post", "put", "patch", "delete", "head", "request"})
+_FILE_IO = frozenset({"read_text", "write_text", "read_bytes", "write_bytes"})
+_SUBPROC = frozenset({"run", "call", "check_call", "check_output"})
+
+
+def _blocking_label(node: ast.Call) -> Optional[str]:
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        recv = f.value
+        rname = recv.id if isinstance(recv, ast.Name) else (
+            recv.attr if isinstance(recv, ast.Attribute) else "")
+        if f.attr == "sleep" and rname == "time":
+            return "time.sleep() blocks the event loop — await asyncio.sleep"
+        if f.attr == "result":
+            return ".result() blocks the loop on a future — await it instead"
+        if f.attr in _HTTP_VERBS and rname in ("httpx", "requests"):
+            return f"sync {rname}.{f.attr}() on the loop — use an async client"
+        if f.attr in _FILE_IO:
+            return f".{f.attr}() does file I/O on the loop — run_in_executor"
+        if f.attr in _SUBPROC and rname == "subprocess":
+            return f"subprocess.{f.attr}() blocks the loop"
+        if f.attr == "acquire" and "lock" in (rname or "").lower():
+            return "lock.acquire() can block the loop behind a worker thread"
+        if f.attr == "block_until_ready" or (
+                f.attr == "device_get" and rname == "jax"):
+            return f".{f.attr}() synchronizes on device work — run_in_executor"
+        if f.attr == "join" and any(
+                k in (rname or "").lower() for k in ("thread", "timer", "proc")):
+            return ".join() blocks the loop on a worker thread"
+    elif isinstance(f, ast.Name) and f.id == "open":
+        return "open() does file I/O on the loop — run_in_executor"
+    return None
+
+
+@register
+class EventLoopBlocking(Rule):
+    id = "event-loop-blocking"
+    invariant = (
+        "async def bodies on the HTTP planes never call sync blocking "
+        "primitives — blocking work goes through run_in_executor/to_thread"
+    )
+    scope = (
+        "kakveda_tpu/service", "kakveda_tpu/dashboard", "kakveda_tpu/fleet",
+        "kakveda_tpu/events", "kakveda_tpu/traffic", "kakveda_tpu/platform.py",
+    )
+
+    def visit_file(self, fc: FileContext, ctx: TreeContext) -> List[Finding]:
+        if fc.tree is None:
+            return []
+        fm = _file_model(fc)
+        class_map, unique_owner = _global_maps(ctx)
+        # Locks this file acquires from spawned worker threads: a `with`
+        # on one of these inside an async body parks the loop behind
+        # whatever that worker does under the lock.
+        worker_locks: Set[str] = set()
+        for cm in fm.classes.values():
+            def resolve(expr, _cm=cm):
+                return _resolve_lock(expr, _cm, fm, unique_owner, class_map)
+            for mname, meth in cm.methods.items():
+                if not (cm.labels.get(mname, set()) & {"thread", "executor"}):
+                    continue
+
+                def visit(kind, node, lid, held):
+                    if kind == "acquire":
+                        worker_locks.add(lid)
+
+                _scan_function(meth, [], resolve, visit)
+
+        out: List[Finding] = []
+        parents = _parent_map(fc.tree)
+        for fn in ast.walk(fc.tree):
+            if not isinstance(fn, ast.AsyncFunctionDef):
+                continue
+            cm = None
+            p = parents.get(fn)
+            while p is not None and not isinstance(p, ast.ClassDef):
+                p = parents.get(p)
+            if isinstance(p, ast.ClassDef):
+                cm = fm.classes.get(p.name)
+
+            def resolve(expr, _cm=cm):
+                return _resolve_lock(expr, _cm, fm, unique_owner, class_map)
+
+            def visit(kind, node, lid, held, _fn=fn):
+                if kind == "acquire":
+                    if lid in worker_locks:
+                        out.append(Finding(
+                            self.id, fc.rel, node.lineno,
+                            f"async {_fn.name}() acquires {lid}, also held "
+                            f"by a worker thread in this file — the loop "
+                            f"stalls behind the worker's critical section",
+                        ))
+                    return
+                if isinstance(node, ast.Call):
+                    label = _blocking_label(node)
+                    if label is not None:
+                        out.append(Finding(
+                            self.id, fc.rel, node.lineno,
+                            f"async {_fn.name}(): {label}",
+                        ))
+
+            # Nested async defs are walked on their own; skip them here by
+            # scanning only this function's direct body (the scanner
+            # already refuses to descend into any nested def).
+            _scan_function(fn, [], resolve, visit)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# rule: unjoined-thread
+# ---------------------------------------------------------------------------
+
+
+@register
+class UnjoinedThread(Rule):
+    id = "unjoined-thread"
+    invariant = (
+        "every spawned Thread/Timer is daemonized or joined/cancelled on "
+        "a close path"
+    )
+    scope = ("kakveda_tpu", "scripts", "bench.py", "__graft_entry__.py")
+
+    def visit_file(self, fc: FileContext, ctx: TreeContext) -> List[Finding]:
+        if fc.tree is None:
+            return []
+        parents = _parent_map(fc.tree)
+        out: List[Finding] = []
+        for node in ast.walk(fc.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            fname = f.attr if isinstance(f, ast.Attribute) else (
+                f.id if isinstance(f, ast.Name) else None)
+            if fname not in ("Thread", "Timer"):
+                continue
+            if isinstance(f, ast.Attribute) and not (
+                    isinstance(f.value, ast.Name) and f.value.id == "threading"):
+                continue
+            if any(kw.arg == "daemon" and isinstance(kw.value, ast.Constant)
+                   and kw.value.value is True for kw in node.keywords):
+                continue
+            if self._retired_later(node, parents):
+                continue
+            out.append(Finding(
+                self.id, fc.rel, node.lineno,
+                f"threading.{fname} spawned without daemon=True and never "
+                f"joined/cancelled — leaks past close/shutdown",
+            ))
+        return out
+
+    @staticmethod
+    def _retired_later(call: ast.Call, parents) -> bool:
+        """Is the constructed thread/timer bound to a name that later gets
+        ``.daemon = True`` or a ``.join()``/``.cancel()`` call?"""
+        parent = parents.get(call)
+        target: Optional[ast.AST] = None
+        if isinstance(parent, ast.Assign) and len(parent.targets) == 1:
+            target = parent.targets[0]
+        if target is None:
+            return False
+        # Search space: the enclosing class for self.X bindings (close
+        # paths live on other methods), else the enclosing function/module.
+        scope: Optional[ast.AST] = parents.get(call)
+        want_cls = _self_attr(target) is not None
+        while scope is not None:
+            if want_cls and isinstance(scope, ast.ClassDef):
+                break
+            if not want_cls and isinstance(
+                    scope, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Module)):
+                break
+            scope = parents.get(scope)
+        if scope is None:
+            return False
+
+        def same(a: ast.AST) -> bool:
+            if isinstance(target, ast.Name):
+                return isinstance(a, ast.Name) and a.id == target.id
+            return _self_attr(a) is not None and _self_attr(a) == _self_attr(target)
+
+        for n in ast.walk(scope):
+            if isinstance(n, ast.Assign):
+                for t in n.targets:
+                    if (isinstance(t, ast.Attribute) and t.attr == "daemon"
+                            and same(t.value)
+                            and isinstance(n.value, ast.Constant)
+                            and n.value.value is True):
+                        return True
+            elif (isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute)
+                  and n.func.attr in ("join", "cancel") and same(n.func.value)):
+                return True
+        return False

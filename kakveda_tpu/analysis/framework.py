@@ -40,6 +40,12 @@ from kakveda_tpu.analysis import discovery
 
 PRAGMA_RE = re.compile(r"#\s*kakveda:\s*allow\[([A-Za-z0-9_,\- ]+)\]")
 
+# Ownership annotation for the concurrency pass: a field mutated without a
+# lock because exactly one context writes it BY DESIGN documents that
+# discipline with ``# kakveda: owned-by[<context>]`` on the mutation (or
+# its __init__ declaration). Same line-or-line-above placement as allow[].
+OWNED_RE = re.compile(r"#\s*kakveda:\s*owned-by\[([A-Za-z0-9_:.,\- ]+)\]")
+
 # Default baseline location, repo-relative (committed; grandfathered keys).
 BASELINE_REL = "kakveda_tpu/analysis/baseline.json"
 
@@ -88,10 +94,15 @@ class FileContext:
             self.parse_error = e
         # lineno -> rule ids allowed on that line (or the line below it).
         self.allows: Dict[int, set] = {}
+        # lineno -> owned-by[<context>] annotation (concurrency pass).
+        self.owned: Dict[int, str] = {}
         for i, ln in enumerate(self.lines, 1):
             m = PRAGMA_RE.search(ln)
             if m:
                 self.allows[i] = {r.strip() for r in m.group(1).split(",") if r.strip()}
+            m = OWNED_RE.search(ln)
+            if m:
+                self.owned[i] = m.group(1).strip()
 
     def find_line(self, needle: str) -> int:
         """First 1-based line containing ``needle`` (1 when absent) — for
@@ -103,14 +114,23 @@ class FileContext:
 
 
 class TreeContext:
-    """The whole scanned tree, parsed once and shared by every rule."""
+    """The whole scanned tree, parsed once and shared by every rule.
 
-    def __init__(self, root: Path):
+    ``files`` restricts the scan to an explicit path list (the
+    ``--changed`` pre-commit mode) — tree rules that need the full corpus
+    are skipped by the runner in that mode, never fed a partial tree."""
+
+    def __init__(self, root: Path, files: Optional[Sequence[Path]] = None):
         self.root = Path(root)
+        if files is None:
+            paths = list(discovery.code_files(self.root))
+        else:
+            paths = [Path(p) for p in files if Path(p).is_file()]
         self.files: List[FileContext] = [
-            FileContext(self.root, p) for p in discovery.code_files(self.root)
+            FileContext(self.root, p) for p in paths
         ]
         self.by_rel: Dict[str, FileContext] = {fc.rel: fc for fc in self.files}
+        self.partial = files is not None
 
 
 class Rule:
@@ -149,6 +169,7 @@ def register(cls):
 
 def all_rules() -> Dict[str, Rule]:
     """The registry, loading the project rules on first use."""
+    from kakveda_tpu.analysis import concurrency as _concurrency  # noqa: F401
     from kakveda_tpu.analysis import rules as _rules  # noqa: F401  (registers)
 
     return dict(sorted(_REGISTRY.items()))
@@ -186,15 +207,21 @@ def run_lint(
     root,
     rule_ids: Optional[Iterable[str]] = None,
     baseline_path: Optional[Path] = None,
+    files: Optional[Sequence[Path]] = None,
 ) -> LintResult:
     """Run the (selected) rules over ``root``; partition findings into
-    live / suppressed / baselined. Raises KeyError on an unknown rule id."""
+    live / suppressed / baselined. Raises KeyError on an unknown rule id.
+    With ``files``, scan only those paths and run only per-file rules —
+    whole-tree rules would misfire on a partial corpus (dead-knob checks
+    would see every knob as dead); the full-tree run stays the gate."""
     registry = all_rules()
     if rule_ids:
         rules = [registry[r] for r in rule_ids]  # KeyError = caller's usage error
     else:
         rules = list(registry.values())
-    ctx = TreeContext(Path(root))
+    ctx = TreeContext(Path(root), files=files)
+    if ctx.partial:
+        rules = [r for r in rules if r.scope is not None]
 
     raw: List[Finding] = []
     for fc in ctx.files:

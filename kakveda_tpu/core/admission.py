@@ -59,6 +59,7 @@ from typing import Dict, Optional, Tuple
 
 from kakveda_tpu.core import faults as _faults
 from kakveda_tpu.core import metrics as _metrics
+from kakveda_tpu.core import sanitize
 
 log = logging.getLogger("kakveda.admission")
 
@@ -167,7 +168,7 @@ class BrownoutController:
             if token_cap is None else token_cap
         )
         self.recorder = recorder
-        self._lock = threading.RLock()
+        self._lock = sanitize.named_lock("BrownoutController._lock", kind="rlock")
         self._step = 0
         self._entered_at = time.monotonic()
         # Time-in-state accounting (bench occupancy + postmortems).
@@ -312,7 +313,7 @@ class AdmissionController:
         self.brownout = brownout if brownout is not None else BrownoutController(
             recorder=self.recorder
         )
-        self._lock = threading.Lock()
+        self._lock = sanitize.named_lock("AdmissionController._lock")
         self._inflight: Dict[str, int] = {k: 0 for k in CLASSES}
         # Fleet pressure floor (gossip input, fleet/gossip.py): the max
         # live PEER occupancy with an expiry — while fresh, pressure() is
@@ -634,7 +635,7 @@ class DeviceHealth:
         )
         self._probe_fn = probe_fn or self._default_probe
         self._degraded = threading.Event()
-        self._lock = threading.Lock()
+        self._lock = sanitize.named_lock("DeviceHealth._lock")
         self._probe_thread: Optional[threading.Thread] = None
         self._since: Optional[float] = None
         self._reason = ""
@@ -778,7 +779,7 @@ class DeviceHealth:
 
 # --- process-global instances ----------------------------------------------
 
-_GLOBAL_LOCK = threading.Lock()
+_GLOBAL_LOCK = sanitize.named_lock("admission._GLOBAL_LOCK")
 _ADMISSION: Optional[AdmissionController] = None
 _DEVICE_HEALTH: Optional[DeviceHealth] = None
 

@@ -48,6 +48,7 @@ import random
 import threading
 import time
 from typing import Dict, Optional
+from kakveda_tpu.core import sanitize
 
 log = logging.getLogger("kakveda.faults")
 
@@ -98,7 +99,7 @@ class FaultSite:
         )
 
 
-_lock = threading.Lock()
+_lock = sanitize.named_lock("faults._lock")
 _sites: Dict[str, FaultSite] = {}
 _rng = random.Random(0)
 _m_injected = None  # resolved lazily: metrics must stay import-cycle-free

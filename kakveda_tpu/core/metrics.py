@@ -44,6 +44,7 @@ import time
 import weakref
 from bisect import bisect_left
 from typing import Dict, Iterable, List, Optional, Tuple
+from kakveda_tpu.core import sanitize
 
 __all__ = [
     "Counter",
@@ -523,7 +524,7 @@ class FlightRecorder:
             capacity = int(os.environ.get("KAKVEDA_METRICS_RECORDER", "256"))
         self.name = name
         self.capacity = max(0, capacity)
-        self._lock = threading.Lock()
+        self._lock = sanitize.named_lock("FlightRecorder._lock")
         self._events: List[dict] = []
         _RECORDERS.add(self)
 

@@ -14,12 +14,13 @@ import os
 import threading
 import time
 from typing import Dict, Optional
+from kakveda_tpu.core import sanitize
 
 
 class RevocationStore:
     def __init__(self, redis_url: Optional[str] = None):
         self._mem: Dict[str, float] = {}  # jti -> expiry ts
-        self._lock = threading.Lock()
+        self._lock = sanitize.named_lock("RevocationStore._lock")
         self._redis = None
         url = redis_url or os.environ.get("KAKVEDA_REDIS_URL")
         if url:

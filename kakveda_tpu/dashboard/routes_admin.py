@@ -201,8 +201,16 @@ def setup(app: web.Application) -> None:
                 continue
             cleaned = pat.model_copy(update={"affected_apps": apps})
             kept_lines.append(cleaned.model_dump_json())
-        plat.gfkb.patterns_path.write_text(
-            "\n".join(kept_lines) + ("\n" if kept_lines else ""), encoding="utf-8"
+        # Log rewrite + full GFKB replay are seconds of disk/CPU at scale —
+        # off the event loop, or every dashboard request stalls behind the
+        # purge (event-loop-blocking rule).
+        import asyncio
+
+        loop = asyncio.get_running_loop()
+        rewritten = "\n".join(kept_lines) + ("\n" if kept_lines else "")
+        await loop.run_in_executor(
+            None,
+            lambda: plat.gfkb.patterns_path.write_text(rewritten, encoding="utf-8"),
         )
         for app_id in demo_apps:
             ctx.db.execute("DELETE FROM trace_runs WHERE app_id=?", (app_id,))
@@ -210,7 +218,7 @@ def setup(app: web.Application) -> None:
             ctx.db.execute("DELETE FROM scenario_runs WHERE app_id=?", (app_id,))
         # The device index and host metadata were built from the pre-purge
         # log — replay the rewritten files so queries and id minting agree.
-        plat.gfkb.reload()
+        await loop.run_in_executor(None, plat.gfkb.reload)
         ctx.db.audit(request["user"].email, "admin.purge_demo", {"apps": sorted(demo_apps)})
         from urllib.parse import quote
 

@@ -183,7 +183,12 @@ def setup(app: web.Application) -> None:
             raise web.HTTPNotFound(text=f"failure {fid} not found")
         history = []
         if plat.gfkb.failures_path.exists():
-            for line in plat.gfkb.failures_path.read_text(encoding="utf-8").splitlines():
+            # The failures log grows unbounded — read it off the event loop
+            # so a big GFKB doesn't stall every other request.
+            raw = await asyncio.get_running_loop().run_in_executor(
+                None, lambda: plat.gfkb.failures_path.read_text(encoding="utf-8")
+            )
+            for line in raw.splitlines():
                 if not line.strip():
                     continue
                 row = json.loads(line)

@@ -52,6 +52,7 @@ from typing import Any, Awaitable, Callable, Collection, Dict, List, Optional, U
 
 from kakveda_tpu.core import faults as _faults
 from kakveda_tpu.core import metrics as _metrics
+from kakveda_tpu.core import sanitize
 
 log = logging.getLogger("kakveda.events")
 
@@ -102,7 +103,7 @@ class EventBus:
             self._dlq_path = self._persist_path.parent / "dlq.jsonl"
         else:
             self._dlq_path = None
-        self._dlq_lock = threading.Lock()
+        self._dlq_lock = sanitize.named_lock("EventBus._dlq_lock")
         # At-least-once knobs, read once at construction.
         self._retries = max(1, int(os.environ.get("KAKVEDA_BUS_RETRIES", "3")))
         self._retry_base = float(os.environ.get("KAKVEDA_BUS_RETRY_BASE", "0.05"))
@@ -120,12 +121,16 @@ class EventBus:
         # docs/robustness.md). 0 = off: `dlq replay` stays manual.
         self._dlq_auto_s = float(os.environ.get("KAKVEDA_DLQ_AUTO_S", "0"))
         self._dlq_auto_pending = False  # guarded by _breaker_lock (coalesce)
+        # Pending auto-replay timer + shutdown latch (guarded by
+        # _breaker_lock): close() cancels the timer and stops re-arming.
+        self._dlq_auto_timer: Optional[threading.Timer] = None
+        self._closed = False
         # Per-URL breaker state: {"state": closed|open|half_open,
         # "fails": consecutive failed events, "opened_at": monotonic ts}.
         # A threading lock, not asyncio: publish_sync spins private loops,
         # so two event loops can touch this dict from different threads.
         self._breakers: Dict[str, dict] = {}
-        self._breaker_lock = threading.Lock()
+        self._breaker_lock = sanitize.named_lock("EventBus._breaker_lock")
         # Ephemeral topics (fleet gossip): single-attempt URL delivery, no
         # dead-lettering — each event is superseded by the next tick, so
         # retrying or replaying a stale one is pure waste. The breaker
@@ -368,17 +373,36 @@ class EventBus:
         whole DLQ anyway."""
         if self._dlq_auto_s <= 0 or self._dlq_path is None:
             return
-        if self._dlq_auto_pending:
+        if self._dlq_auto_pending or self._closed:
             return
         self._dlq_auto_pending = True
         self._m_dlq_auto.labels(result="scheduled").inc()
         timer = threading.Timer(self._dlq_auto_s, self._run_dlq_auto)
         timer.daemon = True
         timer.start()
+        # Retain the handle so close() can cancel a pending replay instead
+        # of letting it fire against a torn-down platform (unjoined-thread
+        # lifecycle: daemonized AND cancelled on the close path).
+        self._dlq_auto_timer = timer
+
+    def close(self) -> None:
+        """Shut down the bus's background work: cancel a pending DLQ
+        auto-replay timer and stop new ones from arming. Idempotent; the
+        bus stays usable for synchronous delivery afterwards (teardown
+        ordering elsewhere may still publish a final event)."""
+        with self._breaker_lock:
+            self._closed = True
+            timer, self._dlq_auto_timer = self._dlq_auto_timer, None
+            self._dlq_auto_pending = False
+        if timer is not None:
+            timer.cancel()
 
     def _run_dlq_auto(self) -> None:
         with self._breaker_lock:
+            if self._closed:
+                return
             self._dlq_auto_pending = False
+            self._dlq_auto_timer = None
         try:
             out = self.replay_dlq()
         except Exception as e:  # noqa: BLE001 — auto-replay must never kill the timer path

@@ -36,6 +36,7 @@ from typing import Dict, Optional
 
 from kakveda_tpu.core import metrics as _metrics
 from kakveda_tpu.events.bus import TOPIC_FLEET_CONTROL, EventBus
+from kakveda_tpu.core import sanitize
 
 log = logging.getLogger("kakveda.fleet")
 
@@ -48,7 +49,7 @@ class FleetView:
 
     def __init__(self, ttl_s: float = 5.0):
         self.ttl_s = float(ttl_s)
-        self._lock = threading.Lock()
+        self._lock = sanitize.named_lock("FleetView._lock")
         # replica id -> (sample dict, folded-at monotonic ts)
         self._samples: Dict[str, tuple] = {}
         reg = _metrics.get_registry()

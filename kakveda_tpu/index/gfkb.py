@@ -58,6 +58,7 @@ from kakveda_tpu.index.tiers import TierConfig, TieredIndex
 from kakveda_tpu.ops.featurizer import HashedNGramFeaturizer, dense_rows_to_sparse
 from kakveda_tpu.ops.knn import ShardedKnn, batch_bucket
 from kakveda_tpu.parallel.mesh import create_mesh
+from kakveda_tpu.core import sanitize
 
 
 class SnapshotError(RuntimeError):
@@ -146,7 +147,7 @@ class GFKB:
         # serialize cost O(N²) over a failure stream. Full-record lines from
         # older logs replay identically (union of growing prefixes).
         self._pattern_state: Dict[str, dict] = {}  # name -> mutable state
-        self._snapshot_write_lock = threading.Lock()
+        self._snapshot_write_lock = sanitize.named_lock("GFKB._snapshot_write_lock")
         # Bumped by reload(); snapshot() aborts if it changed mid-write so a
         # purge (external log rewrite + reload) can't race a snapshot into
         # resurrecting pre-purge records.
@@ -156,7 +157,7 @@ class GFKB:
         # batch (O(N²) over a failure stream).
         self._ids_by_type: Dict[str, List[str]] = {}
         self._apps_by_type: Dict[str, set] = {}
-        self._lock = threading.Lock()
+        self._lock = sanitize.named_lock("GFKB._lock")
         # Upserts append records under the lock but embed AFTER releasing it
         # (_embed_new_slots). Consumers of (records, embeddings) pairs —
         # snapshot(), records_and_embeddings() — must not observe appended
@@ -317,6 +318,12 @@ class GFKB:
 
     def close(self) -> None:
         """Flush and close the append logs (safe to call repeatedly)."""
+        with self._lock:
+            self._close_locked()
+
+    def _close_locked(self) -> None:
+        """Caller holds ``_lock`` (reload() closes mid-rebuild while
+        already inside its locked section)."""
         for log in self._logs.values():
             log.close()
         self._logs.clear()
@@ -842,8 +849,9 @@ class GFKB:
             self._generation += 1
             shutil.rmtree(self._snapshot_dir(), ignore_errors=True)
             # Reopen the append logs: an external rewrite may have replaced
-            # the files (new inode), and a held fd would append to the old one.
-            self.close()
+            # the files (new inode), and a held fd would append to the old
+            # one. _lock is already held here — close() would deadlock.
+            self._close_locked()
             self._emb, self._valid = self._knn.alloc()
             self._types = self._knn.alloc_i32()
             self._type_ids = {}
@@ -1379,7 +1387,8 @@ class GFKB:
                 type(e).__name__, e,
             )
             m.mark_stale(f"attach failed: {type(e).__name__}")
-            self._mine_pending.clear()
+            with self._lock:
+                self._mine_pending.clear()
 
     def _mine_drain_locked(self) -> int:
         """Fold every pending delta top-k result into the union-find

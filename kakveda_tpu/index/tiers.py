@@ -69,6 +69,7 @@ import numpy as np
 from kakveda_tpu import native as _native
 from kakveda_tpu.core import faults as _faults
 from kakveda_tpu.core import metrics as _metrics
+from kakveda_tpu.core import sanitize
 
 log = logging.getLogger("kakveda.tiers")
 
@@ -947,7 +948,7 @@ class TieredIndex:
                  data_dir: Optional[Path] = None):
         self.cfg = config or TierConfig()
         self.dim = dim
-        self.lock = threading.RLock()
+        self.lock = sanitize.named_lock("TieredIndex.lock", kind="rlock")
         self.scorer = NativeScorer()
         self.warm = WarmTier(dim, self.scorer)
         self._data_dir = Path(data_dir) if data_dir is not None else None

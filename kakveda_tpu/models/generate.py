@@ -34,6 +34,7 @@ from kakveda_tpu.models.llama import (
 )
 from kakveda_tpu.models.runtime import GenerateResult
 from kakveda_tpu.models.tokenizer import ByteTokenizer
+from kakveda_tpu.core import sanitize
 
 
 @partial(jax.jit, static_argnames=("cfg", "last_only"))
@@ -546,7 +547,7 @@ class LlamaRuntime:
         import threading
 
         self._engine = None
-        self._engine_lock = threading.Lock()
+        self._engine_lock = sanitize.named_lock("LlamaRuntime._engine_lock")
         self._retired = False
 
     @classmethod

@@ -27,6 +27,7 @@ import os
 import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, Optional, Protocol
+from kakveda_tpu.core import sanitize
 
 # The reference's exact stub text (services/dashboard/app.py:1193-1199) —
 # fake citations that trip the rule classifier deterministically.
@@ -263,8 +264,8 @@ class MultiModelRuntime:
         )
         self._loaded: Dict[str, Any] = {}  # label -> LlamaRuntime, LRU order
         self._bytes: Dict[str, int] = {}  # label -> exact weight+KV bytes
-        self._load_lock = threading.Lock()  # serializes load/evict/budget
-        self._lru_lock = threading.Lock()  # guards _loaded order mutations only
+        self._load_lock = sanitize.named_lock("MultiModelRuntime._load_lock")  # serializes load/evict/budget
+        self._lru_lock = sanitize.named_lock("MultiModelRuntime._lru_lock")  # guards _loaded order mutations only
         # HBM headroom on the metrics plane: budget is static, loaded
         # bytes move on every load/evict — headroom is the difference,
         # computed by the dashboard/alert side.

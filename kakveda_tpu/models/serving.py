@@ -72,6 +72,7 @@ from kakveda_tpu.core import admission as _admission
 from kakveda_tpu.core import faults as _faults
 from kakveda_tpu.core import metrics as _metrics
 from kakveda_tpu.core.admission import DeviceUnavailableError, OverloadError
+from kakveda_tpu.core import sanitize
 from kakveda_tpu.models.llama import (
     LlamaConfig,
     Params,
@@ -464,7 +465,7 @@ class ContinuousBatcher:
         # mutation holds ``stats_lock`` (RLock: the gate helper nests
         # inside locked sections) and readers go through
         # :meth:`stats_snapshot` / ``ServingEngine.stats()``.
-        self.stats_lock = threading.RLock()
+        self.stats_lock = sanitize.named_lock("ContinuousBatcher.stats_lock", kind="rlock")
         self.spec_stats = {
             "chunks": 0, "emitted": 0, "slot_chunks": 0,
             "drafted": 0, "accepted": 0,
@@ -539,12 +540,14 @@ class ContinuousBatcher:
         # under pipelining is the overlapped (real) per-chunk cost.
         self._spec_walls: deque = deque(maxlen=16)
         self._plain_walls: deque = deque(maxlen=16)
+        # kakveda: owned-by[serving-loop] — gate decision state, loop thread only
         self._tpv_recent: deque = deque(maxlen=32)
         self._gate_warmup = int(os.environ.get("KAKVEDA_SERVE_SPEC_WARMUP", "8"))
         self._gate_calib = int(os.environ.get("KAKVEDA_SERVE_SPEC_CALIB", "2"))
         self._gate_reprobe = int(os.environ.get("KAKVEDA_SERVE_SPEC_REPROBE", "256"))
         self._gate_prior = float(os.environ.get("KAKVEDA_SERVE_SPEC_BREAKEVEN", "1.35"))
-        self._gate_spec_chunks = 0  # spec chunks since (re)entering warmup
+        # kakveda: owned-by[serving-loop] — spec chunks since (re)entering warmup
+        self._gate_spec_chunks = 0
         self._gate_plain_since_off = 0
         self._gate_reprobes = 0
         # Pipelined speculation: the device slot_pos returned by the last
@@ -1358,7 +1361,7 @@ class ServingEngine:
         # not change behavior mid-life because the env moved), restarts
         # consumed, and the terminal-death latch (submit fails fast on it).
         self._restart_budget = int(os.environ.get("KAKVEDA_SERVE_RESTARTS", "2"))
-        self._restarts = 0
+        self._restarts = 0  # kakveda: owned-by[serving-loop] (supervisor writes)
         self._dead = threading.Event()
         # Prefixes successfully registered on the live batcher, in order —
         # the supervisor re-registers them on the rebuilt batcher so a
@@ -1413,10 +1416,13 @@ class ServingEngine:
         # deadline_abs_or_None, fut); control items: ("cancel"|"prefix", …, fut).
         self._q: "queue.Queue[tuple]" = queue.Queue()
         self._closed = threading.Event()
-        self._submit_lock = threading.Lock()  # closes the submit/close race
+        self._submit_lock = sanitize.named_lock("ServingEngine._submit_lock")  # closes the submit/close race
+        # submit inserts pre-handoff under _submit_lock (the close race);
+        # kakveda: owned-by[serving-loop] — the loop owns every later mutation.
         self._pend: Dict[int, Future] = {}  # loop-owned; close() fails leftovers
         self._waiting: List = []  # loop-owned: admitted-when-a-slot-frees queue
-        self._track: Dict[int, dict] = {}  # loop-owned per-request timeline state
+        # kakveda: owned-by[serving-loop] — per-request timeline state
+        self._track: Dict[int, dict] = {}
         self._stats = {"submitted": 0, "completed": 0, "max_active": 0, "chunks": 0}
         self._thread = threading.Thread(target=self._loop, daemon=True, name="serving-engine")
         self._thread.start()

@@ -52,6 +52,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from kakveda_tpu.ops.clustering import _BLOCK, _block_topk, _sparse_components
+from kakveda_tpu.core import sanitize
 
 __all__ = [
     "ClusterState",
@@ -203,7 +204,7 @@ class ClusterState:
     def __init__(self, threshold: float = 0.6, k: int = 32):
         self.threshold = float(threshold)
         self.k = int(k)
-        self._lock = threading.RLock()
+        self._lock = sanitize.named_lock("ClusterState._lock", kind="rlock")
         self._n = 0
         self._ids = np.full((0, self.k), -1, np.int64)
         self._sims = np.full((0, self.k), -np.inf, np.float32)

@@ -26,6 +26,7 @@ from typing import Deque, Dict, List, Optional
 
 from kakveda_tpu.core.config import ConfigStore
 from kakveda_tpu.core.schemas import FailureSignal, HealthPoint, utcnow
+from kakveda_tpu.core import sanitize
 
 WINDOW = 50
 EXECUTIONS_PER_WINDOW = 10.0
@@ -72,7 +73,7 @@ class HealthScorer:
             self.data_dir.mkdir(parents=True, exist_ok=True)
         self.health_path = self.data_dir / "health.jsonl"
         self._windows: Dict[str, _AppWindow] = defaultdict(_AppWindow)
-        self._lock = threading.Lock()
+        self._lock = sanitize.named_lock("HealthScorer._lock")
 
     def _append_all(self, points: List[HealthPoint]) -> None:
         if not self.persist or not points:

@@ -35,6 +35,7 @@ from pydantic import ValidationError
 from kakveda_tpu.core import admission as _admission
 from kakveda_tpu.core import faults as _faults
 from kakveda_tpu.core.admission import DeviceUnavailableError, OverloadError
+from kakveda_tpu.core import sanitize
 from kakveda_tpu.core.runtime import ensure_request_id, get_runtime_config
 from kakveda_tpu.core.schemas import (
     FailureMatchRequest,
@@ -60,6 +61,7 @@ def _native_status() -> dict:
 PLATFORM_KEY: web.AppKey[Platform] = web.AppKey("platform", Platform)
 WARN_BATCHER_KEY: web.AppKey[MicroBatcher] = web.AppKey("warn_batcher", MicroBatcher)
 _GOSSIP_TASK_KEY: web.AppKey[object] = web.AppKey("fleet_gossip_task", object)
+_STALL_WATCHDOG_KEY: web.AppKey[object] = web.AppKey("sanitize_stall_watchdog", object)
 
 # Chaos site for the HTTP tier, resolved once at import: an armed
 # service.handler fault turns a request into a clean 500 before its
@@ -304,8 +306,19 @@ def make_app(
             app[_GOSSIP_TASK_KEY] = _asyncio.get_running_loop().create_task(
                 gossip.run()
             )
+        if sanitize.enabled():
+            # Loop-stall watchdog: the runtime half of the static
+            # event-loop-blocking rule. Stalls past
+            # KAKVEDA_SANITIZE_STALL_MS dump the loop thread's stack to
+            # the sanitizer flight recorder (docs/robustness.md).
+            wd = sanitize.LoopStallWatchdog()
+            await wd.start()
+            app[_STALL_WATCHDOG_KEY] = wd
 
     async def _on_cleanup(app):
+        wd = app.get(_STALL_WATCHDOG_KEY)
+        if wd is not None:
+            await wd.stop()
         t = app.get(_GOSSIP_TASK_KEY)
         if t is not None:
             import asyncio as _asyncio
@@ -316,6 +329,7 @@ def make_app(
             except _asyncio.CancelledError:
                 pass
         await warn_batcher.stop()
+        plat.bus.close()  # cancel a pending DLQ auto-replay timer
 
     app.on_startup.append(_on_startup)
     app.on_cleanup.append(_on_cleanup)

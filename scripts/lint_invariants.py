@@ -12,6 +12,13 @@ Usage::
 
     python scripts/lint_invariants.py [root] [--json] [--rule ID ...]
                                       [--list-rules] [--update-baseline]
+                                      [--changed]
+
+``--changed`` scans only the files git reports as modified/staged/
+untracked (filtered to the lint's code tree) — a sub-100 ms pre-commit
+loop. Whole-tree rules (knob docs, forward-flag parity, lock-order …)
+need the full corpus and are skipped in that mode: the full-tree run
+stays the tier-1 gate.
 
 Exit codes (stable; tier-1 asserts them via tests/test_lint_invariants.py):
 0 = clean (suppressed/baselined findings allowed), 1 = live findings,
@@ -36,11 +43,41 @@ _REPO = Path(__file__).resolve().parents[1]
 if str(_REPO) not in sys.path:
     sys.path.insert(0, str(_REPO))
 
+from kakveda_tpu.analysis import discovery  # noqa: E402
 from kakveda_tpu.analysis.framework import (  # noqa: E402
     BASELINE_REL,
     all_rules,
     run_lint,
 )
+
+
+def _changed_files(root: Path) -> list:
+    """Modified + staged + untracked .py files inside the lint's code
+    tree, as absolute paths. Empty list = nothing relevant changed."""
+    import subprocess
+
+    out = subprocess.run(
+        ["git", "-C", str(root), "status", "--porcelain", "--untracked-files=all"],
+        capture_output=True, text=True, timeout=10, check=True,
+    ).stdout
+    rels = set()
+    for line in out.splitlines():
+        if len(line) < 4:
+            continue
+        path = line[3:].strip()
+        if " -> " in path:  # rename: scan the new side
+            path = path.split(" -> ", 1)[1]
+        path = path.strip('"')
+        if path.endswith(".py"):
+            rels.add(path)
+    picked = []
+    for rel in sorted(rels):
+        p = root / rel
+        if not p.is_file() or discovery._skipped(root, p):
+            continue
+        if any(rel == c or rel.startswith(c + "/") for c in discovery.CODE_PATHS):
+            picked.append(p)
+    return picked
 
 
 def main(argv: list) -> int:
@@ -56,6 +93,10 @@ def main(argv: list) -> int:
         "--update-baseline", action="store_true",
         help="rewrite the baseline to grandfather current findings",
     )
+    ap.add_argument(
+        "--changed", action="store_true",
+        help="scan only git-modified files; per-file rules only (pre-commit)",
+    )
     try:
         args = ap.parse_args(argv[1:])
     except SystemExit as e:
@@ -70,8 +111,23 @@ def main(argv: list) -> int:
     if not root.is_dir():
         print(f"lint_invariants: not a directory: {root}", file=sys.stderr)
         return 2
+    files = None
+    if args.changed:
+        if args.update_baseline:
+            print("lint_invariants: --changed and --update-baseline are "
+                  "incompatible (baseline needs the full tree)", file=sys.stderr)
+            return 2
+        try:
+            files = _changed_files(root)
+        except Exception as e:  # noqa: BLE001 — not-a-git-checkout etc.
+            print(f"lint_invariants: --changed needs git: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+            return 2
+        if not files:
+            print("lint_invariants: ok — no changed code files")
+            return 0
     try:
-        res = run_lint(root, rule_ids=args.rule)
+        res = run_lint(root, rule_ids=args.rule, files=files)
     except KeyError as e:
         print(f"lint_invariants: unknown rule {e.args[0]!r} "
               "(see --list-rules)", file=sys.stderr)

@@ -1,0 +1,469 @@
+"""The concurrency pass (kakveda_tpu/analysis/concurrency.py,
+docs/static-analysis.md): four rules — lockset-race, lock-order,
+event-loop-blocking, unjoined-thread — each proven against a known-bad
+fixture AND its known-good twin, plus real-tree mutation tests (delete a
+live guard / wrapper from a shipped file, the rule must fire) so the
+rules demonstrably cover the code they were written for.
+
+No jax: the analysis package is pure stdlib AST.
+"""
+
+import subprocess
+import sys
+import textwrap
+import time
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parents[1]
+if str(ROOT) not in sys.path:
+    sys.path.insert(0, str(ROOT))
+
+from kakveda_tpu.analysis.framework import run_lint  # noqa: E402
+
+CONCURRENCY_RULES = ("lockset-race", "lock-order", "event-loop-blocking",
+                     "unjoined-thread")
+
+
+def _tree(tmp_path: Path, files: dict) -> Path:
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return tmp_path
+
+
+def _findings(root: Path, rule: str):
+    return run_lint(root, rule_ids=[rule]).findings
+
+
+# ---------------------------------------------------------------------------
+# the tree itself
+# ---------------------------------------------------------------------------
+
+
+def test_tree_is_clean_under_concurrency_rules():
+    """The shipped tree passes all four rules with zero live findings —
+    the PR that introduced them triaged and fixed what they found — and
+    the pass stays inside its wall budget."""
+    t0 = time.perf_counter()
+    res = run_lint(ROOT, rule_ids=list(CONCURRENCY_RULES))
+    wall = time.perf_counter() - t0
+    assert res.findings == [], "\n".join(f.human() for f in res.findings)
+    assert wall < 5.0, f"concurrency pass took {wall:.1f}s — budget is 5s"
+
+
+def test_runtime_lock_names_match_static_graph_nodes():
+    """Every sanitize.named_lock("…") literal in the tree IS a node the
+    static analyzer can produce (ClassName._attr / module._name) — the
+    equality the runtime/static cross-check rides on."""
+    import re
+
+    from kakveda_tpu.analysis import discovery
+
+    names = set()
+    for p in discovery.code_files(ROOT):
+        if p.name in ("sanitize.py", "concurrency.py"):
+            continue  # define/document named_lock; docstrings show "…" usage
+        for m in re.finditer(r'named_lock\(\s*"([^"]+)"', p.read_text()):
+            names.add(m.group(1))
+    assert names, "the tree constructs its locks through named_lock"
+    for n in names:
+        assert re.fullmatch(r"[A-Za-z_][A-Za-z0-9_]*\.[A-Za-z_][A-Za-z0-9_]*", n), n
+
+
+# ---------------------------------------------------------------------------
+# lockset-race
+# ---------------------------------------------------------------------------
+
+_RACY = """
+    import threading
+
+    class Box:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._items = []
+
+        def put(self, x):
+            with self._lock:
+                self._items.append(x)
+
+        def drop(self):
+            self._items.clear()
+"""
+
+
+def test_lockset_race_flags_unguarded_mutation(tmp_path):
+    root = _tree(tmp_path, {"kakveda_tpu/box.py": _RACY})
+    fs = _findings(root, "lockset-race")
+    assert len(fs) == 1 and "Box._items" in fs[0].message, fs
+
+
+def test_lockset_race_good_twin_passes(tmp_path):
+    root = _tree(tmp_path, {"kakveda_tpu/box.py": _RACY.replace(
+        "            self._items.clear()",
+        "            with self._lock:\n                self._items.clear()",
+    )})
+    assert _findings(root, "lockset-race") == []
+
+
+def test_lockset_race_owned_by_annotation_suppresses(tmp_path):
+    """owned-by[<context>] on the __init__ declaration documents a
+    single-writer field — the rule stands down (an annotation, not a
+    silent suppression: greps for owned-by find it)."""
+    root = _tree(tmp_path, {"kakveda_tpu/box.py": _RACY.replace(
+        "            self._items = []",
+        "            # kakveda: owned-by[caller] — single-writer by design\n"
+        "            self._items = []",
+    )})
+    assert _findings(root, "lockset-race") == []
+
+
+def test_lockset_race_caller_held_guard_propagates(tmp_path):
+    """A private helper mutating state is guarded by its CALL SITE's
+    ``with`` — the gfkb reload()/_replay() shape must not be flagged."""
+    root = _tree(tmp_path, {"kakveda_tpu/kb.py": """
+        import threading
+
+        class KB:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._rows = []
+
+            def reload(self):
+                with self._lock:
+                    self._replay()
+
+            def add(self, r):
+                with self._lock:
+                    self._rows.append(r)
+
+            def _replay(self):
+                self._rows.clear()
+    """})
+    assert _findings(root, "lockset-race") == []
+
+
+def test_lockset_race_multi_context_unguarded(tmp_path):
+    """A field mutated from BOTH a spawned thread and the caller's thread
+    with no lock anywhere is variant (b): multiple contexts, no common
+    guard."""
+    root = _tree(tmp_path, {"kakveda_tpu/w.py": """
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._guarded = []
+                self._out = []
+
+            def start(self):
+                t = threading.Thread(target=self._run, daemon=True)
+                t.start()
+
+            def _run(self):
+                self._out.append(1)
+
+            def push(self, x):
+                self._out.append(x)
+
+            def note(self, x):
+                with self._lock:
+                    self._guarded.append(x)
+    """})
+    fs = _findings(root, "lockset-race")
+    assert len(fs) == 1 and "Worker._out" in fs[0].message, fs
+    assert "multiple contexts" in fs[0].message
+
+
+def test_lockset_race_real_tree_mutation_gossip():
+    """Delete the ``with self._lock`` guards from the shipped
+    fleet/gossip.py FleetView — the rule must fire on the now-unguarded
+    mutations (proof the rule covers the real file, not just fixtures)."""
+    import re
+
+    src = (ROOT / "kakveda_tpu/fleet/gossip.py").read_text()
+    lines = src.splitlines(keepends=True)
+    out, i, dropped = [], 0, 0
+    while i < len(lines):
+        ln = lines[i]
+        m = re.match(r"^(\s*)with self\._lock:\s*$", ln)
+        if m:
+            # Drop the with-line, dedent its body by 4.
+            indent = len(m.group(1))
+            i += 1
+            while i < len(lines):
+                body = lines[i]
+                if body.strip() and (len(body) - len(body.lstrip())) <= indent:
+                    break
+                out.append(body[4:] if body.startswith(" " * (indent + 4))
+                           else body)
+                i += 1
+            dropped += 1
+            continue
+        out.append(ln)
+        i += 1
+    assert dropped >= 1, "gossip.py no longer guards with self._lock?"
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        root = Path(td)
+        (root / "kakveda_tpu/fleet").mkdir(parents=True)
+        (root / "kakveda_tpu/fleet/gossip.py").write_text("".join(out))
+        fs = _findings(root, "lockset-race")
+    assert any("FleetView" in f.message for f in fs), fs
+
+
+# ---------------------------------------------------------------------------
+# lock-order
+# ---------------------------------------------------------------------------
+
+_INVERTED = """
+    import threading
+
+    class Runtime:
+        def __init__(self):
+            self._load_lock = threading.Lock()
+            self._lru_lock = threading.Lock()
+
+        def load(self):
+            with self._load_lock:
+                with self._lru_lock:
+                    pass
+
+        def evict(self):
+            with self._lru_lock:
+                with self._load_lock:
+                    pass
+"""
+
+
+def test_lock_order_flags_inverted_nesting(tmp_path):
+    """The inverted MultiModelRuntime-style nesting (load: A->B,
+    evict: B->A) is a deadlock-in-waiting — exactly one cycle finding."""
+    root = _tree(tmp_path, {"kakveda_tpu/rt.py": _INVERTED})
+    fs = _findings(root, "lock-order")
+    assert len(fs) == 1, fs
+    assert "lock-order cycle" in fs[0].message
+    assert "Runtime._load_lock" in fs[0].message
+    assert "Runtime._lru_lock" in fs[0].message
+
+
+def test_lock_order_consistent_nesting_passes(tmp_path):
+    root = _tree(tmp_path, {"kakveda_tpu/rt.py": _INVERTED.replace(
+        """        def evict(self):
+            with self._lru_lock:
+                with self._load_lock:
+                    pass""",
+        """        def evict(self):
+            with self._load_lock:
+                with self._lru_lock:
+                    pass""",
+    )})
+    assert _findings(root, "lock-order") == []
+
+
+def test_lock_order_sees_transitive_acquisition(tmp_path):
+    """A cycle THROUGH a method call (hold A, call something that takes
+    B; elsewhere hold B then take A) is still a cycle — lexical nesting
+    alone would miss it."""
+    root = _tree(tmp_path, {"kakveda_tpu/tr.py": """
+        import threading
+
+        class T:
+            def __init__(self):
+                self._a_lock = threading.Lock()
+                self._b_lock = threading.Lock()
+
+            def fwd(self):
+                with self._a_lock:
+                    self._take_b()
+
+            def _take_b(self):
+                with self._b_lock:
+                    pass
+
+            def rev(self):
+                with self._b_lock:
+                    with self._a_lock:
+                        pass
+    """})
+    fs = _findings(root, "lock-order")
+    assert len(fs) == 1 and "lock-order cycle" in fs[0].message, fs
+
+
+def test_static_lock_graph_has_real_edges_and_no_cycles():
+    """The shipped tree's graph contains the known-good
+    MultiModelRuntime._load_lock -> _lru_lock edge and stays acyclic."""
+    from kakveda_tpu.analysis.concurrency import static_lock_graph
+    from kakveda_tpu.core.sanitize import find_cycles
+
+    edges = static_lock_graph(ROOT)
+    assert ("MultiModelRuntime._load_lock", "MultiModelRuntime._lru_lock") in edges
+    assert find_cycles(edges) == []
+
+
+# ---------------------------------------------------------------------------
+# event-loop-blocking
+# ---------------------------------------------------------------------------
+
+
+def test_event_loop_blocking_flags_sync_calls(tmp_path):
+    root = _tree(tmp_path, {"kakveda_tpu/service/h.py": """
+        import time
+
+        async def handler(request):
+            time.sleep(0.1)
+            data = request.path.read_text(encoding="utf-8")
+            return data
+    """})
+    fs = _findings(root, "event-loop-blocking")
+    msgs = "\n".join(f.message for f in fs)
+    assert len(fs) == 2, fs
+    assert "time.sleep" in msgs and "read_text" in msgs
+
+
+def test_event_loop_blocking_executor_thunk_exempt(tmp_path):
+    """The fix idiom — the blocking call inside the nested def/lambda
+    handed to run_in_executor — must NOT be flagged (nested function
+    bodies run off the loop)."""
+    root = _tree(tmp_path, {"kakveda_tpu/service/h.py": """
+        import asyncio
+
+        async def handler(request):
+            loop = asyncio.get_running_loop()
+            data = await loop.run_in_executor(
+                None, lambda: request.path.read_text(encoding="utf-8")
+            )
+            await asyncio.sleep(0.01)
+            return data
+    """})
+    assert _findings(root, "event-loop-blocking") == []
+
+
+def test_event_loop_blocking_real_tree_mutation_routes_main():
+    """Strip the run_in_executor wrap from the shipped dashboard
+    failure_detail handler (back to a bare read_text on the loop) — the
+    rule must fire on the regression."""
+    import tempfile
+
+    src = (ROOT / "kakveda_tpu/dashboard/routes_main.py").read_text()
+    wrapped = (
+        "raw = await asyncio.get_running_loop().run_in_executor(\n"
+        "                None, lambda: plat.gfkb.failures_path.read_text(encoding=\"utf-8\")\n"
+        "            )"
+    )
+    assert wrapped in src, "routes_main.py executor wrap moved — update test"
+    mutated = src.replace(
+        wrapped, 'raw = plat.gfkb.failures_path.read_text(encoding="utf-8")')
+    with tempfile.TemporaryDirectory() as td:
+        root = Path(td)
+        (root / "kakveda_tpu/dashboard").mkdir(parents=True)
+        (root / "kakveda_tpu/dashboard/routes_main.py").write_text(mutated)
+        fs = _findings(root, "event-loop-blocking")
+    assert any("read_text" in f.message for f in fs), fs
+
+
+def test_event_loop_blocking_worker_held_lock_in_async(tmp_path):
+    """`with self._lock:` inside an async body, where the same file's
+    spawned worker thread also takes that lock, parks the loop behind
+    the worker — flagged."""
+    root = _tree(tmp_path, {"kakveda_tpu/service/s.py": """
+        import threading
+
+        class Svc:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+
+            def start(self):
+                threading.Thread(target=self._work, daemon=True).start()
+
+            def _work(self):
+                with self._lock:
+                    self._n += 1
+
+            async def handle(self, request):
+                with self._lock:
+                    return self._n
+    """})
+    fs = _findings(root, "event-loop-blocking")
+    assert len(fs) == 1 and "Svc._lock" in fs[0].message, fs
+
+
+# ---------------------------------------------------------------------------
+# unjoined-thread
+# ---------------------------------------------------------------------------
+
+
+def test_unjoined_thread_flags_leak(tmp_path):
+    root = _tree(tmp_path, {"kakveda_tpu/t.py": """
+        import threading
+
+        def go():
+            t = threading.Thread(target=print)
+            t.start()
+    """})
+    fs = _findings(root, "unjoined-thread")
+    assert len(fs) == 1 and "threading.Thread" in fs[0].message, fs
+
+
+def test_unjoined_thread_good_twins_pass(tmp_path):
+    """daemon=True kwarg, later `.daemon = True`, a join() on a close
+    path, and a cancel()'d Timer handle are all retired — no findings."""
+    root = _tree(tmp_path, {"kakveda_tpu/t.py": """
+        import threading
+
+        def kwarg():
+            threading.Thread(target=print, daemon=True).start()
+
+        def attr():
+            t = threading.Thread(target=print)
+            t.daemon = True
+            t.start()
+
+        class C:
+            def start(self):
+                self._t = threading.Thread(target=print)
+                self._t.start()
+                self._timer = threading.Timer(1.0, print)
+                self._timer.start()
+
+            def close(self):
+                self._t.join()
+                self._timer.cancel()
+    """})
+    assert _findings(root, "unjoined-thread") == []
+
+
+# ---------------------------------------------------------------------------
+# --changed pre-commit mode
+# ---------------------------------------------------------------------------
+
+
+def test_changed_mode_scans_only_git_dirty_files(tmp_path):
+    """--changed lints the git-dirty subset with per-file rules only:
+    a racy untracked file fails (exit 1); tree rules (knob-docs et al.)
+    are skipped so the partial corpus can't misfire."""
+    subprocess.run(["git", "init", "-q", str(tmp_path)], check=True, timeout=10)
+    _tree(tmp_path, {"kakveda_tpu/box.py": _RACY})
+    script = ROOT / "scripts" / "lint_invariants.py"
+    r = subprocess.run(
+        [sys.executable, str(script), str(tmp_path), "--changed"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "lockset-race" in r.stdout
+    assert "knob-docs" not in r.stdout
+
+    # Fix the file -> clean exit 0; and a clean checkout (nothing dirty)
+    # short-circuits without scanning anything.
+    (tmp_path / "kakveda_tpu/box.py").write_text(textwrap.dedent(
+        _RACY.replace(
+            "            self._items.clear()",
+            "            with self._lock:\n                self._items.clear()",
+        )))
+    r = subprocess.run(
+        [sys.executable, str(script), str(tmp_path), "--changed"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr

@@ -1,0 +1,249 @@
+"""Runtime concurrency sanitizer (kakveda_tpu/core/sanitize.py,
+docs/robustness.md): named-lock edge/hold recording, cycle detection,
+the asyncio loop-stall watchdog, and the chaos-marked cross-check that
+merges the RUNTIME edge set observed under concurrent real-object
+traffic with the STATIC lock-order graph and asserts the union stays
+acyclic — the two halves of the concurrency pass agreeing on one graph.
+
+No jax imports outside the chaos test's object construction.
+"""
+
+import asyncio
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+ROOT = Path(__file__).resolve().parents[1]
+
+from kakveda_tpu.core import sanitize  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fresh_state():
+    sanitize.reset()
+    yield
+    sanitize.reset()
+
+
+# ---------------------------------------------------------------------------
+# SanitizedLock mechanics
+# ---------------------------------------------------------------------------
+
+
+def test_named_lock_plain_when_disarmed(monkeypatch):
+    monkeypatch.delenv("KAKVEDA_SANITIZE", raising=False)
+    lk = sanitize.named_lock("X._l")
+    assert not isinstance(lk, sanitize.SanitizedLock)
+    rl = sanitize.named_lock("X._r", kind="rlock")
+    rl.acquire(); rl.acquire(); rl.release(); rl.release()  # an RLock
+
+
+def test_edges_stats_and_reentrancy(monkeypatch):
+    monkeypatch.setenv("KAKVEDA_SANITIZE", "1")
+    a = sanitize.named_lock("A._x")
+    b = sanitize.named_lock("B._y", kind="rlock")
+    with a:
+        with b:
+            with b:  # reentrant: no self-edge, one hold
+                pass
+    rep = sanitize.sanitizer_report()
+    assert rep["edges"] == [["A._x", "B._y", 1]]
+    assert rep["cycles"] == []
+    assert rep["locks"]["A._x"]["acquisitions"] == 1
+    assert rep["locks"]["B._y"]["acquisitions"] == 1  # outermost only
+    assert rep["locks"]["A._x"]["hold_ms_max"] >= 0.0
+
+
+def test_contention_and_wait_accounting(monkeypatch):
+    monkeypatch.setenv("KAKVEDA_SANITIZE", "1")
+    lk = sanitize.named_lock("C._l")
+    lk.acquire()
+    t = threading.Thread(
+        target=lambda: (lk.acquire(), lk.release()), daemon=True)
+    t.start()
+    time.sleep(0.05)
+    lk.release()
+    t.join(timeout=5.0)
+    st = sanitize.sanitizer_report()["locks"]["C._l"]
+    assert st["acquisitions"] == 2
+    assert st["contended"] >= 1
+    assert st["wait_ms_total"] >= 25.0
+
+
+def test_condition_compatible(monkeypatch):
+    monkeypatch.setenv("KAKVEDA_SANITIZE", "1")
+    lk = sanitize.named_lock("D._l")
+    cv = threading.Condition(lk)
+    hits = []
+
+    def waiter():
+        with cv:
+            cv.wait(timeout=5.0)
+            hits.append(1)
+
+    t = threading.Thread(target=waiter, daemon=True)
+    t.start()
+    time.sleep(0.05)
+    with cv:
+        cv.notify_all()
+    t.join(timeout=5.0)
+    assert hits == [1]
+    assert not lk.locked()
+
+
+def test_find_cycles():
+    assert sanitize.find_cycles([("a", "b"), ("b", "c")]) == []
+    cycles = sanitize.find_cycles([("a", "b"), ("b", "a"), ("b", "c")])
+    assert cycles == [["a", "b", "a"]]
+
+
+def test_inverted_order_reports_cycle(monkeypatch):
+    monkeypatch.setenv("KAKVEDA_SANITIZE", "1")
+    a = sanitize.named_lock("E._a")
+    b = sanitize.named_lock("E._b")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    rep = sanitize.sanitizer_report()
+    assert rep["cycles"] == [["E._a", "E._b", "E._a"]]
+
+
+# ---------------------------------------------------------------------------
+# loop-stall watchdog
+# ---------------------------------------------------------------------------
+
+
+def test_watchdog_detects_loop_stall():
+    async def go():
+        wd = sanitize.LoopStallWatchdog(threshold_ms=80)
+        await wd.start()
+        try:
+            await asyncio.sleep(0.05)  # healthy heartbeat first
+            time.sleep(0.4)            # THE sin: block the loop
+            await asyncio.sleep(0.1)   # let the checker observe recovery
+        finally:
+            await wd.stop()
+        return wd.stall_count
+
+    stalls = asyncio.run(go())
+    assert stalls >= 1
+    rep = sanitize.sanitizer_report()
+    assert rep["stalls"], "stall must be recorded in the report"
+    evt = rep["stalls"][-1]
+    assert evt["stall_ms"] >= 80
+    # The captured stack is the loop thread's frames — the blocking
+    # time.sleep call above must be visible in it.
+    assert "time.sleep" in evt["stack"] or "go" in evt["stack"]
+
+
+def test_watchdog_quiet_on_healthy_loop():
+    async def go():
+        wd = sanitize.LoopStallWatchdog(threshold_ms=200)
+        await wd.start()
+        try:
+            for _ in range(10):
+                await asyncio.sleep(0.01)
+        finally:
+            await wd.stop()
+        return wd.stall_count
+
+    assert asyncio.run(go()) == 0
+    assert sanitize.sanitizer_report()["stalls"] == []
+
+
+# ---------------------------------------------------------------------------
+# chaos: runtime edges vs static graph, under real concurrent traffic
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_runtime_edges_consistent_with_static_graph(monkeypatch, tmp_path):
+    """Arm KAKVEDA_SANITIZE=1, drive the real lock-owning objects (bus
+    DLQ/breaker paths, admission + brownout ladder, fleet view, cluster
+    state) concurrently from threads, then merge the OBSERVED edge set
+    with the STATIC lock-order graph: the union must be acyclic, and no
+    runtime edge may invert a static one. This is the cross-check the
+    matching named_lock()/ClassName._attr node ids exist for."""
+    monkeypatch.setenv("KAKVEDA_SANITIZE", "1")
+
+    from kakveda_tpu.core.admission import (
+        AdmissionController,
+        BrownoutController,
+    )
+    from kakveda_tpu.events.bus import EventBus
+    from kakveda_tpu.fleet.gossip import FleetView
+    from kakveda_tpu.ops.incremental import ClusterState
+
+    adm = AdmissionController(
+        enabled=True,
+        brownout=BrownoutController(enabled=True, enter=0.8, exit=0.5,
+                                    dwell_s=0.0),
+    )
+    bus = EventBus(dlq_path=tmp_path / "dlq.jsonl")
+    view = FleetView(ttl_s=1.0)
+    cs = ClusterState(threshold=0.5, k=4)
+
+    stop = threading.Event()
+    errors = []
+    seqs = iter(range(1, 1_000_000))
+
+    def drive(fn):
+        try:
+            while not stop.is_set():
+                fn()
+        except Exception as e:  # noqa: BLE001 — surfaced below
+            errors.append(e)
+
+    def adm_path():
+        try:
+            with adm.slot("warn"):
+                pass
+        except Exception:  # noqa: BLE001 — sheds are the point of the storm
+            pass
+        adm.note_fleet_pressure(0.9, ttl_s=0.2)
+        adm.brownout.occupancy()
+
+    def bus_path():
+        bus.breaker_states()
+        bus.topics()
+
+    def view_path():
+        view.fold({"replica": "r1", "seq": next(seqs),
+                   "ts": time.time(), "occupancy": 0.5})
+        view.peers()
+        view.fleet_pressure()
+
+    def cs_path():
+        cs.info()
+        cs.labels()
+
+    threads = [threading.Thread(target=drive, args=(f,), daemon=True)
+               for f in (adm_path, bus_path, view_path, cs_path)]
+    for t in threads:
+        t.start()
+    for i in range(20):
+        cs.add_row(i, failure_type="t", failure_id=f"F-{i}", apps=("a",))
+        cs.attach(i, [max(0, i - 1)], [0.9])
+    time.sleep(0.5)
+    stop.set()
+    for t in threads:
+        t.join(timeout=5.0)
+    bus.close()
+    assert not errors, errors
+
+    runtime_edges = sanitize.lock_order_edges()
+    assert sanitize.sanitizer_report()["cycles"] == []
+
+    from kakveda_tpu.analysis.concurrency import static_lock_graph
+
+    static_edges = static_lock_graph(ROOT)
+    union = set(static_edges) | set(runtime_edges)
+    assert sanitize.find_cycles(union) == [], (
+        "runtime acquisition order contradicts the static lock-order "
+        f"graph: {sorted(union)}"
+    )

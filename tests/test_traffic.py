@@ -498,6 +498,63 @@ def test_dlq_auto_replay_on_breaker_reclose(tmp_path, monkeypatch):
     asyncio.run(go())
 
 
+def test_bus_close_cancels_pending_dlq_auto_timer(tmp_path, monkeypatch):
+    """The DLQ auto-replay timer's close path: a bus shut down while a
+    replay is pending cancels the timer (no delivery fires against a
+    torn-down platform), close() is idempotent, and a closed bus never
+    arms another timer."""
+    monkeypatch.setenv("KAKVEDA_BUS_RETRIES", "1")
+    monkeypatch.setenv("KAKVEDA_BUS_BREAKER_THRESHOLD", "1")
+    monkeypatch.setenv("KAKVEDA_BUS_BREAKER_COOLDOWN", "0")
+    monkeypatch.setenv("KAKVEDA_DLQ_AUTO_S", "0.2")
+    from aiohttp import web
+    from aiohttp.test_utils import TestServer
+
+    from kakveda_tpu.events.bus import EventBus
+
+    received = []
+
+    async def hook(request):
+        received.append((await request.json()).get("n"))
+        return web.json_response({"ok": True})
+
+    async def go():
+        app = web.Application()
+        app.router.add_post("/hook", hook)
+        server = TestServer(app)
+        await server.start_server()
+        try:
+            url = str(server.make_url("/hook"))
+            dlq = tmp_path / "dlq.jsonl"
+            bus = EventBus(dlq_path=dlq)
+            bus.subscribe("t", url)
+
+            faults.arm("bus.deliver:1:-1")
+            assert await bus.publish("t", {"n": 1}) == 0
+            faults.disarm()
+            assert await bus.publish("t", {"n": 2}) == 1  # re-close arms timer
+            assert bus._dlq_auto_timer is not None
+
+            bus.close()
+            assert bus._dlq_auto_timer is None
+            bus.close()  # idempotent
+
+            await asyncio.sleep(0.4)  # past the would-have-fired deadline
+            assert len(dlq.read_text().splitlines()) == 1  # never replayed
+            assert received == [2]
+
+            # A closed bus never arms another timer.
+            faults.arm("bus.deliver:1:-1")
+            await bus.publish("t", {"n": 3})
+            faults.disarm()
+            await bus.publish("t", {"n": 4})
+            assert bus._dlq_auto_timer is None
+        finally:
+            await server.close()
+
+    asyncio.run(go())
+
+
 # ---------------------------------------------------------------------------
 # capture seam + storm smoke (through the real HTTP stack)
 # ---------------------------------------------------------------------------
