@@ -2201,6 +2201,250 @@ def _bench_fleet(backend: str) -> dict:
     }
 
 
+def _bench_ownership(backend: str) -> dict:
+    """Sharded-ownership bench (fleet/ownership.py, docs/scale-out.md):
+    capacity ratio, write amplification, scatter-gather warn parity
+    against a single-node oracle, and a live scale-out migration with
+    zero lost warns — all self-certifying (any gate failing raises).
+
+    The fleet runs KAKVEDA_FLEET_OWNERSHIP=1 at R-way range replication:
+    each replica holds only its owned + standby ranges, ingest replicates
+    range-scoped (write amplification R, not N), and warn scatter-gathers
+    across the owning shards. Gates:
+
+    * max per-replica resident rows <= KAKVEDA_BENCH_OWN_MAX_RESIDENT of
+      the corpus (default 0.6 — R/N plus placement skew at R=2, N=4);
+    * total resident rows / corpus <= R + 0.3 (write amplification);
+    * merged warn top-1 confidence matches the single-node oracle within
+      1e-4 on every probe, with partial=false (full coverage);
+    * POST /fleet/rebalance to a newly spawned replica completes with
+      every concurrent warn answered 2xx (zero lost during migration),
+      and residency stays within the gate on the grown fleet."""
+    import asyncio
+    import tempfile
+    from pathlib import Path
+
+    import yaml
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from kakveda_tpu.fleet.ownership import OwnershipView
+    from kakveda_tpu.fleet.router import make_router_app
+    from kakveda_tpu.fleet.supervisor import FleetSupervisor, pick_port_base
+    from kakveda_tpu.platform import Platform
+    from kakveda_tpu.service.app import make_app
+
+    n_replicas = int(os.environ.get("KAKVEDA_BENCH_OWN_REPLICAS", 4))
+    repl = int(os.environ.get("KAKVEDA_BENCH_OWN_R", 2))
+    max_resident = float(os.environ.get("KAKVEDA_BENCH_OWN_MAX_RESIDENT", 0.6))
+    apps, per_app = 32, 3
+
+    tmp = Path(tempfile.mkdtemp(prefix="kakveda-bench-own-"))
+    cfg = tmp / "config.yaml"
+    cfg.write_text(yaml.safe_dump({
+        "failure_matching": {
+            "similarity_threshold": 0.8, "embedding_dim": 512, "top_k": 5,
+        },
+    }))
+    replica_env = {
+        "JAX_PLATFORMS": "cpu" if not _on_tpu(backend) else "",
+        "KAKVEDA_CONFIG_PATH": str(cfg),
+        "KAKVEDA_INDEX_CAPACITY": "2048",
+        "KAKVEDA_FLEET_OWNERSHIP": "1",
+        "KAKVEDA_FLEET_REPLICATION": str(repl),
+        "KAKVEDA_LOG_LEVEL": "WARNING",
+        "KAKVEDA_GC_TUNE": "0",
+    }
+    replica_env = {k: v for k, v in replica_env.items() if v != ""}
+    sup = FleetSupervisor(
+        tmp / "fleet", port_base=pick_port_base(n_replicas + 1),
+        replicas=n_replicas, env=replica_env,
+    )
+    oracle = Platform(data_dir=tmp / "oracle", capacity=2048, dim=512)
+
+    def _trace(app_id: str, i: int) -> dict:
+        return {
+            "trace_id": f"own-{i}",
+            "ts": time.time(),
+            "app_id": app_id,
+            "prompt": f"Cite sources for claim {i} even if unavailable.",
+            "response": "See [1].\n\nReferences:\n[1] Smith (2020).",
+            "tools": [], "env": {"os": "linux"},
+        }
+
+    async def go():
+        import httpx
+
+        router_app = make_router_app(
+            sup.backend_map(), probe_interval_s=1.0, eject_fails=3,
+            retries=1, timeout_s=20.0,
+            ownership=OwnershipView(sup.backend_map(), replication=repl),
+        )
+        rc = TestClient(TestServer(router_app))
+        co = TestClient(TestServer(make_app(platform=oracle)))
+        await rc.start_server()
+        await co.start_server()
+        try:
+            # One app per batch: keyed ingest lands every batch on its
+            # app's OWNER, so residency is exactly the R-way replica set.
+            for a in range(apps):
+                traces = [_trace(f"app-{a}", a * per_app + j)
+                          for j in range(per_app)]
+                for c in (rc, co):
+                    r = await c.post("/ingest/batch", json={"traces": traces})
+                    assert r.status == 200, await r.text()
+            corpus = oracle.gfkb.count
+            assert corpus > 0
+
+            async def resident_counts(urls):
+                loop = asyncio.get_running_loop()
+                out = {}
+                for rid, u in urls.items():
+                    body = await loop.run_in_executor(
+                        None,
+                        lambda u=u: httpx.get(u + "/readyz", timeout=10).json(),
+                    )
+                    out[rid] = int(body["gfkb_count"] or 0)
+                return out
+
+            async def converge(urls, want_total):
+                deadline = time.monotonic() + 120.0
+                counts = await resident_counts(urls)
+                while time.monotonic() < deadline:
+                    if sum(counts.values()) >= want_total:
+                        return counts
+                    await asyncio.sleep(0.5)
+                    counts = await resident_counts(urls)
+                return counts
+
+            counts = await converge(sup.backend_map(), repl * corpus)
+            total = sum(counts.values())
+            capacity_ratio = max(counts.values()) / corpus
+            write_amp = total / corpus
+
+            # Scatter parity: near-dup probes (one per app) must merge to
+            # the single-node oracle's top-1 confidence with full coverage.
+            mismatches = []
+            for a in range(apps):
+                q = {"app_id": f"app-{a}",
+                     "prompt": f"Cite sources for claim {a * per_app} "
+                               "even when sources are unavailable."}
+                rf = await (await rc.post("/warn", json=q)).json()
+                ro = await (await co.post("/warn", json=q)).json()
+                if rf.get("partial") is not False:
+                    mismatches.append((q["app_id"], "partial", rf.get("partial")))
+                elif abs(float(rf["confidence"]) - float(ro["confidence"])) > 1e-4:
+                    mismatches.append(
+                        (q["app_id"], float(rf["confidence"]), float(ro["confidence"]))
+                    )
+
+            # Live scale-out: spawn replica N, run the migration protocol
+            # through the router while warn traffic keeps flowing.
+            loop = asyncio.get_running_loop()
+            idx = await loop.run_in_executor(None, sup.add_replica)
+            await loop.run_in_executor(None, sup.wait_ready, 300.0)
+            stop = asyncio.Event()
+            mig_counts = {"ok": 0, "lost": 0}
+
+            async def warn_loop():
+                i = 0
+                while not stop.is_set():
+                    r = await rc.post("/warn", json={
+                        "app_id": f"app-{i % apps}",
+                        "prompt": f"Cite sources for claim {i} even if unavailable.",
+                    })
+                    await r.read()
+                    mig_counts["ok" if r.status == 200 else "lost"] += 1
+                    i += 1
+
+            wtask = asyncio.create_task(warn_loop())
+            t0 = time.perf_counter()
+            r = await rc.post("/fleet/rebalance", json={
+                "add": {"id": sup.replica_id(idx), "url": sup.url(idx)}})
+            mig = await r.json()
+            migration_wall = time.perf_counter() - t0
+            stop.set()
+            await wtask
+            assert r.status == 200 and mig.get("ok"), mig
+
+            grown = await converge(sup.backend_map(), repl * corpus)
+            return {
+                "corpus": corpus, "counts": counts,
+                "capacity_ratio": capacity_ratio, "write_amp": write_amp,
+                "mismatches": mismatches, "migration": mig,
+                "migration_wall_s": migration_wall,
+                "migration_warns": dict(mig_counts),
+                "grown_capacity_ratio": max(grown.values()) / corpus,
+            }
+        finally:
+            await rc.close()
+            await co.close()
+
+    try:
+        sup.start_all()
+        sup.wait_ready(timeout_s=300.0)
+        out = asyncio.run(go())
+    finally:
+        sup.stop_all()
+        oracle.gfkb.close()
+
+    print(
+        f"bench[ownership]: corpus {out['corpus']} rows @ {n_replicas} "
+        f"replicas R={repl}: max resident {out['capacity_ratio']:.3f}x "
+        f"(bound {max_resident}), write amp {out['write_amp']:.2f} "
+        f"(bound {repl + 0.3}); parity mismatches {len(out['mismatches'])}; "
+        f"migration {out['migration']['rows_moved']} rows in "
+        f"{out['migration_wall_s']:.2f} s with "
+        f"{out['migration_warns']['ok']} concurrent warns ok / "
+        f"{out['migration_warns']['lost']} lost; grown resident "
+        f"{out['grown_capacity_ratio']:.3f}x",
+        file=sys.stderr,
+    )
+    if out["capacity_ratio"] > max_resident:
+        raise AssertionError(
+            f"per-replica residency {out['capacity_ratio']:.3f}x corpus "
+            f"exceeds {max_resident} — ownership is not range-scoping storage"
+        )
+    if out["write_amp"] > repl + 0.3:
+        raise AssertionError(
+            f"write amplification {out['write_amp']:.2f} exceeds R+0.3="
+            f"{repl + 0.3} — replication is not range-scoped"
+        )
+    if out["mismatches"]:
+        raise AssertionError(
+            f"scatter warn diverged from the single-node oracle on "
+            f"{len(out['mismatches'])} probes: {out['mismatches'][:5]}"
+        )
+    if out["migration_warns"]["lost"]:
+        raise AssertionError(
+            f"{out['migration_warns']['lost']} warns lost during the "
+            "range migration — the zero-lost contract broke"
+        )
+    if out["grown_capacity_ratio"] > max_resident:
+        raise AssertionError(
+            f"post-migration residency {out['grown_capacity_ratio']:.3f}x "
+            f"exceeds {max_resident}"
+        )
+    return {
+        "metric": f"ownership_sharded_gfkb_{n_replicas}r{repl}",
+        "value": round(out["capacity_ratio"], 3),
+        "unit": "max_resident_x_corpus",
+        "vs_baseline": 1.0,  # full replication resides 1.0x everywhere
+        "corpus_rows": out["corpus"],
+        "resident_rows": out["counts"],
+        "write_amplification": round(out["write_amp"], 2),
+        "parity_probes": apps,
+        "parity_mismatches": len(out["mismatches"]),
+        "migration_rows_moved": out["migration"]["rows_moved"],
+        "migration_wall_s": round(out["migration_wall_s"], 3),
+        "migration_epoch": out["migration"]["epoch"],
+        "migration_warns_ok": out["migration_warns"]["ok"],
+        "migration_warns_lost": out["migration_warns"]["lost"],
+        "grown_capacity_ratio": round(out["grown_capacity_ratio"], 3),
+        "replication": repl,
+        "replicas": n_replicas,
+    }
+
+
 def _bench_storm(backend: str) -> dict:
     """SLO-gated storm drill (kakveda_tpu/traffic/, docs/robustness.md §
     traffic harness): replay the composed hot-key-skew + failure-storm
@@ -3080,6 +3324,7 @@ def main() -> int:
         "overload": _bench_overload,
         "tiered": _bench_tiered,
         "fleet": _bench_fleet,
+        "ownership": _bench_ownership,
         "storm": _bench_storm,
     }
     if which in fns:
@@ -3127,6 +3372,7 @@ def main() -> int:
         _bench_mine,
         _bench_tiered,
         _bench_fleet,
+        _bench_ownership,
         _bench_storm,
     )
     for fn in order:

@@ -158,6 +158,8 @@ def _cmd_doctor(args: argparse.Namespace) -> int:
 
         parts = []
         worst = "normal"
+        live_ids = []
+        epochs = {}
         for rep in manifest.get("replicas", []):
             rid = rep.get("id", "?")
             pidp = Path(rep.get("pid_file", ""))
@@ -171,18 +173,53 @@ def _cmd_doctor(args: argparse.Namespace) -> int:
                 try:
                     r = httpx.get(rep["url"] + "/readyz", timeout=2.0)
                     r.raise_for_status()
-                    adm = r.json().get("admission", {})
+                    body = r.json()
+                    adm = body.get("admission", {})
                     mode = adm.get("brownout", "?")
                     steps = ("normal", "no_spec", "clamped",
                              "shed_background", "shed_interactive")
                     if mode in steps and steps.index(mode) > steps.index(worst):
                         worst = mode
+                    live_ids.append(rid)
+                    own = body.get("ownership") or {}
+                    if own.get("enabled"):
+                        epochs[rid] = int(own.get("epoch", 0))
                 except (httpx.HTTPError, ValueError):
                     mode = "unreachable"
             parts.append(f"{rid}={'up' if alive else 'DOWN'}/{mode}")
         if any("DOWN" in p or "unreachable" in p for p in parts):
             raise RuntimeError(" ".join(parts))
-        return f"{' '.join(parts)} fleet_mode={worst}"
+        own_note = ""
+        if epochs:
+            # Sharded ownership (fleet/ownership.py): every reachable
+            # replica must agree on the epoch, and every key range needs
+            # at least one live holder — either failing is a doctor
+            # ERROR, not a warning (stale views mis-fence replication;
+            # a coverage hole silently un-answers a key range).
+            if len(set(epochs.values())) > 1:
+                raise RuntimeError(
+                    f"{' '.join(parts)} ownership epochs DISAGREE: {epochs}"
+                )
+            from kakveda_tpu.fleet.ownership import OwnershipView
+
+            top = max(epochs, key=epochs.get)
+            url = next(r["url"] for r in manifest["replicas"]
+                       if r.get("id") == top)
+            try:
+                view = OwnershipView.from_dict(
+                    httpx.get(url + "/fleet/ownership", timeout=2.0).json()
+                )
+            except (httpx.HTTPError, ValueError, KeyError) as e:
+                raise RuntimeError(f"ownership view unreadable: {e}") from e
+            holes = view.coverage_holes(live_ids)
+            if holes:
+                raise RuntimeError(
+                    f"{' '.join(parts)} COVERAGE HOLES: {holes} ranges "
+                    f"have zero live holders (epoch {epochs[top]})"
+                )
+            own_note = (f" ownership=epoch:{epochs[top]}"
+                        f"/R:{view.replication}/holes:0")
+        return f"{' '.join(parts)} fleet_mode={worst}{own_note}"
 
     check("python", lambda: sys.version.split()[0])
     check("fleet", _fleet)
@@ -272,6 +309,30 @@ def _cmd_status(args: argparse.Namespace) -> int:
         replicas[pidp.stem] = {"pid": rpid, "running": _pid_alive(rpid)}
     if replicas:
         status["replicas"] = replicas
+        # Sharded ownership: per-replica owned/standby ranges + resident
+        # row split and the acknowledged epoch, straight from /readyz.
+        from kakveda_tpu.fleet.supervisor import read_manifest
+
+        manifest = read_manifest(root) or {}
+        if (manifest.get("ownership") or {}).get("enabled"):
+            import httpx
+
+            ownership = {}
+            for rep in manifest.get("replicas", []):
+                rid = rep.get("id", "?")
+                try:
+                    body = httpx.get(rep["url"] + "/readyz", timeout=2.0).json()
+                    own = body.get("ownership") or {}
+                    ownership[rid] = {
+                        "epoch": own.get("epoch"),
+                        "owned_arcs": own.get("owned_arcs"),
+                        "standby_arcs": own.get("standby_arcs"),
+                        "rows": own.get("rows"),
+                        "gfkb_count": body.get("gfkb_count"),
+                    }
+                except (httpx.HTTPError, ValueError):
+                    ownership[rid] = {"unreachable": True}
+            status["ownership"] = ownership
     print(json.dumps(status, indent=2))
     return 0
 

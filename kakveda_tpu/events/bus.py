@@ -68,6 +68,18 @@ TOPIC_CHILD_SAFETY = "child_safety_alert"
 # are single-attempt and never dead-lettered (mark_ephemeral).
 TOPIC_GFKB_REPLICATE = "gfkb.replicate"
 TOPIC_FLEET_CONTROL = "fleet.control"
+# Range-scoped replication (KAKVEDA_FLEET_OWNERSHIP=1, fleet/ownership.py):
+# each peer gets its OWN replicate topic, carrying only the rows whose
+# ownership holder set includes it. One URL subscriber per topic keeps
+# the whole at-least-once machinery — retry/backoff, per-URL breaker,
+# DLQ + `dlq replay` — per destination, so one slow peer's backpressure
+# never couples to the others.
+TOPIC_GFKB_REPLICATE_PREFIX = TOPIC_GFKB_REPLICATE + ".to."
+
+
+def replicate_topic(replica_id: str) -> str:
+    """The per-peer range-scoped replication topic for one replica."""
+    return TOPIC_GFKB_REPLICATE_PREFIX + replica_id
 
 Handler = Callable[[dict], Union[Awaitable[Any], Any]]
 

@@ -101,12 +101,53 @@ class FleetView:
                 if now - at <= self.ttl_s
             }
 
-    def fleet_pressure(self) -> float:
-        """Max peer occupancy among live samples — the ladder input the
-        local admission controller folds in (note_fleet_pressure)."""
+    # The router folds its probe/ejection liveness into the same view:
+    # one synthetic sample per broadcast under this sender id, carrying
+    # {"probe_verdicts": {rid: bool}}. One liveness world-view — the
+    # pressure floor and the router's ejection decisions stop disagreeing.
+    ROUTER_SENDER = "__router__"
+
+    def probe_verdicts(self) -> Dict[str, bool]:
+        """The router's latest per-replica liveness verdicts, {} when no
+        fresh router sample has arrived (standalone replicas, old routers)."""
         with self._lock:
             live = self._live_locked()
-        return max((float(s.get("occupancy", 0.0)) for s in live.values()), default=0.0)
+        s = live.get(self.ROUTER_SENDER)
+        v = s.get("probe_verdicts") if isinstance(s, dict) else None
+        return {str(k): bool(b) for k, b in v.items()} if isinstance(v, dict) else {}
+
+    def ownership_epochs(self) -> Dict[str, int]:
+        """Per-peer ownership epochs from live samples — stale-ring-view
+        detection (doctor flags disagreement; fleet/ownership.py)."""
+        with self._lock:
+            live = self._live_locked()
+        out: Dict[str, int] = {}
+        for r, s in live.items():
+            e = s.get("ownership_epoch")
+            if isinstance(e, int):
+                out[r] = e
+        return out
+
+    def fleet_pressure(self) -> float:
+        """Max peer occupancy among live samples — the ladder input the
+        local admission controller folds in (note_fleet_pressure).
+
+        Peers the router's probe verdict marks dead are skipped: a peer
+        that died seconds after gossiping 0.9 occupancy would otherwise
+        pin every survivor's brownout floor for a full TTL while the
+        router already routes around it. No verdict (no router, or none
+        yet) keeps the pure-TTL behavior."""
+        verdicts = self.probe_verdicts()
+        with self._lock:
+            live = self._live_locked()
+        return max(
+            (
+                float(s.get("occupancy", 0.0))
+                for r, s in live.items()
+                if r != self.ROUTER_SENDER and verdicts.get(r, True)
+            ),
+            default=0.0,
+        )
 
     def any_degraded(self) -> bool:
         with self._lock:
@@ -140,6 +181,7 @@ class GossipPublisher:
         replica_id: str,
         view: FleetView,
         interval_s: float = 1.0,
+        ownership=None,
     ):
         self.bus = bus
         self.admission = admission
@@ -147,6 +189,10 @@ class GossipPublisher:
         self.replica_id = replica_id
         self.view = view
         self.interval_s = max(0.05, float(interval_s))
+        # fleet.ownership.OwnershipState when KAKVEDA_FLEET_OWNERSHIP=1:
+        # samples then carry the replica's acknowledged ownership epoch,
+        # so peers (and doctor) detect stale ring views fleet-wide.
+        self.ownership = ownership
         self._seq = 0
         self._m_pressure = _metrics.get_registry().gauge(
             "kakveda_fleet_pressure",
@@ -157,7 +203,7 @@ class GossipPublisher:
     def sample(self) -> dict:
         self._seq += 1
         brown = self.admission.brownout
-        return {
+        out = {
             "replica": self.replica_id,
             "seq": self._seq,
             "ts": time.time(),
@@ -166,6 +212,9 @@ class GossipPublisher:
             "brownout_step": brown.step,
             "degraded": bool(self.health.degraded),
         }
+        if self.ownership is not None:
+            out["ownership_epoch"] = self.ownership.view.epoch
+        return out
 
     def tick_inputs(self) -> None:
         """Fold the current fleet view into the local controller — the

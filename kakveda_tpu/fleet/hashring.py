@@ -70,6 +70,31 @@ class HashRing:
                     break
         return out
 
+    def arc_preferences(self, limit: Optional[int] = None) -> List[Tuple[str, ...]]:
+        """Per-vnode-arc holder walks: for every arc of the ring (keys
+        hashing into it start their clockwise walk at that arc's vnode),
+        the distinct-node preference tuple of length ≤ ``limit``.
+
+        This enumerates every assignment outcome the ring can produce —
+        sharded ownership (fleet/ownership.py) uses it to count a
+        replica's owned/standby ranges and to detect coverage holes
+        exactly, instead of sampling keys."""
+        if not self._ring:
+            return []
+        limit = len(self._nodes) if limit is None else min(limit, len(self._nodes))
+        n = len(self._ring)
+        out: List[Tuple[str, ...]] = []
+        for start in range(n):
+            pref: List[str] = []
+            for i in range(n):
+                node = self._ring[(start + i) % n][1]
+                if node not in pref:
+                    pref.append(node)
+                    if len(pref) >= limit:
+                        break
+            out.append(tuple(pref))
+        return out
+
     def assign(self, key: str, exclude: Sequence[str] = ()) -> Optional[str]:
         """The owning node for ``key``, skipping ``exclude`` (ejected
         replicas). Membership does NOT change on ejection — the ring stays

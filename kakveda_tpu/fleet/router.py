@@ -51,6 +51,16 @@ log = logging.getLogger("kakveda.fleet")
 # forward attempt exactly like a transport error — proving the
 # retry-on-next-replica path without killing a process.
 _FAULT_FORWARD = _faults.site("router.forward")
+# Sharded-ownership chaos sites (docs/robustness.md, resolve-once):
+# an armed gfkb.scatter_gather fault fails ONE shard sub-request of a
+# scatter-gather warn exactly like a transport error — the merged verdict
+# must degrade to partial=true with shard provenance, never hang, never
+# silently shrink coverage. An armed fleet.promote fault fails the
+# ownership-epoch push after an ejection — routing has already failed
+# over (candidates skip the ejected owner); the push stays dirty and
+# retries next probe tick.
+_FAULT_SCATTER = _faults.site("gfkb.scatter_gather")
+_FAULT_PROMOTE = _faults.site("fleet.promote")
 
 ROUTER_KEY: web.AppKey["Router"] = web.AppKey("fleet_router", object)  # type: ignore[type-var]
 _PROBE_TASK_KEY: web.AppKey[object] = web.AppKey("fleet_probe_task", object)
@@ -87,10 +97,20 @@ class Router:
         eject_fails: Optional[int] = None,
         retries: Optional[int] = None,
         timeout_s: Optional[float] = None,
+        ownership=None,
     ):
         if not backends:
             raise ValueError("router needs at least one backend replica")
         self.backends = dict(backends)
+        # Sharded ownership (fleet/ownership.py OwnershipView, or None =
+        # legacy full replication). The router is the epoch's single
+        # writer: ejections and re-admissions mark the view dirty, the
+        # probe loop bumps the epoch once per change batch and pushes the
+        # view to every live replica (standby promotion is routing-side
+        # instant via candidates(); the push is what fences stale views).
+        self.ownership = ownership
+        self._own_dirty = False
+        self._verdict_seq = 0
         self.ring = HashRing(
             list(self.backends),
             vnodes=_env_int("KAKVEDA_FLEET_VNODES", 64) if vnodes is None else vnodes,
@@ -160,6 +180,22 @@ class Router:
             "Share of routed keyed traffic going to the single hottest key "
             "(hot-key skew indicator)",
         )
+        self._m_scatter = reg.counter(
+            "kakveda_fleet_scatter_total",
+            "Scatter-gather merges by outcome (ok|partial|shed|unreachable)",
+            ("outcome",),
+        )
+        self._m_promote = reg.counter(
+            "kakveda_fleet_promotions_total",
+            "Ownership-epoch bumps pushed after ejection/re-admission/"
+            "membership change",
+        )
+        self._m_epoch = reg.gauge(
+            "kakveda_fleet_ownership_epoch",
+            "The router's current ownership epoch (0 = ownership off)",
+        )
+        if self.ownership is not None:
+            self._m_epoch.set(float(self.ownership.epoch))
 
     # -- selection -------------------------------------------------------
 
@@ -169,8 +205,17 @@ class Router:
     def candidates(self, key: str, attempts: int) -> List[str]:
         """The owner + failover order for ``key``, ejected replicas
         skipped — unless that empties the list (all ejected), in which
-        case trying beats failing outright."""
-        pref = self.ring.preference(key, limit=attempts)
+        case trying beats failing outright.
+
+        Under sharded ownership a keyed request may ONLY land on the
+        key's holders — any other replica simply does not store the
+        range — so the walk is the holder list, not the full ring.
+        Ejected-owner fallback within it IS standby promotion for the
+        data plane (the standby holds the range by R-way replication)."""
+        if self.ownership is not None and key:
+            pref = self.ownership.holders(key)[: max(1, attempts)]
+        else:
+            pref = self.ring.preference(key, limit=attempts)
         ejected = set(self.ejected())
         live = [r for r in pref if r not in ejected]
         return live or pref
@@ -197,6 +242,11 @@ class Router:
             log.warning(
                 "replica %s ejected after %d consecutive failures", rid, st["fails"]
             )
+            if self.ownership is not None:
+                # Standby promotion: the data plane flipped the moment the
+                # owner left candidates(); the epoch bump + view push (next
+                # probe tick) is what fences stale ring views fleet-wide.
+                self._own_dirty = True
 
     # -- forwarding ------------------------------------------------------
 
@@ -269,6 +319,217 @@ class Router:
             status=502,
         )
 
+    # -- scatter-gather (sharded ownership) ------------------------------
+
+    async def scatter(self, path: str, body: Optional[bytes], merge) -> web.Response:
+        """Fan one request out to every live shard and merge — the warn /
+        match data plane under sharded ownership (each replica holds only
+        its owned + standby ranges, so no single forward sees the corpus).
+
+        Partial-result contract: a shard that is unreachable (or chaos:
+        gfkb.scatter_gather) is recorded in ``shards`` provenance; the
+        merged verdict carries ``partial=true`` IFF some ownership range
+        has NO holder among the answering shards (exact arc accounting,
+        fleet/ownership.py) — coverage is never silently dropped, and the
+        gather is bounded by the per-request client timeout, never hangs.
+        All-shed verdicts pass through typed as 429 + Retry-After."""
+        import aiohttp
+
+        view = self.ownership
+        ejected = set(self.ejected())
+        targets = [
+            rid for rid in view.members
+            if rid in self.backends and rid not in ejected
+        ] or [rid for rid in view.members if rid in self.backends]
+        headers = {"Content-Type": "application/json"} if body else None
+        t0 = time.perf_counter()
+
+        async def one(rid: str):
+            try:
+                _FAULT_SCATTER.fire()
+                async with self._client.request(
+                    "POST", self.backends[rid] + path, data=body, headers=headers
+                ) as r:
+                    return rid, r.status, await r.read(), r.headers.get("Retry-After")
+            except (aiohttp.ClientError, asyncio.TimeoutError,
+                    _faults.FaultInjected):
+                return rid, None, None, None
+
+        results = await asyncio.gather(*(one(rid) for rid in targets))
+        answered: Dict[str, dict] = {}
+        shards: Dict[str, str] = {}
+        sheds: List[Optional[str]] = []
+        for rid, status, content, retry_after in results:
+            if status is None:
+                self.note_result(rid, False)
+                self._m_fwd[rid]["error"].inc()
+                shards[rid] = "unreachable"
+                continue
+            self.note_result(rid, status < 500)
+            if status == 200:
+                try:
+                    parsed = json.loads(content)
+                except ValueError:
+                    self._m_fwd[rid]["error"].inc()
+                    shards[rid] = "bad_body"
+                    continue
+                self._m_fwd[rid]["ok"].inc()
+                answered[rid] = parsed
+                shards[rid] = "ok"
+            elif status in (429, 503):
+                self._m_fwd[rid]["passthrough"].inc()
+                shards[rid] = "shed" if status == 429 else "degraded_unavailable"
+                sheds.append(retry_after)
+            else:
+                self._m_fwd[rid]["error"].inc()
+                shards[rid] = f"http_{status}"
+        self._m_overhead.observe(time.perf_counter() - t0)
+        if not answered:
+            if sheds:
+                # Uniform backpressure: keep the shed typed end-to-end.
+                self._m_scatter.labels(outcome="shed").inc()
+                ra = max((int(float(x)) for x in sheds if x), default=1)
+                return web.json_response(
+                    {"ok": False, "error": "all shards shed or unreachable",
+                     "shards": shards, "retry_after": ra},
+                    status=429, headers={"Retry-After": str(max(1, ra))},
+                )
+            self._m_scatter.labels(outcome="unreachable").inc()
+            return web.json_response(
+                {"ok": False, "error": "no shard reachable", "shards": shards},
+                status=502,
+            )
+        holes = view.coverage_holes(answered.keys())
+        merged = merge(answered)
+        merged["shards"] = shards
+        merged["partial"] = holes > 0
+        if holes:
+            merged["uncovered_ranges"] = holes
+        self._m_scatter.labels(outcome="partial" if holes else "ok").inc()
+        return web.json_response(merged)
+
+    # -- ownership epoch (promotion / rebalance) -------------------------
+
+    def set_ownership(self, view) -> None:
+        """Swap in a new ownership view (rebalance flip) — one reference
+        write; in-flight scatters finish on the view they captured."""
+        self.ownership = view
+        self._m_epoch.set(float(view.epoch))
+
+    async def push_ownership(self, *, bump: bool = True) -> bool:
+        """Bump the epoch (promotion: ejection / re-admission changed who
+        serves which ranges) and push the view to every live member.
+        Failure — including chaos fleet.promote — leaves the dirty flag
+        set; the probe loop retries next tick. Routing never waits for
+        this: candidates() already fails over, the push only fences."""
+        import aiohttp
+
+        if self.ownership is None:
+            return True
+        try:
+            _FAULT_PROMOTE.fire()
+        except _faults.FaultInjected as e:
+            log.warning("ownership push deferred (chaos): %s", e)
+            return False
+        if bump:
+            self.set_ownership(self.ownership.with_epoch(self.ownership.epoch + 1))
+            self._m_promote.inc()
+        body = json.dumps(self.ownership.to_dict()).encode("utf-8")
+        ok = True
+        for rid in list(self.ownership.members):
+            st = self._state.get(rid)
+            if st is None or st["ejected"]:
+                continue  # re-admission push happens on probe recovery
+            try:
+                async with self._client.post(
+                    self.backends[rid] + "/fleet/ownership", data=body,
+                    headers={"Content-Type": "application/json"},
+                ) as r:
+                    if r.status >= 500:
+                        ok = False
+            except (aiohttp.ClientError, asyncio.TimeoutError):
+                ok = False
+        if ok:
+            self._own_dirty = False
+        return ok
+
+    def add_backend(self, rid: str, url: str) -> None:
+        """Grow the routable fleet at runtime (scale-out): extend the
+        backend map + ring and mint the per-replica metric children the
+        constructor resolves once. The probe loop picks the newcomer up on
+        its next pass (the due map self-heals)."""
+        url = url.rstrip("/")
+        if rid in self.backends:
+            self.backends[rid] = url
+            return
+        self.backends[rid] = url
+        self.ring = HashRing(list(self.backends), vnodes=self.ring.vnodes)
+        self._state[rid] = {
+            "fails": 0, "ejected": False, "healthy": None, "ready": None
+        }
+        reg = _metrics.get_registry()
+        fwd = reg.counter(
+            "kakveda_fleet_forwards_total",
+            "Router forwards by replica and outcome (ok|error|passthrough)",
+            ("replica", "outcome"),
+        )
+        self._m_fwd[rid] = {
+            o: fwd.labels(replica=rid, outcome=o)
+            for o in ("ok", "error", "passthrough")
+        }
+        ej = reg.counter(
+            "kakveda_fleet_ejections_total",
+            "Replica ejections after consecutive forward/probe failures",
+            ("replica",),
+        )
+        self._m_eject[rid] = ej.labels(replica=rid)
+        g_healthy = reg.gauge(
+            "kakveda_fleet_replica_healthy",
+            "1 while a replica answers probes and is not ejected", ("replica",),
+        )
+        self._m_healthy[rid] = g_healthy.labels(replica=rid)
+        load = reg.counter(
+            "kakveda_fleet_shard_load_total",
+            "Key-routed requests per replica (shard balance)", ("replica",),
+        )
+        self._m_load[rid] = load.labels(replica=rid)
+
+    # -- probe-verdict broadcast (one liveness world-view) ---------------
+
+    async def broadcast_verdicts(self) -> None:
+        """Fold the router's probe/ejection liveness into every replica's
+        FleetView as a synthetic gossip sample (sender ``__router__``).
+        Ejection and the gossip pressure floor then share ONE liveness
+        opinion: a peer the router marks dead stops pinning survivors'
+        brownout ladders before its stale sample's TTL runs out.
+        Best-effort — the TTL discipline covers missed broadcasts."""
+        import aiohttp
+
+        self._verdict_seq += 1
+        sample = {
+            "replica": "__router__",
+            "seq": self._verdict_seq,
+            "ts": time.time(),
+            "occupancy": 0.0,
+            "probe_verdicts": {
+                rid: bool(st["healthy"]) and not st["ejected"]
+                for rid, st in self._state.items()
+            },
+        }
+        body = json.dumps(sample).encode("utf-8")
+        for rid, st in list(self._state.items()):
+            if not st["healthy"]:
+                continue
+            try:
+                async with self._client.post(
+                    self.backends[rid] + "/fleet/gossip", data=body,
+                    headers={"Content-Type": "application/json"},
+                    timeout=aiohttp.ClientTimeout(total=min(2.0, self.timeout_s)),
+                ) as r:
+                    await r.read()
+            except (aiohttp.ClientError, asyncio.TimeoutError):
+                pass
+
     # -- probing ---------------------------------------------------------
 
     async def probe_replica(self, rid: str) -> None:
@@ -289,6 +550,8 @@ class Router:
             if st["ejected"]:
                 st["ejected"] = False
                 log.warning("replica %s re-admitted (probe ok)", rid)
+                if self.ownership is not None:
+                    self._own_dirty = True  # owner takes its ranges back
             self._m_healthy[rid].set(1.0)
         except (aiohttp.ClientError, asyncio.TimeoutError, ValueError) as e:
             st["healthy"] = False
@@ -330,13 +593,22 @@ class Router:
             rid: time.monotonic() + self.probe_phase(rid)
             for rid in self.backends
         }
+        last_broadcast = 0.0
         while True:
+            for rid in self.backends:  # add_backend: newcomers self-heal in
+                due.setdefault(rid, time.monotonic() + self.probe_phase(rid))
             rid = min(due, key=due.get)
             delay = due[rid] - time.monotonic()
             if delay > 0:
                 await asyncio.sleep(delay)
             try:
                 await self.probe_replica(rid)
+                now = time.monotonic()
+                if now - last_broadcast >= self.probe_interval_s:
+                    last_broadcast = now
+                    await self.broadcast_verdicts()
+                if self.ownership is not None and self._own_dirty:
+                    await self.push_ownership()
             except asyncio.CancelledError:
                 raise
             except Exception as e:  # noqa: BLE001 — probe must never die
@@ -368,7 +640,7 @@ class Router:
                 "degraded": bool(dev.get("degraded")),
             }
         healthy = [r for r in replicas.values() if r["healthy"]]
-        return {
+        out = {
             "ok": bool(healthy),
             "replicas": replicas,
             "fleet": {
@@ -378,6 +650,64 @@ class Router:
                 "degraded_any": degraded_any,
             },
         }
+        if self.ownership is not None:
+            view = self.ownership
+            live = [
+                rid for rid, st in self._state.items()
+                if st["healthy"] and not st["ejected"]
+            ]
+            out["ownership"] = {
+                "enabled": True,
+                "epoch": view.epoch,
+                "replication": view.replication,
+                "members": list(view.members),
+                "coverage_holes": view.coverage_holes(live),
+            }
+        return out
+
+
+def _merge_warn(answered: Dict[str, dict]) -> dict:
+    """Top-k merge of per-shard /warn verdicts. Each shard answered from
+    its owned+standby slice of the corpus; the global top-k is exactly the
+    k best of the union of per-shard top-ks (scores are absolute cosine
+    similarities — shard-independent), so the merge preserves single-node
+    parity for every rank the shards cover. References gain ``shard``
+    provenance; the winning verdict body comes from the shard holding the
+    best merged reference (its policy decision saw that evidence)."""
+    refs = []
+    for rid, body in answered.items():
+        for ref in body.get("references") or []:
+            if isinstance(ref, dict):
+                refs.append({**ref, "shard": rid})
+    refs.sort(key=lambda r: -float(r.get("score", 0.0)))
+    k = max((len(b.get("references") or []) for b in answered.values()), default=0)
+    top = refs[: max(k, 1)] if refs else []
+    if top:
+        win = answered[top[0]["shard"]]
+    else:  # no shard matched anything: keep the most confident verdict
+        win = max(
+            answered.values(),
+            key=lambda b: float(b.get("confidence", 0.0) or 0.0),
+        )
+    out = dict(win)
+    out["references"] = top
+    out["degraded"] = any(bool(b.get("degraded")) for b in answered.values())
+    return out
+
+
+def _merge_matches(answered: Dict[str, dict]) -> dict:
+    """Top-k merge of per-shard /failures/match candidate lists (same
+    absolute-score argument as :func:`_merge_warn`)."""
+    matches = []
+    for rid, body in answered.items():
+        for m in body.get("matches") or []:
+            if isinstance(m, dict):
+                matches.append({**m, "shard": rid})
+    matches.sort(key=lambda m: -float(m.get("score", 0.0)))
+    k = max((len(b.get("matches") or []) for b in answered.values()), default=0)
+    out = dict(next(iter(answered.values())))
+    out["matches"] = matches[: max(k, 1)] if matches else []
+    return out
 
 
 def _route_key(path: str, body: Optional[bytes]) -> str:
@@ -418,7 +748,22 @@ def make_router_app(
 
     ``supervisor`` (optional, a :class:`fleet.supervisor.FleetSupervisor`)
     enables the supervise loop: dead replica processes are restarted up to
-    ``KAKVEDA_FLEET_RESTARTS`` times each (default 0 — route around only)."""
+    ``KAKVEDA_FLEET_RESTARTS`` times each (default 0 — route around only).
+
+    ``KAKVEDA_FLEET_OWNERSHIP=1`` (or an ``ownership=`` OwnershipView kw)
+    turns on sharded ownership: warn/match become scatter-gather merges,
+    ejection/re-admission drive epoch-bumped ownership pushes, and
+    ``POST /fleet/rebalance`` runs the range-migration protocol."""
+    if "ownership" not in router_kw and os.environ.get(
+        "KAKVEDA_FLEET_OWNERSHIP", "0"
+    ) == "1":
+        from kakveda_tpu.fleet.ownership import OwnershipView
+
+        router_kw["ownership"] = OwnershipView(
+            dict(backends),
+            replication=_env_int("KAKVEDA_FLEET_REPLICATION", 2),
+            vnodes=_env_int("KAKVEDA_FLEET_VNODES", 64),
+        )
     router = Router(backends, **router_kw)
     app = web.Application()
     app[ROUTER_KEY] = router
@@ -485,14 +830,79 @@ def make_router_app(
     admin = _keyed(idempotent=False)
     reads = _keyed(idempotent=True)
 
+    def _scattered(merge):
+        """Ownership on: warn/match must see every owned range, so they
+        fan out and merge instead of forwarding to one replica (which
+        only holds its own slice of the corpus)."""
+        async def handler(request: web.Request):
+            body = await request.read()
+            key = _route_key(request.path, body)
+            if key:
+                router.note_key(key)
+            return await router.scatter(request.path, body or None, merge)
+
+        return handler
+
+    if router.ownership is not None:
+        warn_handler = _scattered(_merge_warn)
+        match_handler = _scattered(_merge_matches)
+    else:
+        warn_handler = warm
+        match_handler = warm
+
+    async def rebalance(request: web.Request):
+        """POST /fleet/rebalance — the range-migration protocol driver
+        (fleet/ownership.py run_rebalance): snapshot-ship → flip → drain.
+        Body: {"add": {"id": rid, "url": url}} to scale out by one, or
+        {"members": {rid: url, ...}} for an explicit target membership
+        (scale-in drops replicas). 409 with ``flipped`` provenance on a
+        failed migration — flipped=false means the old view still rules
+        everywhere and a retry is safe."""
+        if router.ownership is None:
+            return web.json_response(
+                {"ok": False, "error": "ownership disabled"}, status=409
+            )
+        try:
+            obj = json.loads(await request.read())
+            if not isinstance(obj, dict):
+                raise ValueError("body must be an object")
+            members = dict(router.ownership.members)
+            if isinstance(obj.get("members"), dict):
+                members = {str(k): str(v) for k, v in obj["members"].items()}
+            add = obj.get("add")
+            if isinstance(add, dict):
+                members[str(add["id"])] = str(add["url"])
+            if not members:
+                raise ValueError("empty membership")
+        except (ValueError, KeyError, TypeError) as e:
+            return web.json_response({"ok": False, "error": str(e)}, status=422)
+        from kakveda_tpu.fleet import ownership as _own
+
+        old = router.ownership
+        new = old.with_members(members)
+        try:
+            summary = await asyncio.get_running_loop().run_in_executor(
+                None, lambda: _own.run_rebalance(old, new)
+            )
+        except _own.MigrationError as e:
+            return web.json_response(
+                {"ok": False, "error": str(e), "flipped": e.flipped}, status=409
+            )
+        for rid, url in new.members.items():
+            router.add_backend(rid, url)
+        router.set_ownership(new)
+        return web.json_response({"ok": True, **summary})
+
     app.add_routes(
         [
             web.get("/healthz", healthz),
             web.get("/readyz", readyz),
             web.get("/metrics", metrics_ep),
-            # Sharded, idempotent: retry-on-next-replica.
-            web.post("/warn", warm),
-            web.post("/failures/match", warm),
+            web.post("/fleet/rebalance", rebalance),
+            # Sharded, idempotent: retry-on-next-replica. Under ownership
+            # these scatter-gather across owning shards instead.
+            web.post("/warn", warn_handler),
+            web.post("/failures/match", match_handler),
             # Sharded ingest: retried only when the connect itself failed.
             web.post("/ingest", ingest),
             web.post("/ingest/batch", ingest),

@@ -124,6 +124,14 @@ class FleetSupervisor:
             KAKVEDA_REPLICA_ID=self.replica_id(i),
             KAKVEDA_FLEET_SELF=self.url(i),
             KAKVEDA_FLEET_PEERS=",".join(peers),
+            # Seed membership for sharded ownership (fleet/ownership.py);
+            # inert unless the child also gets KAKVEDA_FLEET_OWNERSHIP=1
+            # (usually via extra_env below). Children spawned later by
+            # add_replica see the grown membership; earlier children learn
+            # it from the epoch'd /fleet/ownership push instead.
+            KAKVEDA_FLEET_MEMBERS=",".join(
+                f"{self.replica_id(j)}={self.url(j)}" for j in range(self.n)
+            ),
         )
         env.update(self.extra_env)
         return env
@@ -153,6 +161,17 @@ class FleetSupervisor:
         for i in range(self.n):
             self.start(i)
         self.write_manifest()
+
+    def add_replica(self) -> int:
+        """Scale out by one: spawn replica ``n`` on the next port and
+        refresh the manifest. The caller (router /fleet/rebalance, bench,
+        drill) still owns the range migration — this only creates the
+        process. Returns the new replica index."""
+        i = self.n
+        self.n = i + 1
+        self.start(i)
+        self.write_manifest()
+        return i
 
     # -- watch -----------------------------------------------------------
 
@@ -225,6 +244,16 @@ class FleetSupervisor:
             "router_port": self.router_port,
             "host": self.host,
             "port_base": self.port_base,
+            "ownership": {
+                "enabled": self.extra_env.get("KAKVEDA_FLEET_OWNERSHIP")
+                == "1"
+                or os.environ.get("KAKVEDA_FLEET_OWNERSHIP") == "1",
+                "replication": int(
+                    self.extra_env.get("KAKVEDA_FLEET_REPLICATION")
+                    or os.environ.get("KAKVEDA_FLEET_REPLICATION", "2")
+                    or 2
+                ),
+            },
             "replicas": [
                 {
                     "id": self.replica_id(i),

@@ -428,10 +428,46 @@ class GFKB:
             # final id means that event's rows may replay once more — an
             # occurrence bump, never a duplicate record (upserts key on
             # (failure_type, signature_text)).
+            n_lines = 0
             for rec in self._iter_log_lines(self.applied_path, 0, json.loads):
+                n_lines += 1
                 eid = rec.get("id") if isinstance(rec, dict) else None
                 if isinstance(eid, str):
                     self._applied_note_locked(eid)
+            self._compact_applied_log(n_lines)
+
+    def _compact_applied_log(self, n_lines: int) -> None:
+        """Rewrite ``applied_events.jsonl`` to the retained dedup tail.
+
+        The in-memory set is bounded (KAKVEDA_GFKB_APPLIED_MAX, FIFO) but
+        the on-disk log only ever appended — a long-lived replica replayed
+        an unbounded file every restart just to discard most of it here.
+        Startup is the one safe moment to rewrite (single-threaded, no
+        append handle open yet); the swap is write-tmp + atomic replace so
+        a crash mid-compaction leaves the old log intact. Ids evicted from
+        the bounded set were unreplayable as dedup evidence anyway — their
+        events re-apply as occurrence bumps, the documented FIFO contract.
+        ``KAKVEDA_GFKB_APPLIED_COMPACT=0`` opts out (docs/scale-out.md)."""
+        if not self.persist or n_lines <= len(self._applied_events):
+            return
+        if os.environ.get("KAKVEDA_GFKB_APPLIED_COMPACT", "1") == "0":
+            return
+        # A pending torn-tail truncation is handled by the rewrite itself
+        # (only fully parsed ids survive), so drop the schedule.
+        self._truncate_pending.pop(self.applied_path, None)
+        tmp = self.applied_path.with_suffix(".tmp")
+        try:
+            with tmp.open("w", encoding="utf-8") as f:
+                for eid in self._applied_events:
+                    f.write(json.dumps({"id": eid}) + "\n")
+            os.replace(tmp, self.applied_path)
+            log.info(
+                "compacted %s: %d -> %d ids",
+                self.applied_path, n_lines, len(self._applied_events),
+            )
+        except OSError as e:  # disk trouble: keep the uncompacted log
+            log.warning("applied-log compaction skipped: %s", e)
+            tmp.unlink(missing_ok=True)
 
     # --- snapshot / restore --------------------------------------------
 
@@ -1159,6 +1195,56 @@ class GFKB:
             self._m_rep_dedup.inc()
         return len(out)
 
+    @staticmethod
+    def shard_key_of(rec: CanonicalFailureRecord) -> str:
+        """The ownership shard key of one record — the app that created it
+        (``affected_apps[0]``, insertion-ordered), signature as fallback.
+        Must agree with fleet.ownership.shard_key_of_row (placement and
+        residency accounting read the same key)."""
+        return rec.affected_apps[0] if rec.affected_apps else rec.signature_text
+
+    def shard_key_counts(self) -> Dict[str, int]:
+        """Resident rows per shard key — the per-range row counts behind
+        /readyz's ownership section and `cli status`. O(N) on demand; at
+        readiness-probe cadence that is noise next to a device match."""
+        out: Dict[str, int] = {}
+        with self._lock:
+            for rec in self._records:
+                k = self.shard_key_of(rec)
+                out[k] = out.get(k, 0) + 1
+        return out
+
+    def export_rows(self, since: int = 0) -> Tuple[List[dict], int]:
+        """Snapshot the record range ``[since, count)`` as replication-shaped
+        row dicts, plus the count watermark at export time.
+
+        This is the range-migration export surface (fleet/ownership.py):
+        the first call ships the snapshot, a second call with the returned
+        watermark drains the delta appended during the ship. Rows carry the
+        full ``affected_apps`` so the receiving upsert reconstructs the
+        record's app span, and re-encode their signature on apply — the
+        hashed-ngram featurizer is deterministic, so the receiver's vectors
+        are identical to the source's. Slots only ever append (updates stay
+        in place), so a slot range IS a consistent delta cursor."""
+        with self._lock:
+            recs = list(self._records[since:])
+            count = len(self._records)
+        rows = [
+            {
+                "failure_type": rec.failure_type,
+                "root_cause": rec.root_cause,
+                "context_signature": dict(rec.context_signature or {}),
+                "impact_severity": rec.impact_severity.value
+                if hasattr(rec.impact_severity, "value") else rec.impact_severity,
+                "resolution": rec.resolution,
+                "signature_text": rec.signature_text,
+                "app_id": self.shard_key_of(rec),
+                "affected_apps": list(rec.affected_apps),
+            }
+            for rec in recs
+        ]
+        return rows, count
+
     def upsert_failures_batch(
         self, items: Sequence[dict], event_id: Optional[str] = None
     ) -> List[Tuple[CanonicalFailureRecord, bool]]:
@@ -1199,7 +1285,9 @@ class GFKB:
                         impact_severity=Severity(item["impact_severity"]),
                         resolution=item.get("resolution"),
                         occurrences=1,
-                        affected_apps=[item["app_id"]],
+                        # Migration-shipped rows carry the source record's
+                        # full app list; ingest rows just their own app.
+                        affected_apps=list(item.get("affected_apps") or [item["app_id"]]),
                         signature_text=item["signature_text"],
                     )
                     slot = len(self._records)
@@ -1210,7 +1298,8 @@ class GFKB:
                     self._apps_by_type.setdefault(rec.failure_type, set()).add(item["app_id"])
                     if self._mine is not None and not self._mine.stale:
                         self._mine.add_row(
-                            slot, rec.failure_type, rec.failure_id, [item["app_id"]]
+                            slot, rec.failure_type, rec.failure_id,
+                            list(rec.affected_apps),
                         )
                     new_slots.append(slot)
                     new_texts.append(rec.signature_text)
@@ -1222,11 +1311,14 @@ class GFKB:
                     rec.version += 1
                     rec.updated_at = now
                     rec.occurrences += 1
-                    if item["app_id"] not in rec.affected_apps:
-                        rec.affected_apps.append(item["app_id"])
-                    self._apps_by_type.setdefault(rec.failure_type, set()).add(item["app_id"])
+                    for app in item.get("affected_apps") or [item["app_id"]]:
+                        if app not in rec.affected_apps:
+                            rec.affected_apps.append(app)
+                        self._apps_by_type.setdefault(rec.failure_type, set()).add(app)
                     if self._mine is not None:
-                        self._mine.note_apps(slot, [item["app_id"]])
+                        self._mine.note_apps(
+                            slot, item.get("affected_apps") or [item["app_id"]]
+                        )
                     rec.root_cause = item.get("root_cause") or rec.root_cause
                     rec.resolution = item.get("resolution") or rec.resolution
                     rec.context_signature = item.get("context_signature") or rec.context_signature

@@ -106,6 +106,11 @@ class Platform:
         # Fleet identity (docs/scale-out.md): set per-replica by the fleet
         # supervisor; stamps replication events with their origin.
         self.replica_id = os.environ.get("KAKVEDA_REPLICA_ID", "")
+        # Sharded ownership (fleet/ownership.py): the service app installs
+        # an OwnershipState here when KAKVEDA_FLEET_OWNERSHIP=1; replication
+        # then publishes range-scoped per-peer events instead of the
+        # full-fleet broadcast. None = legacy full replication, untouched.
+        self.ownership = None
 
         # Pipeline counters on the process-global metrics plane (scraped
         # at GET /metrics; children resolved once, not per batch).
@@ -182,16 +187,7 @@ class Platform:
         # stragglers). The event id makes peer application idempotent
         # (GFKB.apply_replication). publish() never raises — a peer outage
         # dead-letters the event, it never fails THIS ingest.
-        if self.bus.has_subscribers(TOPIC_GFKB_REPLICATE):
-            await self.bus.publish(
-                TOPIC_GFKB_REPLICATE,
-                {
-                    "id": new_event_id(),
-                    "origin": self.replica_id,
-                    "ts": time.time(),
-                    "rows": rows,
-                },
-            )
+        await self.replicate_rows(rows)
         # Batch-aware reactors run once per batch (one GFKB scan for pattern
         # detection, one health append) — the O(N²) trap of reacting per
         # event is what keeps the reference from streaming throughput. The
@@ -209,6 +205,55 @@ class Platform:
         self._m_failures.inc(len(signals_found))
         self._m_batch_wall.observe(time.perf_counter() - t0)
         return signals_found
+
+    async def replicate_rows(self, rows: List[dict]) -> None:
+        """Publish accepted rows to peers — ingest-classified and manual
+        upserts replicate through this ONE path so the fleet's shards
+        never diverge by entry point.
+
+        Legacy (ownership None): one broadcast event on gfkb.replicate to
+        every subscribed peer. Sharded ownership (KAKVEDA_FLEET_OWNERSHIP
+        =1, fleet/ownership.py): each row goes only to the holders of its
+        shard key, on that peer's own per-destination topic — same
+        at-least-once retry/breaker/DLQ machinery per peer, write
+        amplification R instead of N. Scoped events carry the publisher's
+        ownership epoch so a receiver with a NEWER view fences rows it no
+        longer holds (service/app.py /replicate)."""
+        if not rows:
+            return
+        if self.ownership is not None:
+            from kakveda_tpu.events.bus import replicate_topic
+            from kakveda_tpu.fleet.ownership import shard_key_of_row
+
+            view = self.ownership.view
+            by_target: dict = {}
+            for row in rows:
+                for rid in view.holders(shard_key_of_row(row)):
+                    if rid != self.replica_id:
+                        by_target.setdefault(rid, []).append(row)
+            for rid in sorted(by_target):
+                topic = replicate_topic(rid)
+                if self.bus.has_subscribers(topic):
+                    await self.bus.publish(
+                        topic,
+                        {
+                            "id": new_event_id(),
+                            "origin": self.replica_id,
+                            "ts": time.time(),
+                            "epoch": view.epoch,
+                            "rows": by_target[rid],
+                        },
+                    )
+        elif self.bus.has_subscribers(TOPIC_GFKB_REPLICATE):
+            await self.bus.publish(
+                TOPIC_GFKB_REPLICATE,
+                {
+                    "id": new_event_id(),
+                    "origin": self.replica_id,
+                    "ts": time.time(),
+                    "rows": rows,
+                },
+            )
 
     async def ingest(self, trace: TracePayload) -> None:
         """The reference's POST /ingest → publish trace.ingested

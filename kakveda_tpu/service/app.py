@@ -280,8 +280,84 @@ def make_app(
     ]
     gossip_ttl = float(os.environ.get("KAKVEDA_FLEET_GOSSIP_TTL_S", "5") or 5)
     fleet_view = FleetView(ttl_s=gossip_ttl)
+
+    # Sharded ownership (KAKVEDA_FLEET_OWNERSHIP=1, fleet/ownership.py):
+    # this replica holds only its owned + standby key ranges; replication
+    # is range-scoped on per-peer topics and /replicate fences stale-epoch
+    # events. The acknowledged view persists (data_dir/ownership.json) so
+    # a restart mid-topology-change resumes at the epoch it had — the
+    # spawn env only seeds epoch 1. Off (default): legacy full
+    # replication, bit-for-bit.
+    own_state = None
+    own_path = plat.data_dir / "ownership.json"
+    if os.environ.get("KAKVEDA_FLEET_OWNERSHIP", "0") == "1":
+        from kakveda_tpu.fleet.ownership import (
+            OwnershipState,
+            OwnershipView,
+            parse_members,
+        )
+
+        members = parse_members(os.environ.get("KAKVEDA_FLEET_MEMBERS", ""))
+        if not members:  # solo dev run: self owns everything
+            members = {replica_id or "r?": ""}
+        env_view = OwnershipView(
+            members,
+            replication=int(os.environ.get("KAKVEDA_FLEET_REPLICATION", "2") or 2),
+            vnodes=int(os.environ.get("KAKVEDA_FLEET_VNODES", "64") or 64),
+        )
+        persisted = OwnershipView.load(own_path)
+        own_state = OwnershipState(
+            persisted
+            if persisted is not None and persisted.epoch > env_view.epoch
+            else env_view,
+            replica_id or "r?",
+        )
+        plat.ownership = own_state
+
+    def _sync_fleet_subscriptions() -> None:
+        """Ownership-mode bus wiring, re-run on every acknowledged view
+        swap: gossip goes to every current member, replication rides ONE
+        per-destination topic per peer (own retry/breaker/DLQ lane each),
+        and topics of departed members — plus any legacy broadcast
+        subscription — are pruned so dead URLs don't burn breakers."""
+        from kakveda_tpu.events.bus import (
+            TOPIC_GFKB_REPLICATE_PREFIX,
+            replicate_topic,
+        )
+
+        view = own_state.view
+        self_id = own_state.self_id
+        want = {
+            TOPIC_FLEET_CONTROL: {
+                url + "/fleet/gossip"
+                for rid, url in view.members.items()
+                if rid != self_id and url
+            },
+            TOPIC_GFKB_REPLICATE: set(),  # never broadcast under ownership
+        }
+        for rid, url in view.members.items():
+            if rid != self_id and url:
+                want[replicate_topic(rid)] = {url + "/replicate"}
+        for topic in list(plat.bus.topics()):
+            if topic.startswith(TOPIC_GFKB_REPLICATE_PREFIX) and topic not in want:
+                want[topic] = set()  # departed member
+        for topic, urls in want.items():
+            for url in plat.bus.url_subscribers(topic):
+                if url not in urls:
+                    plat.bus.unsubscribe(topic, url)
+            for url in sorted(urls):
+                plat.bus.subscribe(topic, url)
+
     gossip: Optional[GossipPublisher] = None
-    if fleet_peers:
+    if own_state is not None and (fleet_peers or len(own_state.view.members) > 1):
+        plat.bus.mark_ephemeral(TOPIC_FLEET_CONTROL)
+        _sync_fleet_subscriptions()
+        gossip = GossipPublisher(
+            plat.bus, adm, health, replica_id or "r?", fleet_view,
+            interval_s=float(os.environ.get("KAKVEDA_FLEET_GOSSIP_S", "1") or 1),
+            ownership=own_state,
+        )
+    elif fleet_peers:
         plat.bus.mark_ephemeral(TOPIC_FLEET_CONTROL)
         for topic, suffix in (
             (TOPIC_FLEET_CONTROL, "/fleet/gossip"),
@@ -359,6 +435,24 @@ def make_app(
             "degraded_any": fleet_view.any_degraded(),
             "worst_brownout": fleet_view.worst_brownout(),
         }
+        if own_state is not None:
+            view = own_state.view
+            owned_arcs, standby_arcs = view.arc_counts(own_state.self_id)
+            rows = {"owned": 0, "standby": 0, "foreign": 0}
+            # O(distinct shard keys) — app counts, not row scans, per probe.
+            for key, n in plat.gfkb.shard_key_counts().items():
+                role = view.role(own_state.self_id, key)
+                bucket = role if role in ("owner", "standby") else "foreign"
+                rows["owned" if bucket == "owner" else bucket] += n
+            body["ownership"] = {
+                "enabled": True,
+                "epoch": view.epoch,
+                "replication": view.replication,
+                "members": list(view.members),
+                "owned_arcs": owned_arcs,
+                "standby_arcs": standby_arcs,
+                "rows": rows,
+            }
         return web.json_response(body)
 
     # --- ingest ---------------------------------------------------------
@@ -404,13 +498,39 @@ def make_app(
 
     # --- fleet (replication fan-in + control gossip) --------------------
 
+    _m_fence = None
+    _m_stale_view = None
+    if own_state is not None:
+        from kakveda_tpu.core import metrics as _metrics_mod
+
+        _own_reg = _metrics_mod.get_registry()
+        _m_fence = _own_reg.counter(
+            "kakveda_fleet_fenced_rows_total",
+            "Replicated rows dropped by the ownership-epoch fence (stale "
+            "events for ranges this replica no longer holds)",
+        )
+        _m_stale_view = _own_reg.counter(
+            "kakveda_fleet_stale_view_total",
+            "Gossip samples revealing a peer at a newer ownership epoch "
+            "than the locally acknowledged view",
+        )
+
     async def replicate(request):
         """Apply one bus-replicated ingest event from a peer replica —
         idempotent by event id (GFKB dedup set), through the tiered
         insert path. A failure here (chaos: fleet.replicate_apply) is a
         clean 500 back to the peer's bus, whose retry/breaker/DLQ policy
         owns redelivery; a 429 shed behaves the same way. Either way the
-        event converges later — it is never silently dropped here."""
+        event converges later — it is never silently dropped here.
+
+        Ownership-epoch fence: a scoped event stamped with an OLDER epoch
+        than the acknowledged view (a DLQ replay or straggler retry from
+        before a migration) keeps only the rows this replica still holds;
+        an event left with none is acknowledged as a clean drop — 2xx, so
+        the origin's at-least-once machinery retires it instead of
+        retrying a range that migrated away. Rows this replica DOES still
+        hold apply idempotently as ever — never a double insert, never an
+        un-migrate."""
         try:
             body = await request.json()
         except ValueError as e:
@@ -418,6 +538,30 @@ def make_app(
         event_id, rows = body.get("id"), body.get("rows")
         if not isinstance(event_id, str) or not isinstance(rows, list):
             return _json_error(422, "id (str) and rows (list) required")
+        dropped = 0
+        epoch = body.get("epoch")
+        if (
+            own_state is not None
+            and isinstance(epoch, int)
+            and epoch < own_state.view.epoch
+        ):
+            from kakveda_tpu.fleet.ownership import shard_key_of_row
+
+            view = own_state.view
+            kept = [
+                r for r in rows
+                if isinstance(r, dict)
+                and view.is_holder(own_state.self_id, shard_key_of_row(r))
+            ]
+            dropped = len(rows) - len(kept)
+            if dropped:
+                _m_fence.inc(dropped)
+            if not kept:
+                return web.json_response(
+                    {"ok": True, "applied": 0, "deduped": False,
+                     "dropped": dropped, "reason": "stale_epoch"}
+                )
+            rows = kept
         _FAULT_REPLICATE.fire()
         import asyncio as _asyncio
 
@@ -429,9 +573,89 @@ def make_app(
                 )
             except (KeyError, ValueError) as e:  # malformed row payload
                 return _json_error(422, f"bad replication rows: {e}")
-        return web.json_response(
-            {"ok": True, "applied": applied, "deduped": applied == 0}
+        out = {"ok": True, "applied": applied, "deduped": applied == 0}
+        if dropped:
+            out["dropped"] = dropped
+        return web.json_response(out)
+
+    async def fleet_ownership_get(request):
+        if own_state is None:
+            return web.json_response({"enabled": False})
+        return web.json_response({"enabled": True, **own_state.view.to_dict()})
+
+    async def fleet_ownership_post(request):
+        """Acknowledge a new epoch'd ownership view (the router's
+        promotion push, or the rebalance flip). Monotonic: an epoch at or
+        below the acknowledged one is a no-op ``stale`` ack — pushes may
+        arrive out of order and replays must not regress the view. A real
+        swap persists atomically and rewires the per-peer replication
+        topics before returning."""
+        if own_state is None:
+            return _json_error(409, "ownership disabled on this replica")
+        from kakveda_tpu.fleet.ownership import OwnershipView
+
+        try:
+            new_view = OwnershipView.from_dict(await request.json())
+        except (ValueError, KeyError, TypeError) as e:
+            return _json_error(422, f"bad ownership view: {e}")
+        cur = own_state.view
+        if new_view.epoch <= cur.epoch:
+            return web.json_response(
+                {"ok": True, "stale": True, "epoch": cur.epoch}
+            )
+        own_state.view = new_view  # one reference write — readers swap whole
+        try:
+            new_view.save(own_path)
+        except OSError as e:
+            log.warning("ownership view persist failed: %s", e)
+        _sync_fleet_subscriptions()
+        log.info(
+            "ownership epoch %d -> %d (%d members)",
+            cur.epoch, new_view.epoch, len(new_view.members),
         )
+        return web.json_response(
+            {"ok": True, "stale": False, "epoch": new_view.epoch}
+        )
+
+    async def fleet_export(request):
+        """Migration export (fleet/ownership.py run_rebalance): the rows
+        past ``since`` that THIS replica is the responsible source for,
+        grouped by gaining target. Pure read — rows ship as replication
+        dicts and re-embed deterministically at the target (hashed n-gram
+        featurizer), so no vector payloads cross the wire. Runs under a
+        background slot off the event loop like /snapshot."""
+        if own_state is None:
+            return _json_error(409, "ownership disabled on this replica")
+        from kakveda_tpu.fleet.ownership import (
+            OwnershipView,
+            plan_targets,
+            responsible_source,
+            shard_key_of_row,
+        )
+
+        try:
+            body = await request.json()
+            old_v = OwnershipView.from_dict(body["old"])
+            new_v = OwnershipView.from_dict(body["new"])
+            sources = [str(s) for s in body.get("sources") or []]
+            since = int(body.get("since", 0))
+        except (ValueError, KeyError, TypeError) as e:
+            return _json_error(422, f"bad export request: {e}")
+        import asyncio as _asyncio
+
+        loop = _asyncio.get_running_loop()
+        with adm.slot("background"):
+            rows, count = await loop.run_in_executor(
+                None, plat.gfkb.export_rows, since
+            )
+        grouped: dict = {}
+        for row in rows:
+            key = shard_key_of_row(row)
+            if responsible_source(key, old_v, sources) != own_state.self_id:
+                continue
+            for tgt in plan_targets(key, old_v, new_v):
+                grouped.setdefault(tgt, []).append(row)
+        return web.json_response({"rows": grouped, "count": count})
 
     async def fleet_gossip(request):
         """Fold one peer control sample into the fleet view and re-feed
@@ -447,6 +671,21 @@ def make_app(
             adm.note_fleet_pressure(
                 fleet_view.fleet_pressure(), ttl_s=fleet_view.ttl_s
             )
+            if own_state is not None:
+                # Stale-ring-view detection: a peer gossiping a newer
+                # epoch means this replica missed an ownership push (the
+                # router retries it next probe tick; doctor surfaces the
+                # disagreement meanwhile).
+                peer_epoch = body.get("ownership_epoch")
+                if (
+                    isinstance(peer_epoch, int)
+                    and peer_epoch > own_state.view.epoch
+                ):
+                    _m_stale_view.inc()
+                    log.warning(
+                        "stale ownership view: peer %s at epoch %d, local %d",
+                        body.get("replica"), peer_epoch, own_state.view.epoch,
+                    )
         return web.json_response({"ok": True, "fresh": fresh})
 
     # --- warn (micro-batched) -------------------------------------------
@@ -494,29 +733,22 @@ def make_app(
         except (KeyError, ValueError, ValidationError) as e:
             return _json_error(422, str(e))
         # Manual upserts replicate like ingest-classified rows do — an
-        # operator correction must not diverge the fleet's shards.
-        if plat.bus.has_subscribers(TOPIC_GFKB_REPLICATE):
-            from kakveda_tpu.events.bus import new_event_id
-
-            await plat.bus.publish(
-                TOPIC_GFKB_REPLICATE,
+        # operator correction must not diverge the fleet's shards. One
+        # publish path (Platform.replicate_rows) covers both the legacy
+        # broadcast and range-scoped ownership fan-out.
+        await plat.replicate_rows(
+            [
                 {
-                    "id": new_event_id(),
-                    "origin": plat.replica_id,
-                    "ts": time.time(),
-                    "rows": [
-                        {
-                            "failure_type": body["failure_type"],
-                            "signature_text": body["signature_text"],
-                            "app_id": body["app_id"],
-                            "impact_severity": body["impact_severity"],
-                            "context_signature": body.get("context_signature"),
-                            "root_cause": body.get("root_cause"),
-                            "resolution": body.get("resolution"),
-                        }
-                    ],
-                },
-            )
+                    "failure_type": body["failure_type"],
+                    "signature_text": body["signature_text"],
+                    "app_id": body["app_id"],
+                    "impact_severity": body["impact_severity"],
+                    "context_signature": body.get("context_signature"),
+                    "root_cause": body.get("root_cause"),
+                    "resolution": body.get("resolution"),
+                }
+            ]
+        )
         return web.json_response(
             {"ok": True, "created": created, "failure": rec.model_dump(mode="json")}
         )
@@ -645,6 +877,9 @@ def make_app(
             web.get("/topics", topics),
             web.post("/replicate", replicate),
             web.post("/fleet/gossip", fleet_gossip),
+            web.get("/fleet/ownership", fleet_ownership_get),
+            web.post("/fleet/ownership", fleet_ownership_post),
+            web.post("/fleet/export", fleet_export),
         ]
     )
     app.add_routes(metrics_routes())

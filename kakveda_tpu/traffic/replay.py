@@ -67,6 +67,9 @@ class ReplayResult:
     ttfts_ms: List[float] = field(default_factory=list)
     ladder_recovery_s: Optional[float] = None
     wall_s: float = 0.0
+    # Caller-stuffed side facts (e.g. scatter-gather partial counts from
+    # a custom post fn) — gates like SLO.max_partial_rate read these.
+    notes: Dict[str, float] = field(default_factory=dict)
 
     def latencies_ms(self, klass: str, phase: Optional[str] = None) -> List[float]:
         return [r["latency_ms"] for r in self.records
@@ -102,6 +105,7 @@ class ReplayResult:
             "late_p95_ms": self.late_p95_ms(),
             "ladder_recovery_s": self.ladder_recovery_s,
             "wall_s": round(self.wall_s, 3),
+            **({"notes": dict(self.notes)} if self.notes else {}),
         }
 
 
@@ -187,9 +191,16 @@ async def replay(events: List[dict], *, post: PostFn, speed: float = 1.0,
 
 async def run_chaos(timeline: List[dict], *, speed: float = 1.0,
                     supervisor=None, admission=None,
+                    callbacks: Optional[Dict[str, Callable]] = None,
                     t0: Optional[float] = None) -> List[dict]:
     """Apply chaos actions at their offsets (``t0`` lets the caller share
-    the replay's clock). Returns a log of applied/skipped actions."""
+    the replay's clock). Returns a log of applied/skipped actions.
+
+    ``callbacks`` maps extra action kinds to handles the caller owns
+    (e.g. ``{"rebalance": fn}`` for the rebalance-under-storm drill) —
+    a coroutine function is awaited, a plain callable runs off the event
+    loop. Still only existing seams: a missing handle skips-with-warning
+    like any other unknown action."""
     speed = max(1e-6, float(speed))
     loop = asyncio.get_running_loop()
     base = loop.time() if t0 is None else t0
@@ -223,6 +234,12 @@ async def run_chaos(timeline: List[dict], *, speed: float = 1.0,
                     admission.note_fleet_pressure(
                         float(act.get("pressure", 0.0)),
                         ttl_s=float(act.get("ttl_s", 5.0)))
+            elif callbacks and kind in callbacks:
+                fn = callbacks[kind]
+                if asyncio.iscoroutinefunction(fn):
+                    await fn(act)
+                else:
+                    await loop.run_in_executor(None, fn, act)
             else:
                 entry.update(applied=False, reason=f"unknown action {kind!r}")
         except Exception as ex:
@@ -256,6 +273,7 @@ async def run_scenario(scenario, *, post: PostFn, speed: float = 1.0,
                        max_concurrency: Optional[int] = None,
                        timeout_s: Optional[float] = None,
                        supervisor=None, admission=None,
+                       callbacks: Optional[Dict[str, Callable]] = None,
                        extra_dispatch: Optional[Dict[str, LocalFn]] = None,
                        recovery_horizon_s: float = 30.0) -> ReplayResult:
     """Replay a Scenario with its chaos timeline on the same clock, then
@@ -269,7 +287,8 @@ async def run_scenario(scenario, *, post: PostFn, speed: float = 1.0,
                    extra_dispatch=extra_dispatch, result=res)]
     if scenario.chaos:
         jobs.append(run_chaos(scenario.chaos, speed=speed, t0=t0,
-                              supervisor=supervisor, admission=admission))
+                              supervisor=supervisor, admission=admission,
+                              callbacks=callbacks))
     storm_end = scenario.notes.get("storm_end_s")
     if storm_end is not None and admission is not None:
         jobs.append(_watch_recovery(res, admission, float(storm_end),

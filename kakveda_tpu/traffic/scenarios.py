@@ -366,6 +366,57 @@ def storm(seed: int = 0, *, duration_s: float = 12.0, warn_rps: float = 40.0,
     )
 
 
+def rebalance_storm(seed: int = 0, *, duration_s: float = 10.0,
+                    warn_rps: float = 30.0, apps: int = 12,
+                    hot_share: float = 0.5, rebalance_frac: float = 0.35,
+                    kill_replica: Optional[int] = None,
+                    kill_frac: float = 0.7, gossip_ttl_s: float = 5.0,
+                    max_partial_rate: float = 0.1) -> Scenario:
+    """Sharded-ownership drill (fleet/ownership.py): steady warn traffic
+    while the fleet rebalances — and, optionally, an OWNER dies.
+
+    * phase ``baseline`` ``[0, rb)``: warn across ``apps`` keys.
+    * at ``rb`` the ``rebalance`` action fires — the driving test/bench
+      supplies the handle via run_chaos ``callbacks`` (add a replica +
+      run the range migration through the router's /fleet/rebalance);
+      warn keeps flowing open-loop through the migration.
+    * phase ``storm`` until ``kill``; at ``kill`` the named replica — an
+      owner — gets SIGTERM'd (supervisor.stop, never SIGKILL). Scatter-
+      gather must keep answering from standbys; the epoch push re-fences.
+    * phase ``recovery`` to the end: the ladder must be back to normal
+      within ``gossip_ttl_s``.
+
+    Zero lost warns + zero hung + sheds confined to interactive/
+    background + bounded partial-verdict rate IS the acceptance contract
+    (ISSUE 13); the ``ownership`` bench arm self-certifies it."""
+    rng = random.Random(seed)
+    rb = round(duration_s * rebalance_frac, 3)
+    kl = round(duration_s * kill_frac, 3)
+    phase = lambda t: "baseline" if t < rb else ("storm" if t < kl else "recovery")  # noqa: E731
+    events = [
+        _warn_event(t, _pick_app(rng, apps, hot_share), i, phase(t))
+        for i, t in enumerate(_arrivals(rng, duration_s, lambda _t: warn_rps))
+    ]
+    events.sort(key=lambda e: e["t"])
+    chaos: List[dict] = [{"t": rb, "action": "rebalance"}]
+    if kill_replica is not None:
+        chaos.append({"t": kl, "action": "kill_replica",
+                      "replica": int(kill_replica)})
+    return Scenario(
+        name="rebalance_storm", seed=seed, duration_s=duration_s,
+        events=events, chaos=chaos,
+        slo=SLO(
+            shed_only=("interactive", "background"),
+            zero_hung=True,
+            zero_lost=("warn",),
+            recovery_s=gossip_ttl_s,
+            max_partial_rate=max_partial_rate,
+        ),
+        notes={"storm_start_s": rb, "storm_end_s": kl,
+               "gossip_ttl_s": gossip_ttl_s},
+    )
+
+
 SCENARIOS: Dict[str, Callable[..., Scenario]] = {
     "diurnal": diurnal_wave,
     "hot_key": hot_key_skew,
@@ -373,6 +424,7 @@ SCENARIOS: Dict[str, Callable[..., Scenario]] = {
     "near_dup": adversarial_near_dup,
     "mixed": mixed_contention,
     "storm": storm,
+    "rebalance_storm": rebalance_storm,
 }
 
 

@@ -22,6 +22,9 @@ Gates (the storm bench row self-certifies all of them in-run):
   terminally accounted (ok/shed/degraded/error — never silently dropped).
 * ``recovery_s`` — the brownout ladder must be back at ``normal`` within
   this many seconds of ``storm_end_s`` (measured by the replayer).
+* ``max_partial_rate`` — sharded ownership: ceiling on the share of ok
+  warn verdicts whose scatter-gather merge was ``partial=true`` (a range
+  had no answering holder). Fed from ``ReplayResult.notes["partial"]``.
 
 Table of which scenario declares what: docs/robustness.md § traffic
 harness.
@@ -57,6 +60,11 @@ class SLO:
     zero_hung: bool = True
     zero_lost: Tuple[str, ...] = ("warn",)
     recovery_s: Optional[float] = None
+    # Sharded-ownership arm: ceiling on the fraction of ok warn verdicts
+    # the scatter-gather merge flagged partial=true (missing range
+    # coverage). Reads result.notes["partial"] — the caller's post fn
+    # counts partials there; no notes at all leaves the gate vacuous.
+    max_partial_rate: Optional[float] = None
 
 
 @dataclass
@@ -153,6 +161,18 @@ def evaluate(slo: SLO, result) -> SLOReport:
         c = counts.get(klass, {})
         lost = result.generated(klass) - sum(c.values())
         add(f"zero_lost[{klass}]", lost <= 0, lost, 0)
+
+    if slo.max_partial_rate is not None:
+        notes = getattr(result, "notes", {}) or {}
+        if "partial" in notes:
+            ok_warns = counts.get("warn", {}).get("ok", 0)
+            rate = (round(float(notes["partial"]) / ok_warns, 4)
+                    if ok_warns else 0.0)
+            add("max_partial_rate", rate <= slo.max_partial_rate,
+                rate, slo.max_partial_rate)
+        else:
+            add("max_partial_rate", True, "no partial accounting",
+                slo.max_partial_rate)
 
     if slo.recovery_s is not None:
         rec = result.ladder_recovery_s

@@ -1,0 +1,815 @@
+"""Sharded-ownership GFKB tests (fleet/ownership.py, docs/scale-out.md):
+placement determinism and R-scoping, exact arc/coverage accounting,
+scoped replication publish, scatter-gather top-k merge + partial-result
+contract, the ownership-epoch fence (incl. DLQ replay to a migrated
+range), applied-log compaction, router-verdict liveness unification, and
+the rebalance-under-storm chaos drill over real subprocess replicas."""
+
+import asyncio
+import dataclasses
+import json
+import time
+import uuid
+from datetime import datetime, timezone
+
+import pytest
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+
+from kakveda_tpu.core import faults
+from kakveda_tpu.fleet.ownership import (
+    MigrationError,
+    OwnershipState,
+    OwnershipView,
+    parse_members,
+    plan_targets,
+    responsible_source,
+    shard_key_of_row,
+)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def _members(n):
+    return {f"r{i}": f"http://127.0.0.1:{7000 + i}" for i in range(n)}
+
+
+def _rows(n, tag, app_of=lambda i: f"app-{i % 4}"):
+    return [
+        {
+            "failure_type": "TIMEOUT",
+            "signature_text": f"{tag} timeout calling service {i}",
+            "app_id": app_of(i),
+            "impact_severity": "medium",
+            "context_signature": {},
+            "root_cause": None,
+            "resolution": None,
+        }
+        for i in range(n)
+    ]
+
+
+# ---------------------------------------------------------------------------
+# placement: determinism, R-scoping, arcs, coverage holes
+# ---------------------------------------------------------------------------
+
+
+def test_view_holders_deterministic_and_r_scoped():
+    """Placement is a pure function of (members, R): two independently
+    built views agree on every key, holders are exactly R distinct
+    members led by the owner, and roles are consistent with the walk."""
+    a = OwnershipView(_members(4), replication=2)
+    b = OwnershipView(dict(reversed(list(_members(4).items()))), replication=2)
+    for i in range(300):
+        k = f"app-{i}"
+        h = a.holders(k)
+        assert h == b.holders(k)
+        assert len(h) == 2 and len(set(h)) == 2
+        assert a.owner(k) == h[0]
+        assert a.role(h[0], k) == "owner"
+        assert a.role(h[1], k) == "standby"
+        assert a.role("r-not-a-member", k) is None
+        assert a.is_holder(h[0], k) and a.is_holder(h[1], k)
+
+
+def test_view_replication_clamped_to_membership():
+    v = OwnershipView(_members(2), replication=5)
+    assert v.replication == 2  # R can never exceed the member count
+    solo = OwnershipView({"r0": ""}, replication=3)
+    assert solo.replication == 1 and solo.holders("k") == ["r0"]
+
+
+def test_view_arc_accounting_and_coverage_holes():
+    """Arc accounting is exact: every vnode arc carries an R-tuple, owned
+    counts sum to the arc total, and a coverage hole exists IFF an arc
+    lost its entire holder set."""
+    v = OwnershipView(_members(4), replication=2)
+    arcs = v.arcs()
+    assert arcs and all(len(a) == 2 for a in arcs)
+    assert sum(v.arc_counts(r)[0] for r in v.members) == len(arcs)
+    # Healthy fleet: zero holes. One member down with R=2: still zero.
+    assert v.coverage_holes(v.members) == 0
+    assert v.coverage_holes(["r0", "r1", "r2"]) == 0
+    # A single survivor cannot cover arcs held by the other three.
+    assert v.coverage_holes(["r0"]) > 0
+    assert v.coverage_holes([]) == len(arcs)
+
+
+def test_view_epoch_serialization_and_persistence(tmp_path):
+    v = OwnershipView(_members(3), replication=2, epoch=4)
+    assert v.with_epoch(9).epoch == 9
+    grown = v.with_members({**_members(3), "r3": "http://127.0.0.1:7003"})
+    assert grown.epoch == 5  # membership change bumps by default
+    rt = OwnershipView.from_dict(v.to_dict())
+    assert rt.epoch == 4 and rt.members == v.members
+    assert rt.holders("app-17") == v.holders("app-17")
+    p = tmp_path / "ownership.json"
+    grown.save(p)
+    back = OwnershipView.load(p)
+    assert back is not None and back.epoch == 5 and "r3" in back.members
+    assert OwnershipView.load(tmp_path / "missing.json") is None
+    p.write_text("{not json")
+    assert OwnershipView.load(p) is None  # corrupt view: rebuild, not crash
+
+
+def test_parse_members_and_shard_key():
+    assert parse_members("r0=http://h:1, r1=http://h:2/,,bad") == {
+        "r0": "http://h:1", "r1": "http://h:2",
+    }
+    assert parse_members("") == {}
+    assert shard_key_of_row({"app_id": "a", "signature_text": "s"}) == "a"
+    assert shard_key_of_row({"app_id": "", "signature_text": "s"}) == "s"
+    assert shard_key_of_row({}) == ""
+
+
+def test_rebalance_plan_is_bounded_and_single_sourced():
+    """Adding one member moves only the keys it gains (bounded movement),
+    each shipped by exactly one responsible source — the first surviving
+    OLD holder, so R-way replication guarantees it has the rows."""
+    old = OwnershipView(_members(3), replication=2)
+    new = old.with_members({**_members(3), "r3": "http://127.0.0.1:7003"})
+    keys = [f"app-{i}" for i in range(500)]
+    moved = 0
+    for k in keys:
+        targets = plan_targets(k, old, new)
+        assert set(targets) <= {"r3"}  # only the newcomer gains ranges
+        if targets:
+            moved += 1
+            src = responsible_source(k, old, sorted(old.members))
+            assert src in old.holders(k)
+    # ~R/N of keys gain a holder on scale-out 3 -> 4; generous slack.
+    assert 0.05 < moved / len(keys) < 0.75, moved
+    # A dead source is skipped; no surviving holder -> None.
+    k = keys[0]
+    h = old.holders(k)
+    assert responsible_source(k, old, [h[1]]) == h[1]
+    assert responsible_source(k, old, []) is None
+
+
+def test_run_rebalance_rejects_non_monotonic_epoch():
+    old = OwnershipView(_members(2), replication=2, epoch=3)
+    from kakveda_tpu.fleet.ownership import run_rebalance
+
+    with pytest.raises(ValueError):
+        run_rebalance(old, old.with_epoch(3))
+    with pytest.raises(MigrationError) as ei:
+        run_rebalance(
+            old, OwnershipView({"rX": "http://h:1"}, replication=1, epoch=4)
+        )
+    assert ei.value.flipped is False  # nothing changed; retry is safe
+
+
+# ---------------------------------------------------------------------------
+# scoped replication publish (platform.replicate_rows)
+# ---------------------------------------------------------------------------
+
+
+def test_replicate_rows_scoped_to_holders(tmp_path):
+    """Under ownership each row is published ONLY to the holders of its
+    shard key (minus self) on per-destination topics — never on the
+    legacy broadcast topic — and scoped events carry the epoch."""
+    from kakveda_tpu.events.bus import TOPIC_GFKB_REPLICATE, replicate_topic
+    from kakveda_tpu.platform import Platform
+
+    plat = Platform(data_dir=tmp_path / "a", capacity=128, dim=512)
+    view = OwnershipView(_members(3), replication=2, epoch=7)
+    plat.replica_id = "r0"
+    plat.ownership = OwnershipState(view, "r0")
+
+    got = {}
+    for rid in view.members:
+        plat.bus.subscribe(
+            replicate_topic(rid),
+            (lambda r: lambda ev: got.setdefault(r, []).append(ev))(rid),
+        )
+    broadcast = []
+    plat.bus.subscribe(TOPIC_GFKB_REPLICATE, broadcast.append)
+
+    rows = _rows(24, "scoped", app_of=lambda i: f"app-{i % 8}")
+    run(plat.replicate_rows(rows))
+
+    assert not broadcast  # never the legacy broadcast under ownership
+    assert "r0" not in got  # never to self
+    seen = {}
+    for rid, evs in got.items():
+        for ev in evs:
+            assert ev["epoch"] == 7 and ev["origin"] == "r0" and ev["id"]
+            for row in ev["rows"]:
+                assert view.is_holder(rid, shard_key_of_row(row))
+                seen.setdefault(rid, []).append(row["signature_text"])
+    # Every row reached every non-self holder of its key — exactly once.
+    for row in rows:
+        want = [r for r in view.holders(shard_key_of_row(row)) if r != "r0"]
+        for rid in want:
+            assert seen[rid].count(row["signature_text"]) == 1
+
+
+def test_replicate_rows_legacy_broadcast_unchanged(tmp_path):
+    """KAKVEDA_FLEET_OWNERSHIP off (ownership None): one broadcast event
+    on gfkb.replicate with ALL rows — the bit-for-bit legacy contract."""
+    from kakveda_tpu.events.bus import TOPIC_GFKB_REPLICATE
+    from kakveda_tpu.platform import Platform
+
+    plat = Platform(data_dir=tmp_path / "a", capacity=128, dim=512)
+    assert plat.ownership is None
+    broadcast = []
+    plat.bus.subscribe(TOPIC_GFKB_REPLICATE, broadcast.append)
+    rows = _rows(5, "legacy")
+    run(plat.replicate_rows(rows))
+    assert len(broadcast) == 1
+    assert broadcast[0]["rows"] == rows
+    assert "epoch" not in broadcast[0]
+
+
+# ---------------------------------------------------------------------------
+# scatter-gather merge + partial contract
+# ---------------------------------------------------------------------------
+
+
+def _merge_answers(scores_by_shard):
+    return {
+        rid: {
+            "ok": True,
+            "warning": bool(scores),
+            "confidence": max(scores, default=0.1),
+            "degraded": False,
+            "references": [
+                {"failure_id": f"{rid}-{i}", "score": s}
+                for i, s in enumerate(scores)
+            ],
+        }
+        for rid, scores in scores_by_shard.items()
+    }
+
+
+def test_merge_warn_global_topk_parity():
+    """The merged top-k is exactly the k best of the union of per-shard
+    top-ks (absolute scores), each reference tagged with its shard, and
+    the verdict body comes from the shard holding the best reference."""
+    from kakveda_tpu.fleet.router import _merge_warn
+
+    out = _merge_warn(_merge_answers({"r0": [0.9, 0.4], "r1": [0.8, 0.7]}))
+    assert [r["score"] for r in out["references"]] == [0.9, 0.8]
+    assert [r["shard"] for r in out["references"]] == ["r0", "r1"]
+    assert out["confidence"] == 0.9  # winning shard's own verdict body
+    # No shard matched: keep the most confident verdict, empty refs.
+    out = _merge_warn(_merge_answers({"r0": [], "r1": []}))
+    assert out["references"] == [] and out["ok"]
+
+
+def test_merge_matches_topk():
+    from kakveda_tpu.fleet.router import _merge_matches
+
+    answered = {
+        "r0": {"ok": True, "matches": [{"failure_id": "a", "score": 0.5}]},
+        "r1": {"ok": True, "matches": [{"failure_id": "b", "score": 0.6}]},
+    }
+    out = _merge_matches(answered)
+    assert [m["failure_id"] for m in out["matches"]] == ["b"]
+    assert out["matches"][0]["shard"] == "r1"
+
+
+def _shard_backend(name, refs=(), status=200, retry_after=None):
+    async def warn(request):
+        if status != 200:
+            headers = {"Retry-After": str(retry_after)} if retry_after else {}
+            return web.json_response(
+                {"ok": False, "error": "shed"}, status=status, headers=headers
+            )
+        return web.json_response(
+            {"ok": True, "warning": bool(refs), "confidence": 0.5,
+             "degraded": False, "served_by": name,
+             "references": [
+                 {"failure_id": f"{name}-{i}", "score": s}
+                 for i, s in enumerate(refs)
+             ]},
+        )
+
+    async def readyz(request):
+        return web.json_response({"ok": True})
+
+    app = web.Application()
+    app.add_routes([web.post("/warn", warn), web.get("/readyz", readyz)])
+    return app
+
+
+async def _scatter_fixture(backends_spec, replication):
+    """Start stub shards + an ownership router over them; returns
+    (router_client, cleanup)."""
+    from kakveda_tpu.fleet.router import make_router_app
+
+    clients = []
+    urls = {}
+    for rid, spec in backends_spec.items():
+        c = TestClient(TestServer(_shard_backend(rid, **spec)))
+        await c.start_server()
+        clients.append(c)
+        urls[rid] = str(c.make_url("")).rstrip("/")
+    router = make_router_app(
+        urls, probe_interval_s=30.0, eject_fails=5, retries=1, timeout_s=5.0,
+        ownership=OwnershipView(urls, replication=replication),
+    )
+    rc = TestClient(TestServer(router))
+    await rc.start_server()
+
+    async def cleanup():
+        await rc.close()
+        for c in clients:
+            await c.close()
+
+    return rc, cleanup
+
+
+def test_scatter_full_coverage_not_partial():
+    """Both shards answer: merged verdict is the global top-k with shard
+    provenance and partial=false (no arc lost its holders)."""
+
+    async def go():
+        rc, cleanup = await _scatter_fixture(
+            {"r0": {"refs": (0.9, 0.4)}, "r1": {"refs": (0.8, 0.7)}},
+            replication=1,
+        )
+        try:
+            r = await rc.post("/warn", json={"app_id": "app-1", "prompt": "x"})
+            body = await r.json()
+            assert r.status == 200
+            assert body["partial"] is False
+            assert "uncovered_ranges" not in body
+            assert body["shards"] == {"r0": "ok", "r1": "ok"}
+            assert [x["score"] for x in body["references"]] == [0.9, 0.8]
+            assert {x["shard"] for x in body["references"]} == {"r0", "r1"}
+        finally:
+            await cleanup()
+
+    run(go())
+
+
+@pytest.mark.chaos
+def test_scatter_partial_contract_on_shard_loss():
+    """Armed gfkb.scatter_gather (count=1): ONE shard sub-request dies
+    like a transport error. With R=1 the dead shard's arcs have no other
+    holder, so the merged verdict MUST say partial=true with the shard
+    marked unreachable — never a silently shrunk full answer, never a
+    hang, still HTTP 200 from the surviving coverage."""
+    faults.disarm()
+
+    async def go():
+        rc, cleanup = await _scatter_fixture(
+            {"r0": {"refs": (0.9,)}, "r1": {"refs": (0.8,)}},
+            replication=1,
+        )
+        try:
+            faults.arm("gfkb.scatter_gather:1.0:1")
+            r = await rc.post("/warn", json={"app_id": "app-1", "prompt": "x"})
+            body = await r.json()
+            assert r.status == 200
+            assert body["partial"] is True
+            assert body["uncovered_ranges"] > 0
+            assert sorted(body["shards"].values()) == ["ok", "unreachable"]
+            assert len(body["references"]) == 1  # surviving shard's answer
+        finally:
+            faults.disarm()
+            await cleanup()
+
+    run(go())
+
+
+@pytest.mark.chaos
+def test_scatter_shard_loss_with_standby_is_not_partial():
+    """Same single-shard loss under R=2: the standby holds every arc the
+    dead shard owned, so coverage is intact and partial stays false —
+    the whole point of R-way range replication."""
+    faults.disarm()
+
+    async def go():
+        rc, cleanup = await _scatter_fixture(
+            {"r0": {"refs": (0.9,)}, "r1": {"refs": (0.8,)}},
+            replication=2,
+        )
+        try:
+            faults.arm("gfkb.scatter_gather:1.0:1")
+            r = await rc.post("/warn", json={"app_id": "app-1", "prompt": "x"})
+            body = await r.json()
+            assert r.status == 200 and body["partial"] is False
+        finally:
+            faults.disarm()
+            await cleanup()
+
+    run(go())
+
+
+def test_scatter_all_shed_stays_typed_429():
+    """Every shard shedding: the merge does NOT fabricate a verdict — the
+    shed passes through typed (429 + max Retry-After), SHED-NEVER-HANG
+    end to end."""
+
+    async def go():
+        rc, cleanup = await _scatter_fixture(
+            {"r0": {"status": 429, "retry_after": 2},
+             "r1": {"status": 429, "retry_after": 5}},
+            replication=2,
+        )
+        try:
+            r = await rc.post("/warn", json={"app_id": "app-1", "prompt": "x"})
+            assert r.status == 429
+            assert r.headers["Retry-After"] == "5"
+            body = await r.json()
+            assert set(body["shards"].values()) == {"shed"}
+        finally:
+            await cleanup()
+
+    run(go())
+
+
+# ---------------------------------------------------------------------------
+# service tier: epoch fence, monotonic view swap, DLQ replay to a
+# migrated range (the satellite regression)
+# ---------------------------------------------------------------------------
+
+
+def _service_app(tmp_path, monkeypatch, members_spec, replication):
+    from kakveda_tpu.platform import Platform
+    from kakveda_tpu.service.app import make_app
+
+    monkeypatch.setenv("KAKVEDA_REPLICA_ID", "r0")
+    monkeypatch.setenv("KAKVEDA_FLEET_OWNERSHIP", "1")
+    monkeypatch.setenv("KAKVEDA_FLEET_MEMBERS", members_spec)
+    monkeypatch.setenv("KAKVEDA_FLEET_REPLICATION", str(replication))
+    monkeypatch.setenv("KAKVEDA_FLEET_GOSSIP_S", "30")
+    plat = Platform(data_dir=tmp_path / "r0", capacity=256, dim=512)
+    return plat, make_app(platform=plat)
+
+
+def _key_owned_by(view, rid, avoid=()):
+    for i in range(500):
+        k = f"app-{i}"
+        if view.owner(k) == rid and k not in avoid:
+            return k
+    raise AssertionError(f"no key owned by {rid}")
+
+
+def test_ownership_endpoint_monotonic_and_replicate_fence(tmp_path, monkeypatch):
+    """/fleet/ownership swaps only forward (stale pushes no-op) and
+    persists; /replicate fences stale-epoch rows this replica no longer
+    holds — dropped rows ack as a clean 2xx so at-least-once retires."""
+    members = "r0=http://127.0.0.1:1,r1=http://127.0.0.1:2"
+    plat, app = _service_app(tmp_path, monkeypatch, members, replication=1)
+    m2 = parse_members(members)
+    v1 = OwnershipView(m2, replication=1, epoch=1)
+    ka = _key_owned_by(v1, "r0")
+    kb = _key_owned_by(v1, "r1")
+
+    async def go(client):
+        r = await client.get("/fleet/ownership")
+        body = await r.json()
+        assert body["enabled"] and body["epoch"] == 1
+        assert set(body["members"]) == {"r0", "r1"}
+
+        # Current-epoch events apply whole (the fence is only for stale).
+        row_a = dict(_rows(1, "fence", app_of=lambda _i: ka)[0])
+        r = await client.post("/replicate", json={
+            "id": "e-base", "epoch": 1, "ts": time.time(), "rows": [row_a]})
+        assert (await r.json())["applied"] == 1
+
+        # Forward swap to epoch 3.
+        v3 = OwnershipView(m2, replication=1, epoch=3)
+        r = await client.post("/fleet/ownership", json=v3.to_dict())
+        body = await r.json()
+        assert body == {"ok": True, "stale": False, "epoch": 3}
+        # Stale push (epoch 2): no-op ack, view stays at 3.
+        r = await client.post(
+            "/fleet/ownership",
+            json=OwnershipView(m2, replication=1, epoch=2).to_dict(),
+        )
+        assert (await r.json()) == {"ok": True, "stale": True, "epoch": 3}
+        assert OwnershipView.load(tmp_path / "r0" / "ownership.json").epoch == 3
+
+        # Stale-epoch event for a range r0 never held: every row fenced,
+        # clean 2xx drop.
+        row_b = dict(_rows(1, "fence-b", app_of=lambda _i: kb)[0])
+        before = plat.gfkb.count
+        r = await client.post("/replicate", json={
+            "id": "e-stale", "epoch": 1, "ts": time.time(), "rows": [row_b]})
+        body = await r.json()
+        assert r.status == 200
+        assert body["applied"] == 0 and body["dropped"] == 1
+        assert body["reason"] == "stale_epoch"
+        assert plat.gfkb.count == before
+
+        # Mixed event: held rows apply, foreign rows fence.
+        row_a2 = dict(_rows(1, "fence-mix", app_of=lambda _i: ka)[0])
+        r = await client.post("/replicate", json={
+            "id": "e-mixed", "epoch": 2, "ts": time.time(),
+            "rows": [row_a2, dict(row_b)]})
+        body = await r.json()
+        assert body["applied"] == 1 and body["dropped"] == 1
+
+    async def wrap():
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            await go(client)
+        finally:
+            await client.close()
+
+    run(wrap())
+
+
+def test_dlq_replay_to_migrated_range_never_unmigrates(tmp_path, monkeypatch):
+    """The satellite regression: a gfkb.replicate event recorded before a
+    migration is re-delivered (DLQ replay) AFTER the range moved away.
+    It must dedup or cleanly drop — never double-insert at the old
+    holder, never re-materialize ('un-migrate') the departed range."""
+    members = "r0=http://127.0.0.1:1,r1=http://127.0.0.1:2"
+    plat, app = _service_app(tmp_path, monkeypatch, members, replication=1)
+    m2 = parse_members(members)
+    m3 = {**m2, "r2": "http://127.0.0.1:3"}
+    v1 = OwnershipView(m2, replication=1, epoch=1)
+    v2 = OwnershipView(m3, replication=1, epoch=2)
+    # A key r0 held at epoch 1 that MOVES to the newcomer at epoch 2.
+    moved = next(
+        k for i in range(500)
+        for k in [f"app-{i}"]
+        if v1.owner(k) == "r0" and v2.owner(k) == "r2"
+    )
+    kept = next(
+        k for i in range(500)
+        for k in [f"app-{i}"]
+        if v1.owner(k) == "r0" and v2.owner(k) == "r0"
+    )
+
+    async def go(client):
+        evt = {"id": "evt-premigration", "epoch": 1, "ts": time.time(),
+               "rows": [dict(_rows(1, "mig", app_of=lambda _i: moved)[0]),
+                        dict(_rows(1, "keep", app_of=lambda _i: kept)[0])]}
+        r = await client.post("/replicate", json=evt)
+        assert (await r.json())["applied"] == 2
+        count = plat.gfkb.count
+        occ = {rec.signature_text: rec.occurrences
+               for rec in plat.gfkb.list_failures()}
+
+        # The migration flips the view to epoch 2; `moved` now lives on r2.
+        r = await client.post("/fleet/ownership", json=v2.to_dict())
+        assert (await r.json())["epoch"] == 2
+
+        # DLQ replay of the SAME event: fence keeps only `kept`, whose
+        # apply dedups by event id — nothing changes anywhere.
+        r = await client.post("/replicate", json=evt)
+        body = await r.json()
+        assert r.status == 200 and body["applied"] == 0
+        assert body["dropped"] == 1
+        assert plat.gfkb.count == count
+        assert {rec.signature_text: rec.occurrences
+                for rec in plat.gfkb.list_failures()} == occ
+
+        # A NEW stale-epoch event for the migrated range: clean drop —
+        # re-delivery must never re-grow a range that moved away.
+        r = await client.post("/replicate", json={
+            "id": "evt-straggler", "epoch": 1, "ts": time.time(),
+            "rows": [dict(_rows(1, "mig2", app_of=lambda _i: moved)[0])]})
+        body = await r.json()
+        assert body["applied"] == 0 and body["reason"] == "stale_epoch"
+        assert plat.gfkb.count == count
+
+    async def wrap():
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            await go(client)
+        finally:
+            await client.close()
+
+    run(wrap())
+
+
+# ---------------------------------------------------------------------------
+# applied-log compaction (startup rewrite, bounded dedup tail)
+# ---------------------------------------------------------------------------
+
+
+def test_applied_log_compacts_on_startup(tmp_path, monkeypatch):
+    from kakveda_tpu.index.gfkb import GFKB
+
+    monkeypatch.setenv("KAKVEDA_GFKB_APPLIED_MAX", "8")
+    kb = GFKB(data_dir=tmp_path / "d", capacity=256, dim=512)
+    for i in range(20):
+        assert kb.apply_replication(_rows(1, f"ev{i}"), f"evt-{i}") == 1
+    kb.close()
+    applied = tmp_path / "d" / "applied_events.jsonl"
+    assert len(applied.read_text().splitlines()) == 20  # append-only live
+
+    kb2 = GFKB(data_dir=tmp_path / "d", capacity=256, dim=512)
+    lines = applied.read_text().splitlines()
+    assert len(lines) == 8  # compacted to the retained FIFO tail
+    assert json.loads(lines[-1])["id"] == "evt-19"
+    # Recent ids still dedup; rows are intact.
+    assert kb2.apply_replication(_rows(1, "ev19"), "evt-19") == 0
+    assert kb2.count == 20
+    kb2.close()
+
+
+def test_applied_log_compaction_opt_out(tmp_path, monkeypatch):
+    from kakveda_tpu.index.gfkb import GFKB
+
+    monkeypatch.setenv("KAKVEDA_GFKB_APPLIED_MAX", "4")
+    monkeypatch.setenv("KAKVEDA_GFKB_APPLIED_COMPACT", "0")
+    kb = GFKB(data_dir=tmp_path / "d", capacity=64, dim=512)
+    for i in range(10):
+        kb.apply_replication(_rows(1, f"ev{i}"), f"evt-{i}")
+    kb.close()
+    kb2 = GFKB(data_dir=tmp_path / "d", capacity=64, dim=512)
+    applied = tmp_path / "d" / "applied_events.jsonl"
+    assert len(applied.read_text().splitlines()) == 10  # untouched
+    kb2.close()
+
+
+# ---------------------------------------------------------------------------
+# one liveness world-view: router verdicts folded into FleetView
+# ---------------------------------------------------------------------------
+
+
+def test_fleetview_router_verdicts_gate_pressure():
+    """A peer the router's probe verdict marks dead stops pinning the
+    pressure floor immediately (not after its sample's TTL), the router's
+    own synthetic sample never counts as occupancy, and per-peer
+    ownership epochs surface for doctor's agreement check."""
+    from kakveda_tpu.fleet.gossip import FleetView
+
+    fv = FleetView(ttl_s=10.0)
+    assert fv.fold({"replica": "rA", "seq": 1, "ts": time.time(),
+                    "occupancy": 0.9, "ownership_epoch": 4})
+    assert fv.fleet_pressure() == pytest.approx(0.9)
+    assert fv.fold({"replica": FleetView.ROUTER_SENDER, "seq": 1,
+                    "ts": time.time(), "occupancy": 0.0,
+                    "probe_verdicts": {"rA": False}})
+    assert fv.probe_verdicts() == {"rA": False}
+    assert fv.fleet_pressure() == 0.0  # dead peer skipped, router excluded
+    # Verdict flips back: the same sample counts again.
+    assert fv.fold({"replica": FleetView.ROUTER_SENDER, "seq": 2,
+                    "ts": time.time(), "occupancy": 0.0,
+                    "probe_verdicts": {"rA": True}})
+    assert fv.fleet_pressure() == pytest.approx(0.9)
+    assert fv.ownership_epochs() == {"rA": 4}
+
+
+# ---------------------------------------------------------------------------
+# the rebalance-under-storm chaos drill (real subprocess replicas)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_rebalance_under_storm_drill(tmp_path):
+    """ISSUE 13 acceptance drill: a 2-replica ownership fleet (R=2) under
+    steady warn traffic scales out to 3 via the range-migration protocol
+    (snapshot-ship -> flip -> drain, driven by the router's
+    /fleet/rebalance), then an OWNER gets SIGTERM'd mid-storm. Zero lost
+    warns, zero hung, zero errors, sheds confined to sheddable classes,
+    bounded partial-verdict rate, and the survivors converge on the
+    promoted epoch within the gossip TTL."""
+    import yaml
+
+    from kakveda_tpu.fleet.router import ROUTER_KEY, make_router_app
+    from kakveda_tpu.fleet.supervisor import FleetSupervisor, pick_port_base
+    from kakveda_tpu.traffic.replay import run_scenario
+    from kakveda_tpu.traffic.scenarios import make_scenario
+    from kakveda_tpu.traffic.slo import evaluate
+
+    cfg = tmp_path / "config.yaml"
+    cfg.write_text(yaml.safe_dump({
+        "failure_matching": {
+            "similarity_threshold": 0.8, "embedding_dim": 512, "top_k": 5,
+        }
+    }))
+    sup = FleetSupervisor(
+        tmp_path, port_base=pick_port_base(4), replicas=2,
+        env={
+            "JAX_PLATFORMS": "cpu",
+            "KAKVEDA_CONFIG_PATH": str(cfg),
+            "KAKVEDA_INDEX_CAPACITY": "1024",
+            "KAKVEDA_FLEET_OWNERSHIP": "1",
+            "KAKVEDA_FLEET_REPLICATION": "2",
+            "KAKVEDA_FLEET_GOSSIP_S": "0.2",
+            "KAKVEDA_BUS_RETRIES": "2",
+            "KAKVEDA_BUS_RETRY_BASE": "0.01",
+            "KAKVEDA_GC_TUNE": "0",
+        },
+    )
+    sc = make_scenario(
+        "rebalance_storm", seed=7, duration_s=8.0, warn_rps=10.0, apps=8,
+        kill_replica=0, gossip_ttl_s=5.0, max_partial_rate=0.1,
+    )
+    partials = 0
+
+    def _trace(app_id, i):
+        from kakveda_tpu.models.runtime import STUB_RESPONSE
+
+        return {
+            "trace_id": str(uuid.uuid4()),
+            "ts": datetime.now(timezone.utc).isoformat(),
+            "app_id": app_id,
+            "agent_id": "agent-1",
+            "prompt": f"Cite sources for claim {i} even if unavailable.",
+            "response": STUB_RESPONSE,
+            "model": "stub", "tools": [], "env": {"os": "linux"},
+        }
+
+    async def go():
+        nonlocal partials
+        import httpx
+
+        router_app = make_router_app(
+            sup.backend_map(), probe_interval_s=0.3, eject_fails=2,
+            retries=1, timeout_s=10.0,
+            ownership=OwnershipView(sup.backend_map(), replication=2),
+        )
+        rc = TestClient(TestServer(router_app))
+        await rc.start_server()
+        try:
+            # Seed a corpus through the router (keyed ingest; accepted
+            # rows replicate range-scoped to their holders).
+            for b in range(4):
+                r = await rc.post("/ingest/batch", json={
+                    "traces": [_trace(f"app-{b * 2 + j % 2}", b * 8 + j)
+                               for j in range(6)]})
+                assert r.status == 200, await r.text()
+
+            # Pre-spawn the newcomer so the chaos callback only drives
+            # the migration protocol (process bring-up is not the drill).
+            idx = await asyncio.get_running_loop().run_in_executor(
+                None, sup.add_replica)
+            await asyncio.get_running_loop().run_in_executor(
+                None, sup.wait_ready, 180.0)
+
+            async def post(path, body):
+                resp = await rc.post(path, json=body)
+                nonlocal partials
+                try:
+                    data = await resp.json()
+                except Exception:
+                    data = None
+                    await resp.read()
+                if isinstance(data, dict) and data.get("partial"):
+                    partials += 1
+                return resp.status
+
+            async def rebalance_cb(act):
+                r = await rc.post("/fleet/rebalance", json={
+                    "add": {"id": sup.replica_id(idx), "url": sup.url(idx)}})
+                body = await r.json()
+                assert r.status == 200 and body["ok"], body
+                assert body["epoch"] == 2
+
+            res = await run_scenario(
+                sc, post=post, timeout_s=15.0, supervisor=sup,
+                callbacks={"rebalance": rebalance_cb},
+            )
+            res.notes["partial"] = float(partials)
+
+            # Epoch convergence: ejection of the dead owner promotes the
+            # view (>= 3) and the push lands on every survivor within the
+            # gossip TTL.
+            router = router_app[ROUTER_KEY]
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                if router.ownership.epoch >= 3 and "r0" in router.ejected():
+                    break
+                await asyncio.sleep(0.2)
+            assert router.ownership.epoch >= 3, router.ownership.epoch
+            assert "r0" in router.ejected()
+            async with httpx.AsyncClient(timeout=5.0) as hc:
+                for i in (1, 2):
+                    resp = await hc.get(sup.url(i) + "/fleet/ownership")
+                    body = resp.json()
+                    assert body["epoch"] >= 3, (i, body)
+                    assert set(body["members"]) == {"r0", "r1", "r2"}
+
+            # Survivor coverage is whole: no arc lost all its holders.
+            r = await rc.get("/readyz")
+            rep = await r.json()
+            assert rep["ownership"]["coverage_holes"] == 0
+            assert rep["fleet"]["brownout"] == "normal"
+            return res
+        finally:
+            await rc.close()
+
+    try:
+        sup.start_all()
+        sup.wait_ready(timeout_s=180.0)
+        res = run(go())
+    finally:
+        sup.stop_all()
+        faults.disarm()
+
+    # Ladder recovery is measured in-process by the admission handle the
+    # drill doesn't have; the router-side brownout check above covers it.
+    slo = dataclasses.replace(sc.slo, recovery_s=None)
+    report = evaluate(slo, res)
+    assert report.ok, report.summary()
+    counts = res.class_counts().get("warn", {})
+    assert res.generated("warn") > 40
+    assert counts.get("ok", 0) == res.generated("warn")  # zero lost, zero
+    assert counts.get("shed", 0) == 0                    # shed, zero hung,
+    assert counts.get("hung", 0) == 0                    # zero errors
+    assert counts.get("error", 0) == 0
