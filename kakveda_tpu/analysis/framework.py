@@ -170,6 +170,7 @@ def register(cls):
 def all_rules() -> Dict[str, Rule]:
     """The registry, loading the project rules on first use."""
     from kakveda_tpu.analysis import concurrency as _concurrency  # noqa: F401
+    from kakveda_tpu.analysis import device as _device  # noqa: F401
     from kakveda_tpu.analysis import rules as _rules  # noqa: F401  (registers)
 
     return dict(sorted(_REGISTRY.items()))

@@ -518,139 +518,13 @@ class StatsLockDiscipline(Rule):
 
 
 # ---------------------------------------------------------------------------
-# host-sync
+# host-sync — RELOCATED to kakveda_tpu/analysis/device.py: the jit-body
+# checks now share the device family's JitIndex discovery (same rule id,
+# same messages). The device-plane rules (retrace-hazard,
+# donation-after-use, constant-capture, dynamic-slice-by-trace) live there.
 # ---------------------------------------------------------------------------
 
 _NP_NAMES = frozenset({"np", "onp", "numpy"})
-
-
-@register
-class HostSyncHazards(Rule):
-    id = "host-sync"
-    invariant = (
-        "no host synchronization (.item()/.tolist()/np.asarray/float(arg)) "
-        "inside jit-compiled bodies in models/ and ops/, and no "
-        "jnp.asarray(self.<mirror>_np) upload without .copy() — the CPU "
-        "backend aliases numpy buffers zero-copy"
-    )
-    scope = ("kakveda_tpu/models/", "kakveda_tpu/ops/")
-
-    def visit_file(self, fc: FileContext, ctx: TreeContext) -> List[Finding]:
-        out: List[Finding] = []
-        jit_names: Set[str] = set()
-        func_nodes: Dict[str, ast.AST] = {}
-        jit_nodes: List[ast.AST] = []
-
-        for n in ast.walk(fc.tree):
-            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                func_nodes.setdefault(n.name, n)
-                if any(self._is_jit_decorator(d) for d in n.decorator_list):
-                    jit_nodes.append(n)
-            elif isinstance(n, ast.Call):
-                # x = jax.jit(fn) / jax.jit(self._impl, …)
-                if self._is_jit_ref(n.func) and n.args:
-                    a = n.args[0]
-                    if isinstance(a, ast.Name):
-                        jit_names.add(a.id)
-                    elif isinstance(a, ast.Attribute):
-                        jit_names.add(a.attr)
-                # jax.lax.scan(body, …): body is traced like a jit fn
-                elif (
-                    isinstance(n.func, ast.Attribute)
-                    and n.func.attr == "scan"
-                    and n.args
-                    and isinstance(n.args[0], ast.Name)
-                ):
-                    jit_names.add(n.args[0].id)
-
-        for name in jit_names:
-            node = func_nodes.get(name)
-            if node is not None and node not in jit_nodes:
-                jit_nodes.append(node)
-
-        for func in jit_nodes:
-            params = {a.arg for a in func.args.args + func.args.kwonlyargs}
-            for n in ast.walk(func):
-                if not isinstance(n, ast.Call):
-                    continue
-                msg = None
-                if isinstance(n.func, ast.Attribute):
-                    if n.func.attr in ("item", "tolist"):
-                        msg = f".{n.func.attr}() forces a device→host sync"
-                    elif (
-                        n.func.attr in ("asarray", "array")
-                        and isinstance(n.func.value, ast.Name)
-                        and n.func.value.id in _NP_NAMES
-                    ):
-                        msg = (
-                            f"{n.func.value.id}.{n.func.attr}() on a traced "
-                            "value forces a device→host sync"
-                        )
-                    elif (
-                        n.func.attr == "device_get"
-                        and isinstance(n.func.value, ast.Name)
-                        and n.func.value.id == "jax"
-                    ):
-                        msg = "jax.device_get() forces a device→host sync"
-                elif (
-                    isinstance(n.func, ast.Name)
-                    and n.func.id in ("float", "int", "bool")
-                    and len(n.args) == 1
-                    and isinstance(n.args[0], ast.Name)
-                    and n.args[0].id in params
-                ):
-                    msg = (
-                        f"{n.func.id}() on traced argument "
-                        f"`{n.args[0].id}` forces a device→host sync"
-                    )
-                if msg is not None:
-                    out.append(Finding(
-                        self.id, fc.rel, n.lineno,
-                        f"inside jit-compiled `{func.name}`: {msg} "
-                        "(~70-90 ms wire RTT per dispatch on tunneled TPUs)",
-                    ))
-
-        # Mutable-mirror aliasing: jnp.asarray(self.<x>_np) without .copy().
-        for n in ast.walk(fc.tree):
-            if (
-                isinstance(n, ast.Call)
-                and isinstance(n.func, ast.Attribute)
-                and n.func.attr == "asarray"
-                and isinstance(n.func.value, ast.Name)
-                and n.func.value.id == "jnp"
-                and n.args
-                and isinstance(n.args[0], ast.Attribute)
-                and n.args[0].attr.endswith("_np")
-            ):
-                out.append(Finding(
-                    self.id, fc.rel, n.lineno,
-                    f"jnp.asarray(…{n.args[0].attr}) without .copy(): on the "
-                    "CPU backend the upload aliases the mutating numpy "
-                    "mirror zero-copy (flaky garbage logits)",
-                ))
-        return out
-
-    @staticmethod
-    def _is_jit_ref(node: ast.AST) -> bool:
-        return (isinstance(node, ast.Name) and node.id == "jit") or (
-            isinstance(node, ast.Attribute) and node.attr == "jit"
-        )
-
-    @classmethod
-    def _is_jit_decorator(cls, dec: ast.AST) -> bool:
-        if cls._is_jit_ref(dec):
-            return True
-        if isinstance(dec, ast.Call):
-            if cls._is_jit_ref(dec.func):
-                return True
-            # @partial(jax.jit, static_argnames=…)
-            if (
-                isinstance(dec.func, ast.Name) and dec.func.id == "partial"
-            ) or (
-                isinstance(dec.func, ast.Attribute) and dec.func.attr == "partial"
-            ):
-                return any(cls._is_jit_ref(a) for a in dec.args)
-        return False
 
 
 # ---------------------------------------------------------------------------

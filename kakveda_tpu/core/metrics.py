@@ -476,6 +476,12 @@ _CORE_FAMILIES = (
     ("histogram", "kakveda_device_block_seconds",
      "Host wall of profiling.annotate()-labeled device blocks, keyed by "
      "annotation name", ("name",), None),
+    ("counter", "kakveda_compile_total",
+     "XLA backend compiles attributed per jit entry point "
+     "(KAKVEDA_LEDGER=1)", ("fn",), None),
+    ("counter", "kakveda_transfer_bytes",
+     "Host<->device transfer bytes by direction and request phase "
+     "(KAKVEDA_LEDGER=1)", ("direction", "phase"), None),
 )
 
 _REGISTRY = MetricsRegistry()

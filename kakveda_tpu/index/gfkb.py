@@ -43,6 +43,7 @@ from jax.sharding import Mesh
 
 from kakveda_tpu import native
 from kakveda_tpu.core import faults as _faults
+from kakveda_tpu.core import ledger as _ledger
 from kakveda_tpu.core import metrics as _metrics
 from kakveda_tpu.core import profiling
 
@@ -1258,6 +1259,14 @@ class GFKB:
         own log AFTER the row lines, so a crash between the two replays the
         rows on redelivery (an occurrence bump) rather than losing them.
         """
+        # Ledger attribution: embed/scatter compiles and uploads land on
+        # the ingest entry/phase.
+        with _ledger.entry("ingest"), _ledger.phase("ingest"):
+            return self._upsert_failures_batch(items, event_id)
+
+    def _upsert_failures_batch(
+        self, items: Sequence[dict], event_id: Optional[str] = None
+    ) -> List[Tuple[CanonicalFailureRecord, bool]]:
         out: List[Tuple[CanonicalFailureRecord, bool]] = []
         new_slots: List[int] = []
         new_texts: List[str] = []
@@ -1762,6 +1771,17 @@ class GFKB:
         embedding work, capacity-growth re-embeds (both off-lock now), or
         other matches' result fetches.
         """
+        # Ledger attribution: any compile or transfer below lands on the
+        # warn entry/phase (lambda jits inherit the ambient entry).
+        with _ledger.entry("warn"), _ledger.phase("warn"):
+            return self._match_batch_info(signature_texts, failure_type, type_filter)
+
+    def _match_batch_info(
+        self,
+        signature_texts: Sequence[str],
+        failure_type: Optional[str] = None,
+        type_filter: str = "post",
+    ) -> Tuple[List[List[FailureMatch]], dict]:
         # Sparse query form: (idx, val) pairs ship ~60× fewer bytes per
         # pre-flight check than dense rows; the device densifies before the
         # same top-k (identical scores). topk_async_sparse buckets ragged

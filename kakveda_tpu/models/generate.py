@@ -81,11 +81,11 @@ def _prefill_width(plen: int, chunk: int) -> int:
 def _bucket_len(need: int, cap: int) -> int:
     """Power-of-two cache window ≥ need (capped): the window is part of the
     compiled program signature, so exact-fit lengths would recompile for
-    every distinct prompt length."""
-    ml = 64
-    while ml < need:
-        ml <<= 1
-    return min(ml, cap)
+    every distinct prompt length. Thin wrapper over the ONE blessed bucket
+    seam (``ops/knn.pow2_bucket``) with the decode floor/cap semantics."""
+    from kakveda_tpu.ops.knn import pow2_bucket
+
+    return pow2_bucket(need, floor=64, cap=cap)
 
 
 def _pack_prompts(prompts: list[list[int]], ml: int, plen: Optional[int] = None):

@@ -72,6 +72,7 @@ from kakveda_tpu.core import admission as _admission
 from kakveda_tpu.core import faults as _faults
 from kakveda_tpu.core import metrics as _metrics
 from kakveda_tpu.core.admission import DeviceUnavailableError, OverloadError
+from kakveda_tpu.core import ledger as _ledger
 from kakveda_tpu.core import sanitize
 from kakveda_tpu.models.llama import (
     LlamaConfig,
@@ -596,11 +597,12 @@ class ContinuousBatcher:
         """Admission pad width: power-of-two ≥ prompt (min 8), capped at
         the slot window. THE definition shared by admit() and
         ServingEngine.fits() — the engine's fallback contract (never admit
-        what would truncate) depends on the two staying identical."""
-        bucket = 8
-        while bucket < prompt_len:
-            bucket <<= 1
-        return min(bucket, max_len - 1)
+        what would truncate) depends on the two staying identical. Thin
+        wrapper over the ONE blessed bucket seam (``ops/knn.pow2_bucket``)
+        with the admission floor/clamp semantics."""
+        from kakveda_tpu.ops.knn import pow2_bucket
+
+        return pow2_bucket(prompt_len, floor=8, cap=max_len - 1)
 
     def register_prefix(self, prefix_ids: List[int]) -> bool:
         """Precompute and retain the K/V rows of a shared prompt prefix so
@@ -822,6 +824,11 @@ class ContinuousBatcher:
         t_dispatch = time.perf_counter()
         self._grow_valid(self.chunk_steps)
 
+        _ledger.note_transfer(
+            "h2d",
+            self._pos_np.nbytes + self._kv_np.nbytes + self._off_np.nbytes
+            + self._temp_np.nbytes,
+        )
         self.cache, self.last, _, self.rng, toks = _step_chunk_jit(
             self.params, self.cfg, self.cache, self.last, jnp.asarray(self._pos_np.copy()),
             jnp.asarray(self._kv_np.copy()), jnp.asarray(self._off_np.copy()),
@@ -850,6 +857,7 @@ class ContinuousBatcher:
         self._fault_fetch.fire()
         toks, snapshot, t_dispatch = handle
         toks_h = np.asarray(toks)
+        _ledger.note_transfer("d2h", toks_h.nbytes)
         # Gate denominator: dispatch→process is the chunk's EFFECTIVE
         # wall — under pipelining the fetch overlapped the next chunk's
         # device work, so this interval is the overlapped cost the spec
@@ -1070,6 +1078,11 @@ class ContinuousBatcher:
             if self._spec_pos_dev is not None
             else jnp.asarray(self._pos_np.copy())
         )
+        _ledger.note_transfer(
+            "h2d",
+            self._kv_np.nbytes + self._off_np.nbytes
+            + getattr(drafts, "nbytes", 0),
+        )
         self.cache, self.last, self._spec_pos_dev, toks, counts = _spec_chunk_jit(
             self.params, self.cfg, self.cache, self.last, slot_pos,
             jnp.asarray(self._kv_np.copy()), jnp.asarray(self._off_np.copy()),
@@ -1094,6 +1107,7 @@ class ContinuousBatcher:
         toks, counts, snapshot, k, kmap, pmap, t_dispatch = handle
         toks_h = np.asarray(toks)
         counts_h = np.asarray(counts).astype(np.int32)
+        _ledger.note_transfer("d2h", toks_h.nbytes + counts_h.nbytes)
         self._spec_pending -= 1
         self._spec_pending_width -= k + 1
         wall = time.perf_counter() - t_dispatch
@@ -1840,7 +1854,11 @@ class ServingEngine:
         backoff = 0.1
         while True:
             try:
-                self._serve()
+                # Ledger attribution: compiles/uploads from the loop thread
+                # land on the serve entry / decode phase (module-level jits
+                # self-label with their fn names when created post-install).
+                with _ledger.entry("serve.loop"), _ledger.phase("decode"):
+                    self._serve()
                 break  # clean close() exit
             except BaseException as e:  # noqa: BLE001 — a dead loop must not strand callers
                 # A device/runtime error escaping a chunk would otherwise

@@ -119,11 +119,11 @@ def _corpus_pad(n: int) -> int:
     (≥ _BLOCK). Padding only to the next _BLOCK multiple re-specializes
     ``_block_topk`` on every 1024-row boundary the GFKB crosses — O(N)
     compiles over a growing corpus; pow2 buckets make it O(log N), and the
-    pad rows are valid-masked so results are identical."""
-    p = _BLOCK
-    while p < n:
-        p <<= 1
-    return p
+    pad rows are valid-masked so results are identical. Thin wrapper over
+    the ONE blessed bucket seam (``ops/knn.pow2_bucket``)."""
+    from kakveda_tpu.ops.knn import pow2_bucket
+
+    return pow2_bucket(n, floor=_BLOCK)
 
 
 def build_knn_edges(
@@ -145,12 +145,12 @@ def build_knn_edges(
     )
     vc = v if exact else _project(v, _MINE_DIM)
 
-    pad = _corpus_pad(n) - n
-    if pad:
-        vc_p = jnp.concatenate([vc, jnp.zeros((pad, vc.shape[1]), vc.dtype)], axis=0)
+    total = _corpus_pad(n)  # bucketed corpus length — never size by raw n
+    if total != n:
+        vc_p = jnp.zeros((total, vc.shape[1]), vc.dtype).at[:n].set(vc)
     else:
         vc_p = vc
-    valid = jnp.arange(n + pad) < n
+    valid = jnp.arange(total) < n
 
     # Dispatch every query block up front (async), then drain fetches — the
     # device computes block i+1 while the host pulls block i's packed

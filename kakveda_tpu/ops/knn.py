@@ -27,6 +27,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from kakveda_tpu.core import ledger
 from kakveda_tpu.ops import pallas_knn
 from kakveda_tpu.parallel.mesh import shard_map as _shard_map
 
@@ -190,6 +191,7 @@ class ShardedKnn:
         same value (the SPMD contract: all hosts see the same log/queries),
         which is exactly what device_put-to-replicated supports under
         multi-controller JAX."""
+        ledger.note_transfer("h2d", getattr(x, "nbytes", 0))
         return jax.device_put(x, self._repl)
 
     def scatter_i32(self, arr: jax.Array, slots: np.ndarray, values: np.ndarray) -> jax.Array:
@@ -399,6 +401,7 @@ class ShardedKnn:
     def topk_result(self, packed: jax.Array) -> Tuple[np.ndarray, np.ndarray]:
         """(scores, logical slots) from a ``topk_async`` buffer."""
         host = np.asarray(packed)
+        ledger.note_transfer("d2h", host.nbytes)
         kk = host.shape[1] // 2
         vals = host[:, :kk]
         phys = host[:, kk:].astype(np.int64)
@@ -411,10 +414,26 @@ class ShardedKnn:
         return self.topk_result(self.topk_async(emb, valid, q))
 
 
+def pow2_bucket(n: int, *, floor: int = 1, cap: int | None = None) -> int:
+    """THE blessed pow2-bucket seam: smallest power-of-two ≥ ``n`` starting
+    from ``floor`` (itself a power of two), optionally clamped to ``cap``.
+
+    Every data-dependent Python size that becomes a jit argument shape must
+    round through here (directly or via the thin wrappers ``batch_bucket``,
+    ``generate._bucket_len``, ``ContinuousBatcher.bucket_for``) — bucketed
+    shapes bound distinct lowerings to O(log N) while exact-fit shapes
+    retrace per distinct size, and on the tunneled TPU one retrace costs
+    more than the kernel it wraps. The static ``retrace-hazard`` rule
+    (kakveda_tpu/analysis/device.py) recognizes exactly this seam; the
+    runtime ledger (core/ledger.py) cross-checks the compile counts.
+    """
+    b = floor
+    while b < n:
+        b <<= 1
+    return b if cap is None else min(b, cap)
+
+
 @functools.lru_cache(maxsize=8)
 def batch_bucket(b: int) -> int:
     """Pad query batches to power-of-two buckets so jit never retraces."""
-    n = 1
-    while n < b:
-        n <<= 1
-    return n
+    return pow2_bucket(b)

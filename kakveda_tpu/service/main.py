@@ -79,6 +79,15 @@ def run_server(
     from kakveda_tpu.parallel.distributed import initialize_multihost
 
     initialize_multihost()
+
+    # Compile-and-transfer ledger (KAKVEDA_LEDGER=1) installs BEFORE the
+    # Platform so its jit wrapping covers the match/ingest programs built
+    # at construction; /metrics then carries kakveda_compile_total and
+    # kakveda_transfer_bytes (docs/observability.md).
+    from kakveda_tpu.core import ledger
+
+    if ledger.maybe_install():
+        log.info("compile-and-transfer ledger installed (KAKVEDA_LEDGER=1)")
     plat = Platform(data_dir=data_dir or cfg.data_dir, capacity=cfg.index_capacity)
 
     # Generational-GC tuning for the streaming path: ingest allocates ~2k

@@ -1,0 +1,66 @@
+#!/usr/bin/env bash
+# One-shot static verification: everything that must be green before a
+# commit, without touching a backend or waiting on the full test suite.
+#
+#   bash scripts/verify_static.sh            # whole tree (~5 s)
+#   bash scripts/verify_static.sh --changed  # git-dirty files only
+#
+# Runs, in order:
+#   1. the invariant lint (all 16 rules incl. the device-plane pass;
+#      --changed narrows to per-file rules over dirty files)
+#   2. the knob/fault-site parity check (legacy check_knobs CLI)
+#   3. a ledger smoke: KAKVEDA_LEDGER=1 install/attribute/uninstall on a
+#      throwaway jit — proves the runtime half of the device pass wires
+#      up on this interpreter (jax import, monitoring listener, metrics
+#      families) without a TPU.
+#
+# Exit: non-zero on the first failing stage. Tier-1 runs this via
+# tests/test_verify_static.py, so CI and the pre-commit habit share one
+# entry point.
+
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+CHANGED=""
+if [[ "${1:-}" == "--changed" ]]; then
+    CHANGED="--changed"
+fi
+
+echo "== invariant lint =="
+python scripts/lint_invariants.py ${CHANGED}
+
+echo "== knob / fault-site parity =="
+python scripts/check_knobs.py
+
+echo "== ledger smoke =="
+KAKVEDA_LEDGER=1 python - <<'EOF'
+import jax
+
+jax.config.update("jax_platforms", "cpu")  # never touch the remote TPU
+import jax.numpy as jnp
+
+from kakveda_tpu.core import ledger
+
+assert ledger.maybe_install(), "KAKVEDA_LEDGER=1 set but install refused"
+try:
+    @jax.jit
+    def _smoke(x):
+        return x * 2.0
+
+    with ledger.phase("smoke"):
+        _smoke(jnp.zeros((4,), jnp.float32)).block_until_ready()
+        ledger.note_transfer("h2d", 16)
+    rep = ledger.ledger_report()
+    assert rep["compiles"].get("_smoke") == 1, rep["compiles"]
+    assert rep["transfer_by_phase"]["h2d"]["smoke"] == 16, rep
+    from kakveda_tpu.core import metrics
+
+    text = metrics.get_registry().render()
+    assert 'kakveda_compile_total{fn="_smoke"}' in text
+    print("ledger smoke: ok — 1 compile attributed, 16 bytes phased")
+finally:
+    ledger.uninstall()
+    ledger.reset()
+EOF
+
+echo "verify_static: all stages green"

@@ -1,0 +1,214 @@
+"""Runtime compile-and-transfer ledger (core/ledger.py, KAKVEDA_LEDGER=1).
+
+The headline test is the N-vs-log(N) pair: feeding an UNBUCKETED jit a
+ragged stream of batch sizes costs one XLA compile per distinct size,
+while routing the sizes through ``ops/knn.pow2_bucket`` first collapses
+the stream to O(log N) compiles — the exact economics the static
+retrace-hazard rule and the bench envelope assertions are built on.
+
+Hygiene: the ledger monkeypatches ``jax.jit`` process-globally and tier-1
+runs the whole suite in ONE process, so every test uninstalls + resets in
+a finally (and the module-scope fixture double-checks on the way out).
+"""
+
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+ROOT = Path(__file__).resolve().parents[1]
+if str(ROOT) not in sys.path:
+    sys.path.insert(0, str(ROOT))
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from kakveda_tpu.core import ledger  # noqa: E402
+from kakveda_tpu.ops.knn import pow2_bucket  # noqa: E402
+
+
+@pytest.fixture
+def installed_ledger(monkeypatch):
+    """Arm + install the ledger for one test; always restore jax.jit."""
+    monkeypatch.setenv("KAKVEDA_LEDGER", "1")
+    ledger.reset()
+    assert ledger.maybe_install()
+    try:
+        yield ledger
+    finally:
+        ledger.uninstall()
+        ledger.reset()
+
+
+def test_disabled_is_inert(monkeypatch):
+    monkeypatch.delenv("KAKVEDA_LEDGER", raising=False)
+    orig = jax.jit
+    try:
+        assert not ledger.enabled()
+        assert not ledger.maybe_install()
+        assert jax.jit is orig
+        # note_transfer is a no-op attribute check when off
+        ledger.note_transfer("h2d", 1 << 20)
+        assert ledger.ledger_report()["transfer_bytes"] == {}
+    finally:
+        ledger.uninstall()
+        ledger.reset()
+
+
+def test_unbucketed_vs_pow2_bucketed_compiles(installed_ledger):
+    """32 distinct batch sizes: raw shapes compile 32 times; pow2-bucketed
+    shapes compile len({pow2 buckets}) = 6 times. This is the ledger
+    measuring the exact waste the retrace-hazard rule flags statically."""
+
+    def probe_raw(x):
+        return x * 2.0
+
+    def probe_bucketed(x):
+        return x * 2.0
+
+    raw_jit = jax.jit(probe_raw)
+    buck_jit = jax.jit(probe_bucketed)
+
+    for n in range(1, 33):
+        raw_jit(jnp.zeros((n,), jnp.float32)).block_until_ready()
+        bb = pow2_bucket(n)
+        buck_jit(jnp.zeros((bb,), jnp.float32)).block_until_ready()
+
+    rep = ledger.ledger_report()
+    assert rep["compiles"].get("probe_raw") == 32, rep["compiles"]
+    expected_buckets = len({pow2_bucket(n) for n in range(1, 33)})
+    assert expected_buckets == 6  # {1, 2, 4, 8, 16, 32}
+    assert rep["compiles"].get("probe_bucketed") == expected_buckets, (
+        rep["compiles"]
+    )
+
+
+def test_entry_attribution_and_lambda_inherits(installed_ledger):
+    """jits made after install self-label; a jitted lambda has no name and
+    must inherit the ambient entry() label instead of masking it."""
+    lam = jax.jit(lambda x: x + 3.0)
+    with ledger.entry("warnpath"):
+        lam(jnp.zeros((7,), jnp.float32)).block_until_ready()
+    rep = ledger.ledger_report()
+    assert rep["compiles"].get("warnpath") == 1, rep["compiles"]
+
+
+def test_decorator_factory_form_and_donation_passthrough(installed_ledger):
+    """The kwargs-only form jax.jit(donate_argnums=...) returns a factory;
+    the wrapper must thread kwargs through and keep donation semantics."""
+
+    @jax.jit
+    def plain(x):
+        return x + 1.0
+
+    factory = jax.jit(donate_argnums=(0,))
+
+    def donated(x):
+        return x * 2.0
+
+    donated_jit = factory(donated)
+    x = jnp.zeros((5,), jnp.float32)
+    plain(x).block_until_ready()
+    donated_jit(x).block_until_ready()
+    rep = ledger.ledger_report()
+    assert rep["compiles"].get("plain") == 1, rep["compiles"]
+    assert rep["compiles"].get("donated") == 1, rep["compiles"]
+
+
+def test_mark_warm_records_post_warmup_compiles(installed_ledger):
+    @jax.jit
+    def step(x):
+        return x - 1.0
+
+    step(jnp.zeros((4,), jnp.float32)).block_until_ready()
+    ledger.mark_warm()
+    rep = ledger.ledger_report()
+    assert rep["warm"] and rep["post_warmup_compiles"] == 0
+    # a NEW shape after warmup is the bug the benches assert against
+    step(jnp.zeros((9,), jnp.float32)).block_until_ready()
+    rep = ledger.ledger_report()
+    assert rep["post_warmup_compiles"] == 1, rep
+    assert rep["post_warmup"][0]["fn"] == "step"
+    assert rep["post_warmup"][0]["duration_ms"] >= 0
+
+
+def test_transfer_phases_and_directions(installed_ledger):
+    ledger.note_transfer("h2d", 1024)  # no phase active
+    with ledger.phase("warn"):
+        ledger.note_transfer("h2d", 4096)
+        ledger.note_transfer("d2h", 256)
+    with ledger.phase("ingest"):
+        ledger.note_transfer("h2d", 512)
+    ledger.note_transfer("d2h", 0)  # zero bytes: dropped
+    rep = ledger.ledger_report()
+    assert rep["transfer_by_phase"] == {
+        "h2d": {"unphased": 1024, "warn": 4096, "ingest": 512},
+        "d2h": {"warn": 256},
+    }
+    assert rep["transfer_bytes"] == {"h2d": 5632, "d2h": 256}
+
+
+def test_labeled_jit_delegates_and_binds(installed_ledger):
+    """_LabeledJit must stay a drop-in: attribute passthrough to the real
+    jitted object and descriptor binding for decorated methods."""
+
+    @jax.jit
+    def f(x):
+        return x + 1.0
+
+    assert hasattr(f, "lower")  # delegation via __getattr__
+    assert "ledger-labeled" in repr(f)
+
+    class Eng:
+        @jax.jit
+        def m(self_arr):
+            return self_arr * 3.0
+
+    out = Eng.m(jnp.ones((2,), jnp.float32))  # unbound: passes arr as arg
+    np.testing.assert_allclose(np.asarray(out), [3.0, 3.0])
+
+
+def test_reset_keeps_install_uninstall_restores_jit(monkeypatch):
+    monkeypatch.setenv("KAKVEDA_LEDGER", "1")
+    orig = jax.jit
+    try:
+        ledger.reset()
+        assert ledger.maybe_install()
+        assert jax.jit is not orig
+
+        @jax.jit
+        def g(x):
+            return x
+
+        g(jnp.zeros((3,), jnp.float32)).block_until_ready()
+        assert ledger.ledger_report()["compile_total"] >= 1
+        ledger.reset()
+        assert ledger.installed()  # reset clears tables, not the install
+        assert ledger.ledger_report()["compile_total"] == 0
+        ledger.uninstall()
+        assert jax.jit is orig
+        # deafened: compiles after uninstall are not counted
+        h = jax.jit(lambda x: x * 5.0)
+        h(jnp.zeros((3,), jnp.float32)).block_until_ready()
+        assert ledger.ledger_report()["compile_total"] == 0
+        # captured jitted callables from the installed era keep working
+        g(jnp.zeros((3,), jnp.float32)).block_until_ready()
+    finally:
+        ledger.uninstall()
+        ledger.reset()
+
+
+def test_metrics_families_exported(installed_ledger):
+    from kakveda_tpu.core import metrics
+
+    @jax.jit
+    def exported(x):
+        return x + 2.0
+
+    with ledger.phase("warn"):
+        exported(jnp.zeros((6,), jnp.float32)).block_until_ready()
+        ledger.note_transfer("d2h", 123)
+    text = metrics.get_registry().render()
+    assert 'kakveda_compile_total{fn="exported"}' in text
+    assert 'direction="d2h"' in text and 'phase="warn"' in text

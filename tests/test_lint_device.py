@@ -1,0 +1,377 @@
+"""Tier-1 guard for the device-plane hygiene pass
+(kakveda_tpu/analysis/device.py, docs/static-analysis.md).
+
+Two layers, mirroring test_lint_invariants.py:
+
+* **Fixture twins** — per rule, a known-bad fixture produces exactly the
+  expected finding and its known-good twin passes (false-negative AND
+  false-positive guard as the rules evolve).
+* **Real-tree mutations** — the shipped sources, copied and minimally
+  broken the way the bug would actually be written (strip the pow2
+  bucket from ``topk_async_sparse``; read a donated cache after
+  ``_step_chunk_jit``), must trip the rule — proof the rules are not
+  vacuous on the real call graph, the same evidence standard the
+  concurrency pass set.
+
+Deliberately imports no jax: the analysis package is pure stdlib AST.
+"""
+
+import sys
+import textwrap
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parents[1]
+if str(ROOT) not in sys.path:
+    sys.path.insert(0, str(ROOT))
+
+from kakveda_tpu.analysis.framework import all_rules, run_lint  # noqa: E402
+
+_DEVICE_RULES = ("constant-capture", "donation-after-use",
+                 "dynamic-slice-by-trace", "host-sync", "retrace-hazard")
+
+
+def _tree(tmp_path: Path, files: dict) -> Path:
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return tmp_path
+
+
+def _findings(root: Path, rule: str):
+    return run_lint(root, rule_ids=[rule]).findings
+
+
+def _mutated_tree(tmp_path: Path, rel: str, old: str, new: str) -> Path:
+    """Copy ONE real source file into a scratch tree at its repo-relative
+    path, with ``old`` replaced by ``new`` (old must exist — a refactor
+    that renames the anchor must update the mutation too)."""
+    src = (ROOT / rel).read_text()
+    assert old in src, f"mutation anchor vanished from {rel}: {old!r}"
+    p = tmp_path / rel
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(src.replace(old, new))
+    return tmp_path
+
+
+# ---------------------------------------------------------------------------
+# registry shape: every device rule is per-file scoped (so --changed runs it)
+# ---------------------------------------------------------------------------
+
+
+def test_device_rules_registered_and_changed_eligible():
+    rules = all_rules()
+    for rid in _DEVICE_RULES:
+        assert rid in rules, f"device rule {rid} not registered"
+        assert rules[rid].scope is not None, (
+            f"{rid} must be per-file scoped so `lint_invariants.py --changed` "
+            f"(the pre-commit mode) runs it"
+        )
+
+
+# ---------------------------------------------------------------------------
+# retrace-hazard
+# ---------------------------------------------------------------------------
+
+_RETRACE_BAD = {
+    "kakveda_tpu/models/pipe.py": """
+    import jax
+    import numpy as np
+
+    def _impl(q):
+        return q * 2
+
+    _match_jit = jax.jit(_impl)
+
+    def serve(rows):
+        b = len(rows)
+        q = np.zeros((b, 4), np.float32)
+        return _match_jit(q)
+    """,
+}
+
+_RETRACE_GOOD = {
+    "kakveda_tpu/models/pipe.py": """
+    import jax
+    import numpy as np
+    from kakveda_tpu.ops.knn import batch_bucket
+
+    def _impl(q):
+        return q * 2
+
+    _match_jit = jax.jit(_impl)
+
+    def serve(rows):
+        b = batch_bucket(len(rows))
+        q = np.zeros((b, 4), np.float32)
+        return _match_jit(q)
+    """,
+}
+
+
+def test_retrace_hazard_fires_on_unbucketed_shape(tmp_path):
+    fs = _findings(_tree(tmp_path, _RETRACE_BAD), "retrace-hazard")
+    assert len(fs) == 1, fs
+    assert "_match_jit" in fs[0].message and "q" in fs[0].message
+
+
+def test_retrace_hazard_good_twin_bucketed(tmp_path):
+    assert _findings(_tree(tmp_path, _RETRACE_GOOD), "retrace-hazard") == []
+
+
+def test_retrace_hazard_real_tree_mutation(tmp_path):
+    """Strip the pow2 bucket from the REAL topk_async_sparse: the ragged
+    batch size then flows raw into the pad-array shapes handed to the
+    _topk_sparse jit entry — the exact regression the rule exists for."""
+    rel = "kakveda_tpu/ops/knn.py"
+    root = _mutated_tree(
+        tmp_path, rel,
+        "bb = batch_bucket(max(b, 1))",
+        "bb = max(b, 1)",
+    )
+    fs = _findings(root, "retrace-hazard")
+    assert any(f.file == rel and "_topk_sparse" in f.message for f in fs), fs
+    # control: the unmutated file is clean
+    assert _findings(_mutated_tree(
+        tmp_path / "ctl", rel, "bb = batch_bucket(max(b, 1))",
+        "bb = batch_bucket(max(b, 1))",
+    ), "retrace-hazard") == []
+
+
+# ---------------------------------------------------------------------------
+# donation-after-use
+# ---------------------------------------------------------------------------
+
+_DONATE_COMMON = """
+    import jax
+    from functools import partial
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def _step(cache, tok):
+        return cache + tok, tok
+"""
+
+_DONATE_BAD = {
+    "kakveda_tpu/models/eng.py": _DONATE_COMMON + """
+    def run(cache, tok):
+        new_cache, out = _step(cache, tok)
+        stale = cache.sum()
+        return new_cache, out, stale
+    """,
+}
+
+_DONATE_GOOD = {
+    "kakveda_tpu/models/eng.py": _DONATE_COMMON + """
+    def run(cache, tok):
+        cache, out = _step(cache, tok)
+        fresh = cache.sum()
+        return cache, out, fresh
+    """,
+}
+
+
+def test_donation_after_use_fires_on_stale_read(tmp_path):
+    fs = _findings(_tree(tmp_path, _DONATE_BAD), "donation-after-use")
+    assert len(fs) == 1, fs
+    assert "donated" in fs[0].message and "_step" in fs[0].message
+
+
+def test_donation_after_use_good_twin_same_statement_rebind(tmp_path):
+    assert _findings(_tree(tmp_path, _DONATE_GOOD), "donation-after-use") == []
+
+
+def test_donation_after_use_real_tree_mutation(tmp_path):
+    """Bind the REAL _step_chunk_jit result away from self.cache and read
+    the donated cache afterwards — the sanctioned same-statement rebind is
+    what keeps the shipped dispatch_chunk legal; break it and the rule
+    must fire."""
+    rel = "kakveda_tpu/models/serving.py"
+    root = _mutated_tree(
+        tmp_path, rel,
+        "self.cache, self.last, _, self.rng, toks = _step_chunk_jit(",
+        "stale_cache, self.last, _, self.rng, toks = _step_chunk_jit(",
+    )
+    # add a post-call read of the donated attr inside the same method
+    p = root / rel
+    src = p.read_text()
+    anchor = "self._pos_np += self.chunk_steps  # every slot advances in lockstep"
+    assert anchor in src
+    p.write_text(src.replace(
+        anchor, anchor + "\n        _stale = self.cache.shape"
+    ))
+    fs = _findings(root, "donation-after-use")
+    assert any(
+        f.file == rel and "_step_chunk_jit" in f.message
+        and "self.cache" in f.message
+        for f in fs
+    ), fs
+
+
+def test_donation_real_tree_is_clean(tmp_path):
+    """The shipped serving.py/knn.py donation sites are all sanctioned
+    same-statement rebinds."""
+    for rel in ("kakveda_tpu/models/serving.py", "kakveda_tpu/ops/knn.py"):
+        root = _mutated_tree(tmp_path / rel.replace("/", "_"), rel, "import", "import")
+        assert _findings(root, "donation-after-use") == []
+
+
+# ---------------------------------------------------------------------------
+# constant-capture
+# ---------------------------------------------------------------------------
+
+_CAPTURE_BAD = {
+    "kakveda_tpu/models/tab.py": """
+    import jax
+    import numpy as np
+
+    _TABLE = np.eye(4, dtype=np.float32)
+
+    @jax.jit
+    def apply(x):
+        return x @ _TABLE
+    """,
+}
+
+_CAPTURE_GOOD = {
+    "kakveda_tpu/models/tab.py": """
+    import jax
+    import numpy as np
+
+    _TABLE = np.eye(4, dtype=np.float32)
+
+    @jax.jit
+    def apply(x, table):
+        return x @ table
+
+    def run(x):
+        return apply(x, _TABLE)
+    """,
+}
+
+
+def test_constant_capture_fires_on_closed_over_numpy(tmp_path):
+    fs = _findings(_tree(tmp_path, _CAPTURE_BAD), "constant-capture")
+    assert len(fs) == 1, fs
+    assert "_TABLE" in fs[0].message and "closes over" in fs[0].message
+
+
+def test_constant_capture_good_twin_passes_as_arg(tmp_path):
+    assert _findings(_tree(tmp_path, _CAPTURE_GOOD), "constant-capture") == []
+
+
+def test_constant_capture_real_tree_mutation(tmp_path):
+    """Graft a module-level numpy table + a jit body closing over it onto
+    the REAL ops/knn.py — the rule must catch it amid the full file."""
+    rel = "kakveda_tpu/ops/knn.py"
+    root = _mutated_tree(tmp_path, rel, "import", "import")
+    p = root / rel
+    p.write_text(p.read_text() + textwrap.dedent("""
+
+        _MUTATION_TAB = np.arange(8, dtype=np.float32)
+
+        @jax.jit
+        def _mutation_capture(x):
+            return x + _MUTATION_TAB
+    """))
+    fs = _findings(root, "constant-capture")
+    assert any("_MUTATION_TAB" in f.message for f in fs), fs
+
+
+# ---------------------------------------------------------------------------
+# dynamic-slice-by-trace
+# ---------------------------------------------------------------------------
+
+_DSLICE_BAD = {
+    "kakveda_tpu/models/sl.py": """
+    import jax
+
+    @jax.jit
+    def take(x, n):
+        return x[:n]
+    """,
+}
+
+_DSLICE_GOOD = {
+    "kakveda_tpu/models/sl.py": """
+    import jax
+    from functools import partial
+
+    @partial(jax.jit, static_argnames=("n",))
+    def take(x, n):
+        return x[:n]
+
+    @jax.jit
+    def head(x, n):
+        return jax.lax.dynamic_slice_in_dim(x, n, 4)
+    """,
+}
+
+
+def test_dynamic_slice_fires_on_traced_size(tmp_path):
+    fs = _findings(_tree(tmp_path, _DSLICE_BAD), "dynamic-slice-by-trace")
+    assert len(fs) == 1, fs
+    assert "n" in fs[0].message and "take" in fs[0].message
+
+
+def test_dynamic_slice_good_twin_static_or_traced_start(tmp_path):
+    """static_argnames sizes and traced STARTS (fixed size) are both fine."""
+    assert _findings(_tree(tmp_path, _DSLICE_GOOD), "dynamic-slice-by-trace") == []
+
+
+def test_dynamic_slice_real_tree_mutation(tmp_path):
+    """Graft a traced-size dynamic_slice_in_dim body onto the REAL
+    ops/knn.py."""
+    rel = "kakveda_tpu/ops/knn.py"
+    root = _mutated_tree(tmp_path, rel, "import", "import")
+    p = root / rel
+    p.write_text(p.read_text() + textwrap.dedent("""
+
+        @jax.jit
+        def _mutation_slice(x, n):
+            return jax.lax.dynamic_slice_in_dim(x, 0, n)
+    """))
+    fs = _findings(root, "dynamic-slice-by-trace")
+    assert any("_mutation_slice" in f.message for f in fs), fs
+
+
+# ---------------------------------------------------------------------------
+# host-sync (relocated into the device pass; fixture twins live in
+# test_lint_invariants.py — here: real-tree mutation + lambda coverage)
+# ---------------------------------------------------------------------------
+
+
+def test_host_sync_real_tree_mutation(tmp_path):
+    """Graft a np.asarray host-sync into a jit body on the REAL knn.py."""
+    rel = "kakveda_tpu/ops/knn.py"
+    root = _mutated_tree(tmp_path, rel, "import", "import")
+    p = root / rel
+    p.write_text(p.read_text() + textwrap.dedent("""
+
+        @jax.jit
+        def _mutation_sync(x):
+            return np.asarray(x) + 1
+    """))
+    fs = _findings(root, "host-sync")
+    assert any("np.asarray" in f.message for f in fs), fs
+
+
+def test_host_sync_covers_jit_wrapped_lambda(tmp_path):
+    fs = _findings(_tree(tmp_path, {
+        "kakveda_tpu/ops/lam.py": """
+        import jax
+
+        _f = jax.jit(lambda x: float(x) + 1.0)
+        """,
+    }), "host-sync")
+    assert len(fs) == 1, fs
+    assert "float" in fs[0].message
+
+
+# ---------------------------------------------------------------------------
+# the shipped tree is clean under the whole device pass
+# ---------------------------------------------------------------------------
+
+
+def test_real_tree_clean_under_device_rules():
+    res = run_lint(ROOT, rule_ids=list(_DEVICE_RULES))
+    assert res.findings == [], [f.human() for f in res.findings]
