@@ -6,14 +6,17 @@ warn-under-decode and pattern mining, and prints ONE JSON line —
 headline = the warn north star, with the rest under ``extra_metrics`` so
 the driver's BENCH_r{N}.json carries every number.
 ``KAKVEDA_BENCH_METRIC=warn|ingest|decode|spec|continuous|mixed|
-mixed-decode|mine|serve|overload|tiered|fleet|storm`` runs a single
-metric instead (``overload`` floods the HTTP tier past its admission
-bounds and proves shedding keeps warn p95 bounded; ``tiered`` A/Bs the
-IVF-routed tiered GFKB against the exact oracle at 1M rows plus a 10M
-host/disk arm — docs/robustness.md, docs/performance.md § tiered;
+mixed-decode|mine|serve|overload|tiered|fleet|storm|elastic`` runs a
+single metric instead (``overload`` floods the HTTP tier past its
+admission bounds and proves shedding keeps warn p95 bounded; ``tiered``
+A/Bs the IVF-routed tiered GFKB against the exact oracle at 1M rows plus
+a 10M host/disk arm — docs/robustness.md, docs/performance.md § tiered;
 ``storm`` replays the seeded hot-key-skew + failure-storm scenario with
 its chaos timeline through the traffic harness and self-certifies the
-SLO gates — kakveda_tpu/traffic/, docs/robustness.md § traffic harness).
+SLO gates — kakveda_tpu/traffic/, docs/robustness.md § traffic harness;
+``elastic`` runs the flash-crowd autoscaling drill — scale 2→4→2 with a
+SIGKILLed owner replaced, zero lost warns, ≤1 flap — and self-certifies
+the elastic contract, docs/scale-out.md § elastic fleet).
 
 == warn: pre-flight warning p50 latency at a 1M-entry GFKB.
 
@@ -2728,6 +2731,260 @@ def _bench_storm(backend: str) -> dict:
     }
 
 
+def _bench_elastic(backend: str) -> dict:
+    """Elastic self-healing fleet drill (fleet/autoscaler.py,
+    docs/scale-out.md § elastic fleet) — self-certifying, any gate
+    failing raises.
+
+    A 2-replica sharded-ownership fleet (R=2) runs under the router's
+    autoscaler (min 2 / max 4) with drill-speed policy knobs. The seeded
+    `flash_crowd` scenario replays open-loop: baseline warn, then a 5×
+    warn ramp + a full-mine background flood that pins replica occupancy,
+    then ONE OWNER SIGKILLed at surge end (the crash_replica chaos
+    action), then decay. Gates:
+
+    * the sustained surge scales the fleet 2→4 (>= 2 scale_up:ok);
+    * the SIGKILLed owner is replaced (>= 1 replace:ok) and the ring
+      re-converges: zero coverage holes, resident rows back to R×corpus;
+    * the decay drains the fleet back to 2 via the lossless
+      migrate-then-stop protocol (live == 2 at the end);
+    * the scenario SLO holds: zero lost warns, zero hung, sheds confined
+      to interactive/background, and at most max_scale_flaps=1 direction
+      reversal (2→4→2 is exactly one flap).
+
+    Replicas are ALWAYS pinned to CPU here — the drill SIGKILLs a
+    process, which must never target a TPU lease holder (CLAUDE.md); the
+    crash_replica action double-checks via may_hold_device_lease."""
+    import asyncio
+    import tempfile
+    from pathlib import Path
+
+    import yaml
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from kakveda_tpu.core import faults as _faults
+    from kakveda_tpu import traffic as _traffic
+    from kakveda_tpu.fleet.ownership import OwnershipView
+    from kakveda_tpu.fleet.router import ROUTER_KEY, make_router_app
+    from kakveda_tpu.fleet.supervisor import FleetSupervisor, pick_port_base
+
+    seed = int(os.environ.get("KAKVEDA_BENCH_ELASTIC_SEED", 7))
+    surge_s = float(os.environ.get("KAKVEDA_BENCH_ELASTIC_SURGE_S", 50.0))
+    decay_s = float(os.environ.get("KAKVEDA_BENCH_ELASTIC_DECAY_S", 45.0))
+    n_start, n_max, repl = 2, 4, 2
+    apps, per_app = 24, 3
+
+    tmp = Path(tempfile.mkdtemp(prefix="kakveda-bench-elastic-"))
+    cfg = tmp / "config.yaml"
+    cfg.write_text(yaml.safe_dump({
+        "failure_matching": {
+            "similarity_threshold": 0.8, "embedding_dim": 512, "top_k": 5,
+        },
+    }))
+    replica_env = {
+        "JAX_PLATFORMS": "cpu",  # crash drill: never a TPU lease holder
+        "KAKVEDA_CONFIG_PATH": str(cfg),
+        "KAKVEDA_INDEX_CAPACITY": "2048",
+        "KAKVEDA_FLEET_OWNERSHIP": "1",
+        "KAKVEDA_FLEET_REPLICATION": str(repl),
+        # background=1 makes each admitted full-mine pin the replica's
+        # occupancy export at 1.0 — the autoscaler's pressure signal.
+        "KAKVEDA_ADMIT_BACKGROUND": "1",
+        "KAKVEDA_ADMIT_WARN": "64",
+        # Heal seam: replication events dead-lettered at the origins
+        # while the crashed owner is down auto-replay on breaker re-close.
+        "KAKVEDA_DLQ_AUTO_S": "2",
+        "KAKVEDA_LOG_LEVEL": "WARNING",
+        "KAKVEDA_GC_TUNE": "0",
+    }
+    # Drill-speed policy knobs (read once at autoscaler mount). Saved and
+    # restored so a full sweep's later rows see the operator's env.
+    drill_knobs = {
+        "KAKVEDA_SCALE_UP_OCC": "0.6",
+        "KAKVEDA_SCALE_DOWN_OCC": "0.2",
+        "KAKVEDA_SCALE_DWELL_S": "2",
+        "KAKVEDA_SCALE_COOLDOWN_S": "5",
+        "KAKVEDA_SCALE_REPLACE_S": "3",
+        "KAKVEDA_SCALE_REPLACE_BACKOFF_S": "3",
+        "KAKVEDA_SCALE_TICK_S": "0.5",
+    }
+    saved_env = {k: os.environ.get(k) for k in drill_knobs}
+    os.environ.update(drill_knobs)
+
+    sc = _traffic.make_scenario(
+        "flash_crowd", seed=seed, baseline_s=4.0, surge_s=surge_s,
+        decay_s=decay_s, warn_rps=4.0, surge_x=5.0, bg_rps=12.0,
+        apps=apps, crash_replica=1, gossip_ttl_s=3.0, max_scale_flaps=1,
+    )
+    sup = FleetSupervisor(
+        tmp / "fleet", port_base=pick_port_base(n_max + 1),
+        replicas=n_start, env=replica_env,
+    )
+    sup.autoscale = (n_start, n_max)
+
+    def _trace(app_id: str, i: int) -> dict:
+        return {
+            "trace_id": f"el-{i}",
+            "ts": time.time(),
+            "app_id": app_id,
+            "prompt": f"Cite sources for claim {i} even if unavailable.",
+            "response": "See [1].\n\nReferences:\n[1] Smith (2020).",
+            "tools": [], "env": {"os": "linux"},
+        }
+
+    async def go():
+        import httpx
+
+        router_app = make_router_app(
+            sup.backend_map(), probe_interval_s=0.5, eject_fails=2,
+            retries=1, timeout_s=20.0,
+            ownership=OwnershipView(sup.backend_map(), replication=repl),
+            supervisor=sup, autoscale=(n_start, n_max),
+        )
+        rc = TestClient(TestServer(router_app))
+        await rc.start_server()
+        router = router_app[ROUTER_KEY]
+        scaler = router.autoscaler
+        assert scaler is not None, "autoscaler did not mount"
+        try:
+            # Seed a corpus so the crashed owner has rows to lose — and
+            # the replacement has a heal to prove.
+            for a in range(apps):
+                traces = [_trace(f"app-{a}", a * per_app + j)
+                          for j in range(per_app)]
+                r = await rc.post("/ingest/batch", json={"traces": traces})
+                assert r.status == 200, await r.text()
+            corpus = apps * per_app
+
+            async def post(path, body):
+                resp = await rc.post(path, json=body)
+                await resp.read()
+                return resp.status
+
+            res = await _traffic.run_scenario(
+                sc, post=post, speed=1.0, supervisor=sup,
+                autoscaler=scaler,
+            )
+
+            async def live_counts():
+                loop = asyncio.get_running_loop()
+                out = {}
+                for rid, ok in router.liveness().items():
+                    if not ok:
+                        continue
+                    u = router.backends.get(rid)
+                    if u is None:
+                        continue
+                    try:
+                        body = await loop.run_in_executor(
+                            None,
+                            lambda u=u: httpx.get(
+                                u + "/readyz", timeout=10).json(),
+                        )
+                        out[rid] = int(body.get("gfkb_count") or 0)
+                    except (httpx.HTTPError, ValueError):
+                        pass
+                return out
+
+            # The replay window closed; the autoscaler keeps ticking.
+            # Converge: replacement done, fleet drained back to n_start,
+            # zero coverage holes, resident rows back to R×corpus.
+            deadline = time.monotonic() + 180.0
+            counts, holes = {}, ["unpolled"]
+            while time.monotonic() < deadline:
+                dc = scaler.decision_counts()
+                counts = await live_counts()
+                holes = router.ownership.coverage_holes(list(counts))
+                if (dc.get("replace:ok", 0) >= 1
+                        and len(counts) == n_start
+                        and not holes
+                        and sum(counts.values()) >= repl * corpus):
+                    break
+                await asyncio.sleep(1.0)
+            res.notes["scale_flaps"] = float(scaler.flap_count())
+            return res, scaler.decision_counts(), counts, holes, corpus
+        finally:
+            await rc.close()
+
+    try:
+        sup.start_all()
+        sup.wait_ready(timeout_s=300.0)
+        res, dcounts, live, holes, corpus = asyncio.run(go())
+    finally:
+        sup.stop_all()
+        _faults.disarm()  # never leak a chaos window
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    ups = dcounts.get("scale_up:ok", 0)
+    downs = dcounts.get("scale_down:ok", 0)
+    replaces = dcounts.get("replace:ok", 0)
+    peak = n_start + ups
+    report = _traffic.evaluate(sc.slo, res)
+    print(
+        f"bench[elastic]: {n_start}→{peak}→{len(live)} replicas "
+        f"(ups={ups} downs={downs} replaces={replaces}, "
+        f"flaps={int(res.notes.get('scale_flaps', -1))}); "
+        f"resident {sum(live.values())} rows vs R×corpus {repl * corpus}, "
+        f"coverage holes {holes or 0}; decisions {dcounts}; "
+        f"{report.summary()}",
+        file=sys.stderr,
+    )
+    if ups < 2:
+        raise AssertionError(
+            f"flash crowd never scaled 2→4: scale_up:ok={ups} "
+            f"(decisions {dcounts})"
+        )
+    if replaces < 1:
+        raise AssertionError(
+            f"SIGKILLed owner was never replaced (decisions {dcounts})"
+        )
+    if len(live) != n_start:
+        raise AssertionError(
+            f"fleet did not drain back to {n_start}: live={sorted(live)} "
+            f"(decisions {dcounts})"
+        )
+    if holes:
+        raise AssertionError(
+            f"coverage holes after replacement: {holes}"
+        )
+    if sum(live.values()) < repl * corpus:
+        raise AssertionError(
+            f"heal incomplete: {sum(live.values())} resident rows < "
+            f"R×corpus {repl * corpus} ({live})"
+        )
+    if not report.ok:
+        raise AssertionError(
+            f"elastic drill failed its SLO — {report.summary()}"
+        )
+    return {
+        "metric": "elastic_fleet_flash_crowd",
+        "value": peak,
+        "unit": "peak_replicas",
+        "vs_baseline": n_start,
+        "slo_ok": report.ok,
+        "slo": report.to_dict(),
+        "scenario": {"name": "flash_crowd", "seed": seed,
+                     "surge_s": surge_s, "decay_s": decay_s},
+        "scale_decisions": dcounts,
+        "scale_ups_ok": ups,
+        "scale_downs_ok": downs,
+        "replaces_ok": replaces,
+        "scale_flaps": int(res.notes.get("scale_flaps", -1)),
+        "final_replicas": len(live),
+        "resident_rows": live,
+        "corpus_rows": corpus,
+        "replication": repl,
+        "coverage_holes": 0,
+        "dispatched": len(res.records),
+        "class_counts": res.class_counts(),
+        "late_p95_ms": res.late_p95_ms(),
+    }
+
+
 def _bench_mine(backend: str) -> dict:
     n = int(os.environ.get("KAKVEDA_BENCH_MINE_N", 500_000 if _on_tpu(backend) else 20_000))
     dim = int(os.environ.get("KAKVEDA_BENCH_DIM", 2048))
@@ -3490,6 +3747,7 @@ def main() -> int:
         "fleet": _bench_fleet,
         "ownership": _bench_ownership,
         "storm": _bench_storm,
+        "elastic": _bench_elastic,
     }
     if which in fns:
         out = fns[which](backend)
@@ -3540,6 +3798,7 @@ def main() -> int:
         _bench_fleet,
         _bench_ownership,
         _bench_storm,
+        _bench_elastic,
     )
     for fn in order:
         if fn.__name__ in done:

@@ -261,6 +261,7 @@ class ForwardFlagParity(Rule):
 _SINGLE_WRITER = {
     "kakveda_tpu/models/serving.py": ("_set_gate_state",),
     "kakveda_tpu/core/admission.py": ("_set_brownout_state",),
+    "kakveda_tpu/fleet/autoscaler.py": ("_set_scale_state",),
 }
 _ANY_KEY = object()
 
@@ -269,9 +270,9 @@ _ANY_KEY = object()
 class SingleWriterTransitions(Rule):
     id = "single-writer"
     invariant = (
-        "the fields moved by _set_gate_state/_set_brownout_state (state "
-        "key, gauge vector, transition counter) are assigned nowhere else "
-        "in their class except __init__"
+        "the fields moved by _set_gate_state/_set_brownout_state/"
+        "_set_scale_state (state key, gauge vector, transition counter) "
+        "are assigned nowhere else in their class except __init__"
     )
     scope = tuple(_SINGLE_WRITER)
 

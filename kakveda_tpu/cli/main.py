@@ -219,7 +219,24 @@ def _cmd_doctor(args: argparse.Namespace) -> int:
                 )
             own_note = (f" ownership=epoch:{epochs[top]}"
                         f"/R:{view.replication}/holes:0")
-        return f"{' '.join(parts)} fleet_mode={worst}{own_note}"
+        scale_note = ""
+        auto = manifest.get("autoscale")
+        if auto:
+            # Elastic fleet (fleet/autoscaler.py): surface the policy
+            # bounds and the last ledgered decision so an operator sees a
+            # crash-looping replacement or a stuck drain without curl.
+            scale_note = f" autoscale={auto.get('min')}..{auto.get('max')}"
+            last = None
+            try:
+                lines = Path(auto.get("scale_log", "")).read_text().splitlines()
+                if lines:
+                    last = json.loads(lines[-1])
+            except (OSError, ValueError):
+                pass
+            if last:
+                scale_note += (f" last={last.get('action')}:"
+                               f"{last.get('outcome')}→{last.get('target')}")
+        return f"{' '.join(parts)} fleet_mode={worst}{own_note}{scale_note}"
 
     check("python", lambda: sys.version.split()[0])
     check("fleet", _fleet)
@@ -333,6 +350,21 @@ def _cmd_status(args: argparse.Namespace) -> int:
                 except (httpx.HTTPError, ValueError):
                     ownership[rid] = {"unreachable": True}
             status["ownership"] = ownership
+        auto = (manifest or {}).get("autoscale")
+        if auto:
+            # Elastic fleet: policy bounds + the tail of the decision
+            # ledger (data/scale_log.jsonl — one typed record per
+            # autoscaler decision, docs/scale-out.md § elastic fleet).
+            block = {"min": auto.get("min"), "max": auto.get("max")}
+            try:
+                lines = Path(auto.get("scale_log", "")).read_text().splitlines()
+                block["decisions"] = len(lines)
+                block["last_decisions"] = [
+                    json.loads(ln) for ln in lines[-5:] if ln.strip()
+                ]
+            except (OSError, ValueError):
+                block["decisions"] = 0
+            status["autoscale"] = block
     print(json.dumps(status, indent=2))
     return 0
 
@@ -390,6 +422,8 @@ def _cmd_up(args: argparse.Namespace) -> int:
         if getattr(args, "replicas", 0):
             cmd += ["--replicas", str(args.replicas),
                     "--port-base", str(args.port_base or 0)]
+            if getattr(args, "autoscale", None):
+                cmd += ["--autoscale", args.autoscale]
         root.mkdir(parents=True, exist_ok=True)  # fresh --dir: log lives inside
         logf = open(_log_path(root), "ab")
         proc = subprocess.Popen(
@@ -424,32 +458,53 @@ def _cmd_up(args: argparse.Namespace) -> int:
 
 
 def _run_fleet(args: argparse.Namespace, root: Path) -> int:
-    """`up --replicas N [--port-base P]`: spawn N replica servers on
-    P..P+N-1 (per-replica pid/log files, private data dirs), wait for
-    readiness, then serve the front router (fleet/router.py) on --port.
-    The router supervises: health probes + ejection always; process
-    restarts within KAKVEDA_FLEET_RESTARTS. Teardown (SIGTERM/exit or
-    `kakveda-tpu down`) stops every replica."""
+    """`up --replicas N [--port-base P] [--autoscale MIN:MAX]`: spawn N
+    replica servers on P..P+N-1 (per-replica pid/log files, private data
+    dirs), wait for readiness, then serve the front router
+    (fleet/router.py) on --port. The router supervises: health probes +
+    ejection always; process restarts within KAKVEDA_FLEET_RESTARTS — or,
+    with --autoscale, the elastic policy loop (fleet/autoscaler.py):
+    scale-up on sustained pressure, lossless drain on idle, dead-replica
+    replacement (which subsumes the restart duty). Teardown (SIGTERM/exit
+    or `kakveda-tpu down`) stops every replica."""
     from aiohttp import web
 
     from kakveda_tpu.fleet.router import make_router_app
     from kakveda_tpu.fleet.supervisor import FleetSupervisor
+
+    autoscale = None
+    if getattr(args, "autoscale", None):
+        try:
+            mn_s, mx_s = str(args.autoscale).split(":", 1)
+            autoscale = (int(mn_s), int(mx_s))
+        except ValueError:
+            print(f"bad --autoscale {args.autoscale!r} (want MIN:MAX)",
+                  file=sys.stderr)
+            return 2
+        if not (1 <= autoscale[0] <= autoscale[1]):
+            print(f"bad --autoscale bounds {autoscale} (want 1 <= min <= max)",
+                  file=sys.stderr)
+            return 2
 
     port_base = args.port_base or (args.port + 1)
     sup = FleetSupervisor(
         root, host=args.host, port_base=port_base,
         replicas=args.replicas, router_port=args.port,
     )
+    if autoscale is not None:
+        sup.autoscale = autoscale  # manifest block for status/doctor
     _pid_path(root).write_text(str(os.getpid()))
     sup.start_all()
     print(
         f"fleet: {args.replicas} replicas starting on ports "
         f"{port_base}..{port_base + args.replicas - 1} "
         f"(replica-<i>.pid / replica-<i>.log under {root})"
+        + (f" autoscale={autoscale[0]}..{autoscale[1]}" if autoscale else "")
     )
     try:
         sup.wait_ready(timeout_s=float(os.environ.get("KAKVEDA_FLEET_READY_S", "240")))
-        app = make_router_app(sup.backend_map(), supervisor=sup)
+        app = make_router_app(sup.backend_map(), supervisor=sup,
+                              autoscale=autoscale)
         print(f"fleet router on http://{args.host}:{args.port}")
         web.run_app(app, host=args.host, port=args.port, print=None)
         return 0
@@ -731,6 +786,11 @@ def build_parser() -> argparse.ArgumentParser:
                     help="spawn N service replicas behind a front router on --port (docs/scale-out.md)")
     sp.add_argument("--port-base", type=int, default=0,
                     help="first replica port (default --port + 1)")
+    sp.add_argument("--autoscale", default=None, metavar="MIN:MAX",
+                    help="elastic fleet bounds: the router's autoscaler "
+                         "scales replicas between MIN and MAX "
+                         "(--replicas is the starting count; "
+                         "docs/scale-out.md § elastic fleet)")
     # Internal: set by the fleet supervisor on the children it spawns.
     sp.add_argument("--replica-index", type=int, default=None, help=argparse.SUPPRESS)
     sp.set_defaults(fn=_cmd_up)

@@ -333,6 +333,16 @@ class AdmissionController:
             0.0, _env_float("KAKVEDA_ADMIT_RA_JITTER", 0.25)
         ))
         self._waits: Dict[str, deque] = {k: deque(maxlen=self._WAIT_WINDOW) for k in CLASSES}
+        # Peak-hold window for the EXPORTED local pressure (gossip/probe):
+        # a flood of short-lived requests through a small class bound
+        # (one 100 ms mine at a time through background=1) is real
+        # sustained load, but point-in-time in-flight samples flicker
+        # 1.0/0.0 and an autoscaler's dwell clock resets on every dip.
+        # (ts, local) peaks recorded at admit time; local_pressure() is
+        # the max over the window. 0 = instantaneous export.
+        self._occ_window_s = max(
+            0.0, _env_float("KAKVEDA_ADMIT_OCC_WINDOW_S", 3.0))
+        self._occ_peaks: deque = deque(maxlen=1024)
         reg = _metrics.get_registry()
         g_inflight = reg.gauge(
             "kakveda_admission_inflight",
@@ -365,11 +375,14 @@ class AdmissionController:
 
     # -- pressure --------------------------------------------------------
 
-    def _pressure_locked(self) -> float:
-        local = max(
+    def _local_locked(self) -> float:
+        return max(
             self._inflight[k] / self.limits[k] if self.limits[k] > 0 else 0.0
             for k in CLASSES
         )
+
+    def _pressure_locked(self) -> float:
+        local = self._local_locked()
         fp, expires = self._fleet_pressure
         if fp > local and time.monotonic() < expires:
             return fp
@@ -378,6 +391,34 @@ class AdmissionController:
     def pressure(self) -> float:
         with self._lock:
             return self._pressure_locked()
+
+    def _note_peak_locked(self, now: float) -> None:
+        if self._occ_window_s > 0.0:
+            self._occ_peaks.append((now, self._local_locked()))
+
+    def local_pressure(self) -> float:
+        """Peak-held max class load from THIS replica's own in-flight
+        work — the gossip/probe EXPORT. Two deliberate properties:
+
+        * excludes the TTL'd fleet floor: publishing the combined
+          ``pressure()`` echoes a peer's number back as this replica's
+          own state, and two idle replicas then refresh each other's
+          floor forever — a latched pressure rumor no real load backs,
+          which pins the brownout ladder AND the autoscaler's scale-down
+          signal. The floor stays an INPUT (``pressure()``), never an
+          output.
+        * holds admit-time peaks for ``KAKVEDA_ADMIT_OCC_WINDOW_S`` (3 s;
+          0 = instantaneous): a flood of short requests through a small
+          class bound is real sustained load, but point samples flicker
+          1.0/0.0 between them and a dwell clock resets on every dip."""
+        with self._lock:
+            cur = self._local_locked()
+            if self._occ_window_s <= 0.0:
+                return cur
+            horizon = time.monotonic() - self._occ_window_s
+            while self._occ_peaks and self._occ_peaks[0][0] < horizon:
+                self._occ_peaks.popleft()
+            return max([cur] + [v for _, v in self._occ_peaks])
 
     def note_fleet_pressure(self, pressure: float, ttl_s: float = 5.0) -> None:
         """Gossip input (fleet/gossip.py): fold the fleet's worst live
@@ -479,6 +520,7 @@ class AdmissionController:
         if not self.enabled:
             with self._lock:
                 self._inflight[klass] += 1
+                self._note_peak_locked(time.monotonic())
             self._m_inflight[klass].set(self._inflight[klass])
             self._m_admitted[klass].inc()
             return
@@ -498,9 +540,14 @@ class AdmissionController:
                 )
         with self._lock:
             if self._inflight[klass] >= self.limits[klass]:
+                # Shed-at-limit is peak load too: between two short-lived
+                # admits the instantaneous in-flight reads 0, but demand
+                # past the bound is exactly what the autoscaler must see.
+                self._note_peak_locked(time.monotonic())
                 pressure = self._pressure_locked()
             else:
                 self._inflight[klass] += 1
+                self._note_peak_locked(time.monotonic())
                 self._m_inflight[klass].set(self._inflight[klass])
                 self._m_admitted[klass].inc()
                 pressure = self._pressure_locked()
@@ -555,6 +602,7 @@ class AdmissionController:
 
     def info(self) -> dict:
         """Mode report for /readyz: per-class occupancy + ladder state."""
+        occupancy = self.local_pressure()
         with self._lock:
             inflight = dict(self._inflight)
         return {
@@ -565,6 +613,11 @@ class AdmissionController:
             },
             "brownout": self.brownout.state,
             "brownout_step": self.brownout.step,
+            # LOCAL load only (local_pressure): the probe gossips this
+            # into the autoscaler's view, and exporting the folded floor
+            # instead would echo a peer's pressure back as this replica's
+            # own state — a rumor latch. The floor is reported separately.
+            "occupancy": round(occupancy, 4),
             "fleet_pressure": round(self.fleet_pressure(), 4),
         }
 
@@ -574,6 +627,7 @@ class AdmissionController:
         with self._lock:
             self._sheds.clear()
             self._fleet_pressure = (0.0, 0.0)
+            self._occ_peaks.clear()
             for k in CLASSES:
                 self._inflight[k] = 0
                 self._waits[k].clear()

@@ -149,6 +149,17 @@ class FleetView:
             default=0.0,
         )
 
+    def occupancies(self) -> Dict[str, float]:
+        """Per-replica occupancy from live samples (router sender
+        excluded) — the autoscaler's least-loaded victim selection."""
+        with self._lock:
+            live = self._live_locked()
+        return {
+            r: float(s.get("occupancy", 0.0))
+            for r, s in live.items()
+            if r != self.ROUTER_SENDER
+        }
+
     def any_degraded(self) -> bool:
         with self._lock:
             live = self._live_locked()
@@ -165,6 +176,45 @@ class FleetView:
             if step > int(worst["step"]):
                 worst = {"state": str(s.get("brownout", "?")), "step": step}
         return worst
+
+
+def sample_from_ready(rid: str, seq: int, ready: dict) -> dict:
+    """Synthesize a gossip-shaped control sample from a /readyz body.
+
+    The router folds one per successful probe into its OWN FleetView, so
+    the autoscaler consumes the same (occupancy, brownout rung, DEGRADED)
+    vocabulary — with the same seq/TTL freshness discipline — whether the
+    signal travelled by bus gossip or by probe. The replica's exported
+    ``admission.occupancy`` is its LOCAL load only (local_pressure) —
+    never the folded fleet floor, which would echo pressure rumors back
+    into the view."""
+    adm = ready.get("admission") or {}
+    occ = adm.get("occupancy")
+    if not isinstance(occ, (int, float)):
+        # Older replicas without the export: approximate the LOCAL load
+        # from per-class in-flight counts (never the gossiped floor —
+        # folding it back in re-creates the echo the export avoids).
+        classes = adm.get("classes") or {}
+        loads = [
+            c.get("inflight", 0) / c["limit"]
+            for c in classes.values()
+            if isinstance(c, dict) and c.get("limit")
+        ]
+        occ = max(loads, default=0.0)
+    dev = ready.get("device") or {}
+    out = {
+        "replica": rid,
+        "seq": int(seq),
+        "ts": time.time(),
+        "occupancy": round(float(occ), 4),
+        "brownout": adm.get("brownout", "normal"),
+        "brownout_step": int(adm.get("brownout_step", 0) or 0),
+        "degraded": bool(dev.get("degraded")),
+    }
+    own = ready.get("ownership") or {}
+    if isinstance(own.get("epoch"), int):
+        out["ownership_epoch"] = own["epoch"]
+    return out
 
 
 class GossipPublisher:
@@ -207,7 +257,11 @@ class GossipPublisher:
             "replica": self.replica_id,
             "seq": self._seq,
             "ts": time.time(),
-            "occupancy": round(self.admission.pressure(), 4),
+            # LOCAL load only: publishing the combined pressure() would
+            # echo a peer's gossiped floor back out as our own occupancy
+            # and two replicas then refresh each other's floor forever —
+            # the floor is an input (tick_inputs), never an output.
+            "occupancy": round(self.admission.local_pressure(), 4),
             "brownout": brown.state,
             "brownout_step": brown.step,
             "degraded": bool(self.health.degraded),

@@ -43,6 +43,7 @@ from aiohttp import web
 
 from kakveda_tpu.core import faults as _faults
 from kakveda_tpu.core import metrics as _metrics
+from kakveda_tpu.fleet.gossip import FleetView, sample_from_ready
 from kakveda_tpu.fleet.hashring import HashRing
 
 log = logging.getLogger("kakveda.fleet")
@@ -65,6 +66,8 @@ _FAULT_PROMOTE = _faults.site("fleet.promote")
 ROUTER_KEY: web.AppKey["Router"] = web.AppKey("fleet_router", object)  # type: ignore[type-var]
 _PROBE_TASK_KEY: web.AppKey[object] = web.AppKey("fleet_probe_task", object)
 _SUPERVISE_TASK_KEY: web.AppKey[object] = web.AppKey("fleet_supervise_task", object)
+AUTOSCALER_KEY: web.AppKey[object] = web.AppKey("fleet_autoscaler", object)
+_AUTOSCALE_TASK_KEY: web.AppKey[object] = web.AppKey("fleet_autoscale_task", object)
 
 # Bounded hot-key accounting: enough keys to see real skew, cheap enough
 # to keep on the forward hot path.
@@ -136,6 +139,16 @@ class Router:
             rid: {"fails": 0, "ejected": False, "healthy": None, "ready": None}
             for rid in self.backends
         }
+        # The router's own fold of the fleet's control vocabulary: one
+        # gossip-shaped sample per successful probe (gossip.
+        # sample_from_ready) under the SAME seq/TTL freshness discipline
+        # the replicas use on the bus — the autoscaler's policy input.
+        self.fleet_view = FleetView(
+            ttl_s=_env_float("KAKVEDA_FLEET_GOSSIP_TTL_S", 5.0)
+        )
+        self._probe_fold_seq = 0
+        # Mounted by make_router_app(autoscale=…); report() exposes it.
+        self.autoscaler = None
         self._client = None  # httpx.AsyncClient, bound at app startup
         self._hot_keys: Dict[str, int] = {}
         self._hot_total = 0
@@ -202,6 +215,15 @@ class Router:
     def ejected(self) -> List[str]:
         return [rid for rid, st in self._state.items() if st["ejected"]]
 
+    def liveness(self) -> Dict[str, bool]:
+        """Per-replica routability (healthy AND not ejected) — the same
+        verdict broadcast_verdicts gossips; the autoscaler's dead-replica
+        detection input."""
+        return {
+            rid: bool(st["healthy"]) and not st["ejected"]
+            for rid, st in self._state.items()
+        }
+
     def candidates(self, key: str, attempts: int) -> List[str]:
         """The owner + failover order for ``key``, ejected replicas
         skipped — unless that empties the list (all ejected), in which
@@ -230,7 +252,9 @@ class Router:
     # -- failure accounting ---------------------------------------------
 
     def note_result(self, rid: str, ok: bool) -> None:
-        st = self._state[rid]
+        st = self._state.get(rid)
+        if st is None:
+            return  # removed by a concurrent scale-down mid-flight
         if ok:
             st["fails"] = 0
             return
@@ -275,7 +299,13 @@ class Router:
         for i, rid in enumerate(cands):
             if i > 0:
                 self._m_reroutes.inc()
-            url = self.backends[rid] + path
+            base = self.backends.get(rid)
+            if base is None:
+                # Removed by a concurrent scale-down between candidate
+                # selection and dispatch — walk on, don't 500.
+                last_err = f"{rid} removed"
+                continue
+            url = base + path
             try:
                 _FAULT_FORWARD.fire()
                 async with self._client.request(
@@ -453,6 +483,60 @@ class Router:
             self._own_dirty = False
         return ok
 
+    async def rebalance_to(self, members: Dict[str, str]) -> dict:
+        """Drive the range-migration protocol to an explicit target
+        membership — THE membership-change epoch write path. Both the
+        POST /fleet/rebalance handler and the autoscaler go through
+        here, so the router stays the single epoch writer (the
+        autoscaler requests; run_rebalance's flip push commits, and any
+        residual promotion retries ride the probe loop's dirty flag).
+        Raises :class:`~kakveda_tpu.fleet.ownership.MigrationError` with
+        ``flipped`` provenance; flipped=False means the old view still
+        rules everywhere and a full retry is safe."""
+        from kakveda_tpu.fleet import ownership as _own
+
+        if self.ownership is None:
+            raise RuntimeError("ownership disabled")
+        old = self.ownership
+        new = old.with_members(dict(members))
+        summary = await asyncio.get_running_loop().run_in_executor(
+            None, lambda: _own.run_rebalance(old, new)
+        )
+        for rid, url in new.members.items():
+            self.add_backend(rid, url)
+        for rid in [r for r in self.backends if r not in new.members]:
+            self.remove_backend(rid)
+        self.set_ownership(new)
+        self._m_promote.inc()
+        return summary
+
+    async def resync_member(self, rid: str) -> dict:
+        """Heal a replaced member's GFKB gap: snapshot-ship its held
+        (owned + standby) arcs back from the surviving holders through
+        the SAME migration protocol — ``run_rebalance`` from the view
+        WITHOUT the member (same epoch, export basis only; never pushed)
+        to the full view at epoch+1 ships exactly the arcs whose holder
+        set regains the member, then drains the watermark delta.
+        Row-idempotent by construction: deterministic ``mig-*`` event ids
+        plus signature-keyed upserts mean re-shipped rows the member
+        already holds update in place, never duplicate."""
+        from kakveda_tpu.fleet import ownership as _own
+
+        view = self.ownership
+        if view is None or rid not in view.members:
+            return {}
+        donors = {r: u for r, u in view.members.items() if r != rid}
+        if not donors:
+            return {}
+        old = view.with_members(donors, epoch=view.epoch)
+        new = view.with_epoch(view.epoch + 1)
+        summary = await asyncio.get_running_loop().run_in_executor(
+            None, lambda: _own.run_rebalance(old, new)
+        )
+        self.set_ownership(new)
+        self._m_promote.inc()
+        return summary
+
     def add_backend(self, rid: str, url: str) -> None:
         """Grow the routable fleet at runtime (scale-out): extend the
         backend map + ring and mint the per-replica metric children the
@@ -494,6 +578,21 @@ class Router:
         )
         self._m_load[rid] = load.labels(replica=rid)
 
+    def remove_backend(self, rid: str) -> None:
+        """Shrink the routable fleet at runtime (lossless scale-down
+        epilogue — the victim's arcs were already migrated away). A
+        DELIBERATE membership change, unlike ejection, which never
+        touches ring membership. Metric children stay minted (their
+        counters keep their history); the probe loop prunes its due map."""
+        if rid not in self.backends:
+            return
+        del self.backends[rid]
+        self._state.pop(rid, None)
+        self.ring = HashRing(list(self.backends), vnodes=self.ring.vnodes)
+        m = self._m_healthy.get(rid)
+        if m is not None:
+            m.set(0.0)
+
     # -- probe-verdict broadcast (one liveness world-view) ---------------
 
     async def broadcast_verdicts(self) -> None:
@@ -511,11 +610,11 @@ class Router:
             "seq": self._verdict_seq,
             "ts": time.time(),
             "occupancy": 0.0,
-            "probe_verdicts": {
-                rid: bool(st["healthy"]) and not st["ejected"]
-                for rid, st in self._state.items()
-            },
+            "probe_verdicts": self.liveness(),
         }
+        # The router's own view folds the verdicts too, so its
+        # fleet_pressure() skips dead peers exactly like a replica's.
+        self.fleet_view.fold(sample)
         body = json.dumps(sample).encode("utf-8")
         for rid, st in list(self._state.items()):
             if not st["healthy"]:
@@ -545,6 +644,10 @@ class Router:
                 if r.status != 200:
                     raise ValueError(f"readyz HTTP {r.status}")
                 st["ready"] = await r.json()
+            self._probe_fold_seq += 1
+            self.fleet_view.fold(
+                sample_from_ready(rid, self._probe_fold_seq, st["ready"])
+            )
             st["healthy"] = True
             st["fails"] = 0
             if st["ejected"]:
@@ -597,6 +700,8 @@ class Router:
         while True:
             for rid in self.backends:  # add_backend: newcomers self-heal in
                 due.setdefault(rid, time.monotonic() + self.probe_phase(rid))
+            for rid in [r for r in due if r not in self.backends]:
+                del due[rid]  # remove_backend (scale-down) prunes out
             rid = min(due, key=due.get)
             delay = due[rid] - time.monotonic()
             if delay > 0:
@@ -663,6 +768,8 @@ class Router:
                 "members": list(view.members),
                 "coverage_holes": view.coverage_holes(live),
             }
+        if self.autoscaler is not None:
+            out["autoscale"] = self.autoscaler.info()
         return out
 
 
@@ -742,6 +849,7 @@ def make_router_app(
     backends: Dict[str, str],
     *,
     supervisor=None,
+    autoscale=None,
     **router_kw,
 ) -> web.Application:
     """Build the front-router app over ``{replica_id: base_url}``.
@@ -749,6 +857,12 @@ def make_router_app(
     ``supervisor`` (optional, a :class:`fleet.supervisor.FleetSupervisor`)
     enables the supervise loop: dead replica processes are restarted up to
     ``KAKVEDA_FLEET_RESTARTS`` times each (default 0 — route around only).
+
+    ``autoscale=(min, max)`` (requires ``supervisor``) mounts the elastic
+    :class:`fleet.autoscaler.Autoscaler` policy loop instead — replacement
+    of dead replicas subsumes the supervise loop's restart duty, so the
+    two are never mounted together (a double-start race on the same
+    replica index otherwise).
 
     ``KAKVEDA_FLEET_OWNERSHIP=1`` (or an ``ownership=`` OwnershipView kw)
     turns on sharded ownership: warn/match become scatter-gather merges,
@@ -779,13 +893,25 @@ def make_router_app(
         app[_PROBE_TASK_KEY] = asyncio.get_running_loop().create_task(
             router.probe_loop()
         )
-        if supervisor is not None:
+        if autoscale is not None and supervisor is not None:
+            from kakveda_tpu.fleet.autoscaler import Autoscaler
+
+            mn, mx = autoscale
+            scaler = Autoscaler(
+                router, supervisor, min_replicas=int(mn), max_replicas=int(mx)
+            )
+            router.autoscaler = scaler
+            app[AUTOSCALER_KEY] = scaler
+            app[_AUTOSCALE_TASK_KEY] = asyncio.get_running_loop().create_task(
+                scaler.run()
+            )
+        elif supervisor is not None:
             app[_SUPERVISE_TASK_KEY] = asyncio.get_running_loop().create_task(
                 _supervise_loop(router, supervisor)
             )
 
     async def _cleanup(app):
-        for key in (_PROBE_TASK_KEY, _SUPERVISE_TASK_KEY):
+        for key in (_PROBE_TASK_KEY, _SUPERVISE_TASK_KEY, _AUTOSCALE_TASK_KEY):
             t = app.get(key)
             if t is not None:
                 t.cancel()
@@ -878,19 +1004,12 @@ def make_router_app(
             return web.json_response({"ok": False, "error": str(e)}, status=422)
         from kakveda_tpu.fleet import ownership as _own
 
-        old = router.ownership
-        new = old.with_members(members)
         try:
-            summary = await asyncio.get_running_loop().run_in_executor(
-                None, lambda: _own.run_rebalance(old, new)
-            )
+            summary = await router.rebalance_to(members)
         except _own.MigrationError as e:
             return web.json_response(
                 {"ok": False, "error": str(e), "flipped": e.flipped}, status=409
             )
-        for rid, url in new.members.items():
-            router.add_backend(rid, url)
-        router.set_ownership(new)
         return web.json_response({"ok": True, **summary})
 
     app.add_routes(

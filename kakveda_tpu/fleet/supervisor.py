@@ -18,8 +18,13 @@ with its fleet identity in env: ``KAKVEDA_REPLICA_ID``,
 ``KAKVEDA_FLEET_SELF``, ``KAKVEDA_FLEET_PEERS`` — the service app wires
 gossip + replication from those (service/app.py).
 
-Teardown is SIGTERM + bounded wait (never SIGKILL first — a replica
-holding a real TPU lease must exit cleanly or be left alone, CLAUDE.md).
+Teardown is SIGTERM + bounded wait, THEN a bounded SIGKILL escalation
+(``KAKVEDA_FLEET_STOP_KILL_S``) — but never on a replica that may hold a
+real TPU lease (CLAUDE.md: a killed lease holder wedges the device for
+hours). Lease detection is conservative: ``KAKVEDA_FLEET_TPU_LEASE=1``
+forces the marker on, and absent an explicit non-TPU platform pin in the
+child env the lease is ASSUMED — only cpu-pinned children (bench/test
+fleets) are safe to escalate.
 """
 
 from __future__ import annotations
@@ -33,7 +38,7 @@ import subprocess
 import sys
 import time
 from pathlib import Path
-from typing import Dict, List, Optional
+from typing import Dict, Iterable, List, Optional
 
 log = logging.getLogger("kakveda.fleet")
 
@@ -80,6 +85,13 @@ class FleetSupervisor:
         self.extra_env = dict(env or {})
         self.router_port = router_port
         self.procs: Dict[int, subprocess.Popen] = {}
+        # Indices drained away by the autoscaler: excluded from the
+        # active fleet (backend_map/poll/manifest) and recycled first by
+        # add_replica so ports and ring positions stay bounded.
+        self.retired: set = set()
+        # (min, max) when the fleet runs under an autoscaler — stamped
+        # into the manifest so status/doctor know to report scale state.
+        self.autoscale: Optional[tuple] = None
         self.root.mkdir(parents=True, exist_ok=True)
 
     # -- identity --------------------------------------------------------
@@ -90,12 +102,16 @@ class FleetSupervisor:
     def url(self, i: int) -> str:
         return f"http://{self.host}:{self.port_base + i}"
 
+    def active_indices(self) -> List[int]:
+        """Spawned-slot indices minus the retired ones — the fleet."""
+        return [i for i in range(self.n) if i not in self.retired]
+
     def urls(self) -> List[str]:
-        return [self.url(i) for i in range(self.n)]
+        return [self.url(i) for i in self.active_indices()]
 
     def backend_map(self) -> Dict[str, str]:
         """{replica_id: url} — what make_router_app consumes."""
-        return {self.replica_id(i): self.url(i) for i in range(self.n)}
+        return {self.replica_id(i): self.url(i) for i in self.active_indices()}
 
     def pid_file(self, i: int) -> Path:
         return self.root / f"replica-{i}.pid"
@@ -119,7 +135,8 @@ class FleetSupervisor:
         if repo not in parts:
             parts.append(repo)
         env["PYTHONPATH"] = os.pathsep.join(parts)
-        peers = [self.url(j) for j in range(self.n) if j != i]
+        active = self.active_indices()
+        peers = [self.url(j) for j in active if j != i]
         env.update(
             KAKVEDA_REPLICA_ID=self.replica_id(i),
             KAKVEDA_FLEET_SELF=self.url(i),
@@ -130,7 +147,7 @@ class FleetSupervisor:
             # add_replica see the grown membership; earlier children learn
             # it from the epoch'd /fleet/ownership push instead.
             KAKVEDA_FLEET_MEMBERS=",".join(
-                f"{self.replica_id(j)}={self.url(j)}" for j in range(self.n)
+                f"{self.replica_id(j)}={self.url(j)}" for j in active
             ),
         )
         env.update(self.extra_env)
@@ -158,20 +175,35 @@ class FleetSupervisor:
         return proc
 
     def start_all(self) -> None:
-        for i in range(self.n):
+        for i in self.active_indices():
             self.start(i)
         self.write_manifest()
 
     def add_replica(self) -> int:
-        """Scale out by one: spawn replica ``n`` on the next port and
-        refresh the manifest. The caller (router /fleet/rebalance, bench,
-        drill) still owns the range migration — this only creates the
-        process. Returns the new replica index."""
-        i = self.n
-        self.n = i + 1
+        """Scale out by one: recycle the lowest retired slot (its port
+        and ring position come back) or spawn replica ``n`` on the next
+        port, then refresh the manifest. The caller (router
+        /fleet/rebalance, autoscaler, bench, drill) still owns the range
+        migration — this only creates the process. Returns the index."""
+        if self.retired:
+            i = min(self.retired)
+            self.retired.discard(i)
+        else:
+            i = self.n
+            self.n = i + 1
         self.start(i)
         self.write_manifest()
         return i
+
+    def retire(self, i: int) -> None:
+        """Drop a (stopped) replica from the active fleet — the
+        autoscaler's scale-down epilogue. The slot recycles via
+        add_replica; the data dir stays (its rows were migrated away,
+        logs keep their forensic value)."""
+        self.retired.add(i)
+        self.procs.pop(i, None)
+        self.pid_file(i).unlink(missing_ok=True)
+        self.write_manifest()
 
     # -- watch -----------------------------------------------------------
 
@@ -180,15 +212,22 @@ class FleetSupervisor:
         return p is not None and p.poll() is None
 
     def poll_dead(self) -> List[int]:
-        return [i for i in range(self.n) if i in self.procs and not self.alive(i)]
+        return [
+            i for i in self.active_indices()
+            if i in self.procs and not self.alive(i)
+        ]
 
-    def wait_ready(self, timeout_s: float = 180.0) -> None:
+    def wait_ready(self, timeout_s: float = 180.0,
+                   only: Optional[Iterable[int]] = None) -> None:
         """Block until every replica's /readyz answers — replica startup
-        (jax import + platform build) dominates fleet bring-up."""
+        (jax import + platform build) dominates fleet bring-up. ``only``
+        narrows the wait to those indices: the autoscaler waits on JUST
+        the replica it spawned, so an unrelated peer dying mid-spawn (the
+        flash-crowd crash drill) cannot fail the scale-up."""
         import httpx
 
         deadline = time.monotonic() + timeout_s
-        pending = set(range(self.n))
+        pending = set(self.active_indices() if only is None else only)
         while pending:
             for i in sorted(pending):
                 if not self.alive(i):
@@ -215,7 +254,26 @@ class FleetSupervisor:
 
     # -- teardown --------------------------------------------------------
 
+    def may_hold_device_lease(self, i: int) -> bool:
+        """Conservative TPU-lease marker for the SIGKILL escalation below
+        (CLAUDE.md gotcha: killing a lease holder wedges the device for
+        hours). ``KAKVEDA_FLEET_TPU_LEASE=1`` forces it on; otherwise a
+        lease is ASSUMED unless the child env pins jax to a leaseless
+        platform (``JAX_PLATFORMS`` set and TPU-free — the cpu-pinned
+        bench/test fleets)."""
+        env = {**os.environ, **self.extra_env}
+        if env.get("KAKVEDA_FLEET_TPU_LEASE") == "1":
+            return True
+        plats = env.get("JAX_PLATFORMS", "").strip().lower()
+        if not plats:
+            return True  # default backend may be the remote TPU
+        return any(p.strip() in ("tpu", "axon") for p in plats.split(","))
+
     def stop(self, i: int, timeout_s: float = 20.0, sig: int = signal.SIGTERM) -> None:
+        """Signal + bounded wait, then a bounded SIGKILL escalation so a
+        wedged replica cannot hang `down`/scale-down forever — except on
+        a replica that may hold the device lease, which is left alone
+        (warned) by design."""
         p = self.procs.get(i)
         if p is None or p.poll() is not None:
             return
@@ -225,10 +283,30 @@ class FleetSupervisor:
             return
         try:
             p.wait(timeout=timeout_s)
+            return
         except subprocess.TimeoutExpired:
+            pass
+        if self.may_hold_device_lease(i):
             log.warning("replica %d did not exit within %.0fs; leaving it "
                         "(never SIGKILL a process that may hold a device "
                         "lease)", i, timeout_s)
+            return
+        grace = 5.0
+        try:
+            grace = float(os.environ.get("KAKVEDA_FLEET_STOP_KILL_S", "") or 5.0)
+        except ValueError:
+            pass
+        log.warning("replica %d did not exit within %.0fs; escalating to "
+                    "SIGKILL (no device-lease marker; reap grace %.0fs)",
+                    i, timeout_s, grace)
+        try:
+            p.kill()
+            p.wait(timeout=max(0.1, grace))
+        except ProcessLookupError:
+            return
+        except subprocess.TimeoutExpired:
+            log.warning("replica %d still not reaped %.0fs after SIGKILL",
+                        i, grace)
 
     def stop_all(self, timeout_s: float = 20.0) -> None:
         for i in list(self.procs):
@@ -262,9 +340,15 @@ class FleetSupervisor:
                     "log_file": str(self.log_file(i)),
                     "data_dir": str(self.data_dir(i)),
                 }
-                for i in range(self.n)
+                for i in self.active_indices()
             ],
         }
+        if self.autoscale is not None:
+            manifest["autoscale"] = {
+                "min": int(self.autoscale[0]),
+                "max": int(self.autoscale[1]),
+                "scale_log": str(self.root / "data" / "scale_log.jsonl"),
+            }
         (self.root / "fleet.json").write_text(json.dumps(manifest, indent=2))
 
 

@@ -617,13 +617,23 @@ def make_app(
             {"ok": True, "stale": False, "epoch": new_view.epoch}
         )
 
+    # Migration export is CONTROL PLANE, not tenant background work: the
+    # flood that trips the autoscaler is the same flood a background
+    # admission slot would shed this ship behind, and a fleet that cannot
+    # migrate while saturated can never scale OUT of saturation
+    # (metastable). Bounded by its own tiny in-flight counter instead —
+    # shed-never-hang still holds: past the bound it 429s immediately and
+    # the router's next rebalance attempt retries.
+    export_inflight = 0
+
     async def fleet_export(request):
         """Migration export (fleet/ownership.py run_rebalance): the rows
         past ``since`` that THIS replica is the responsible source for,
         grouped by gaining target. Pure read — rows ship as replication
         dicts and re-embed deterministically at the target (hashed n-gram
-        featurizer), so no vector payloads cross the wire. Runs under a
-        background slot off the event loop like /snapshot."""
+        featurizer), so no vector payloads cross the wire. Runs off the
+        event loop under its own control-plane bound (never the
+        background class — tenant floods must not starve a migration)."""
         if own_state is None:
             return _json_error(409, "ownership disabled on this replica")
         from kakveda_tpu.fleet.ownership import (
@@ -643,11 +653,17 @@ def make_app(
             return _json_error(422, f"bad export request: {e}")
         import asyncio as _asyncio
 
+        nonlocal export_inflight
+        if export_inflight >= 2:
+            return _json_error(429, "export concurrency bound")
         loop = _asyncio.get_running_loop()
-        with adm.slot("background"):
+        export_inflight += 1
+        try:
             rows, count = await loop.run_in_executor(
                 None, plat.gfkb.export_rows, since
             )
+        finally:
+            export_inflight -= 1
         grouped: dict = {}
         for row in rows:
             key = shard_key_of_row(row)

@@ -21,11 +21,16 @@ Every dispatch terminates in exactly one bucket:
 ``run_chaos`` applies timeline actions at offsets (same clock + speed
 factor as the replay): ``faults`` re-arms `core/faults.py` (empty spec
 ends the outage window — disarm IS recovery), ``kill_replica`` /
-``restart_replica`` drive a FleetSupervisor, ``fleet_pressure`` feeds
+``restart_replica`` drive a FleetSupervisor, ``crash_replica`` hard-kills
+one (SIGKILL — the dead-owner drill; skipped with a warning when the
+replica may hold the TPU lease, per the never-kill-the-lease-holder
+gotcha), ``fleet_pressure`` feeds
 ``AdmissionController.note_fleet_pressure`` exactly as a peer's gossip
-sample would. Actions needing a handle the caller didn't provide are
-skipped with a warning, never fatal — a single-process storm simply has
-no replicas to kill.
+sample would, and ``scale_events`` snapshots the threaded autoscaler's
+decision counters into the chaos log (a measurement, not a mutation).
+Actions needing a handle the caller didn't provide are skipped with a
+warning, never fatal — a single-process storm simply has no replicas to
+kill.
 """
 
 from __future__ import annotations
@@ -33,6 +38,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import os
+import signal
 import time
 from dataclasses import dataclass, field
 from typing import Awaitable, Callable, Dict, List, Optional
@@ -190,7 +196,7 @@ async def replay(events: List[dict], *, post: PostFn, speed: float = 1.0,
 
 
 async def run_chaos(timeline: List[dict], *, speed: float = 1.0,
-                    supervisor=None, admission=None,
+                    supervisor=None, admission=None, autoscaler=None,
                     callbacks: Optional[Dict[str, Callable]] = None,
                     t0: Optional[float] = None) -> List[dict]:
     """Apply chaos actions at their offsets (``t0`` lets the caller share
@@ -227,6 +233,35 @@ async def run_chaos(timeline: List[dict], *, speed: float = 1.0,
                     # lease); keep that wait off the event loop.
                     fn = supervisor.stop if kind == "kill_replica" else supervisor.start
                     await loop.run_in_executor(None, fn, i)
+            elif kind == "crash_replica":
+                # Hard owner death (the replacement drill): SIGKILL with
+                # zero grace so the replica cannot drain — UNLESS it may
+                # hold the TPU lease (CLAUDE.md gotcha: a killed lease
+                # holder wedges every later backend init for hours).
+                if supervisor is None:
+                    entry.update(applied=False, reason="no supervisor")
+                else:
+                    i = int(act.get("replica", 0))
+                    if supervisor.may_hold_device_lease(i):
+                        entry.update(applied=False,
+                                     reason="replica may hold TPU lease")
+                    else:
+                        await loop.run_in_executor(
+                            None,
+                            lambda: supervisor.stop(
+                                i, timeout_s=0.5, sig=signal.SIGKILL),
+                        )
+            elif kind == "scale_events":
+                # Measurement-only: snapshot the autoscaler's decision
+                # ledger into the chaos log at this offset.
+                if autoscaler is None:
+                    entry.update(applied=False, reason="no autoscaler")
+                else:
+                    entry["scale"] = {
+                        "counts": autoscaler.decision_counts(),
+                        "flaps": autoscaler.flap_count(),
+                        "state": autoscaler.info().get("state"),
+                    }
             elif kind == "fleet_pressure":
                 if admission is None:
                     entry.update(applied=False, reason="no admission")
@@ -272,13 +307,17 @@ async def _watch_recovery(result: ReplayResult, admission, storm_end_s: float,
 async def run_scenario(scenario, *, post: PostFn, speed: float = 1.0,
                        max_concurrency: Optional[int] = None,
                        timeout_s: Optional[float] = None,
-                       supervisor=None, admission=None,
+                       supervisor=None, admission=None, autoscaler=None,
                        callbacks: Optional[Dict[str, Callable]] = None,
                        extra_dispatch: Optional[Dict[str, LocalFn]] = None,
                        recovery_horizon_s: float = 30.0) -> ReplayResult:
     """Replay a Scenario with its chaos timeline on the same clock, then
     (when the scenario declares storm phases and an admission handle is
-    given) measure ladder recovery after the storm window closes."""
+    given) measure ladder recovery after the storm window closes.
+
+    An ``autoscaler`` handle enables the ``scale_events`` chaos action and
+    stuffs ``notes["scale_flaps"]`` / ``notes["scale_decisions"]`` into
+    the result for the ``max_scale_flaps`` SLO gate."""
     res = ReplayResult()
     loop = asyncio.get_running_loop()
     t0 = loop.time()
@@ -288,10 +327,14 @@ async def run_scenario(scenario, *, post: PostFn, speed: float = 1.0,
     if scenario.chaos:
         jobs.append(run_chaos(scenario.chaos, speed=speed, t0=t0,
                               supervisor=supervisor, admission=admission,
-                              callbacks=callbacks))
+                              autoscaler=autoscaler, callbacks=callbacks))
     storm_end = scenario.notes.get("storm_end_s")
     if storm_end is not None and admission is not None:
         jobs.append(_watch_recovery(res, admission, float(storm_end),
                                     speed, t0, recovery_horizon_s))
     await asyncio.gather(*jobs)
+    if autoscaler is not None:
+        res.notes["scale_flaps"] = float(autoscaler.flap_count())
+        res.notes["scale_decisions"] = float(
+            sum(autoscaler.decision_counts().values()))
     return res

@@ -21,7 +21,13 @@ offsets while the replay runs —
     {"t": 6.0, "action": "faults", "spec": ""}            ← outage ends
     {"t": 5.0, "action": "kill_replica", "replica": 1}
     {"t": 5.5, "action": "restart_replica", "replica": 1}
+    {"t": 5.2, "action": "crash_replica", "replica": 1}   ← SIGKILL (dead-owner
+                                                            drill; skipped when
+                                                            the replica may hold
+                                                            the TPU lease)
     {"t": 4.5, "action": "fleet_pressure", "pressure": 0.95, "ttl_s": 5.0}
+    {"t": 7.0, "action": "scale_events"}                  ← snapshot autoscaler
+                                                            counters (measurement)
 
 ``faults`` entries are full :func:`kakveda_tpu.core.faults.arm` specs
 (each REPLACES the arming — an empty spec closes the outage window, the
@@ -417,6 +423,93 @@ def rebalance_storm(seed: int = 0, *, duration_s: float = 10.0,
     )
 
 
+def flash_crowd(seed: int = 0, *, baseline_s: float = 8.0,
+                surge_s: float = 30.0, decay_s: float = 40.0,
+                warn_rps: float = 10.0, surge_x: float = 5.0,
+                bg_rps: float = 15.0, apps: int = 12,
+                hot_share: float = 0.3,
+                crash_replica: Optional[int] = None,
+                gossip_ttl_s: float = 5.0, max_scale_flaps: int = 1,
+                recovery_s: Optional[float] = None,
+                mine_mode: str = "full") -> Scenario:
+    """Elastic-fleet drill (fleet/autoscaler.py): flash crowd → dead owner.
+
+    * phase ``baseline`` ``[0, b)``: warn at ``warn_rps`` — the fleet
+      holds at ``KAKVEDA_SCALE_MIN`` replicas, occupancy well under the
+      scale-up threshold.
+    * phase ``storm`` ``[b, s)``: warn ramps to ``surge_x ×`` over the
+      first fifth of the window and holds, plus a background mine flood
+      (``bg_rps`` past the background class bound — the sheddable excess
+      that pins occupancy at 1.0; ``mine_mode="full"`` by default so each
+      admitted mine is a real O(N²) burn, not an empty-delta no-op the
+      probe would sample as idle). Sustained pressure must carry the
+      autoscaler through its dwell and spawn fresh replicas — size
+      ``surge_s`` to cover dwell + replica cold-start (a jax import is
+      tens of seconds on CPU).
+    * at ``s`` (surge end) the optional ``crash_replica`` fires: one
+      OWNER dies by SIGKILL — no drain, no goodbye gossip. The autoscaler
+      must declare it dead past ``KAKVEDA_SCALE_REPLACE_S``, give a fresh
+      replica its ring position, and heal its rows (snapshot-ship +
+      DLQ replay) — replacement outranks elastic actions in the policy.
+    * phase ``recovery`` ``[s, end)``: warn back at baseline rate long
+      enough for the replacement AND the lossless scale-down drains
+      (migrate-then-SIGTERM, never stop-then-migrate) to complete.
+
+    The attached SLO is the elastic acceptance contract the ``elastic``
+    bench row self-certifies: zero lost warns, zero hung, sheds confined
+    to interactive/background, and at most ``max_scale_flaps`` direction
+    reversals (a clean 2→4→2 cycle is exactly one flap — anything more is
+    ring flapping). ``scale_events`` entries snapshot the autoscaler's
+    decision ledger at each phase boundary for the chaos log."""
+    rng = random.Random(seed)
+    b = round(baseline_s, 3)
+    s = round(baseline_s + surge_s, 3)
+    duration_s = round(baseline_s + surge_s + decay_s, 3)
+    phase = lambda t: "baseline" if t < b else ("storm" if t < s else "recovery")  # noqa: E731
+    ramp = max(1e-6, 0.2 * surge_s)
+
+    def warn_rate(t: float) -> float:
+        if t < b or t >= s:
+            return warn_rps
+        return warn_rps * min(surge_x, 1.0 + (surge_x - 1.0) * (t - b) / ramp)
+
+    events = [
+        _warn_event(t, _pick_app(rng, apps, hot_share), i, phase(t))
+        for i, t in enumerate(_arrivals(rng, duration_s, warn_rate))
+    ]
+    for t in _arrivals(rng, duration_s, lambda t: bg_rps if b <= t < s else 0.0):
+        events.append({
+            "t": t, "method": "POST", "path": "/patterns/mine",
+            "klass": "background", "app_id": "miner", "phase": "storm",
+            "body": {"mode": mine_mode},
+        })
+    events.sort(key=lambda e: e["t"])
+
+    chaos: List[dict] = [
+        {"t": b, "action": "scale_events"},
+        {"t": round(b + 0.5 * surge_s, 3), "action": "scale_events"},
+        {"t": s, "action": "scale_events"},
+        {"t": round(duration_s - 0.5, 3), "action": "scale_events"},
+    ]
+    if crash_replica is not None:
+        chaos.append({"t": s, "action": "crash_replica",
+                      "replica": int(crash_replica)})
+    chaos.sort(key=lambda c: c["t"])
+    return Scenario(
+        name="flash_crowd", seed=seed, duration_s=duration_s, events=events,
+        chaos=chaos,
+        slo=SLO(
+            shed_only=("interactive", "background"),
+            zero_hung=True,
+            zero_lost=("warn",),
+            recovery_s=recovery_s,
+            max_scale_flaps=max_scale_flaps,
+        ),
+        notes={"storm_start_s": b, "storm_end_s": s,
+               "gossip_ttl_s": gossip_ttl_s},
+    )
+
+
 SCENARIOS: Dict[str, Callable[..., Scenario]] = {
     "diurnal": diurnal_wave,
     "hot_key": hot_key_skew,
@@ -425,6 +518,7 @@ SCENARIOS: Dict[str, Callable[..., Scenario]] = {
     "mixed": mixed_contention,
     "storm": storm,
     "rebalance_storm": rebalance_storm,
+    "flash_crowd": flash_crowd,
 }
 
 

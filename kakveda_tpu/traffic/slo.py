@@ -25,6 +25,10 @@ Gates (the storm bench row self-certifies all of them in-run):
 * ``max_partial_rate`` — sharded ownership: ceiling on the share of ok
   warn verdicts whose scatter-gather merge was ``partial=true`` (a range
   had no answering holder). Fed from ``ReplayResult.notes["partial"]``.
+* ``max_scale_flaps`` — elastic fleet: ceiling on autoscaler direction
+  reversals (executed scale-up↔scale-down flips) during the run. Fed
+  from ``ReplayResult.notes["scale_flaps"]`` (the replayer stuffs it
+  when an autoscaler handle was threaded through ``run_scenario``).
 
 Table of which scenario declares what: docs/robustness.md § traffic
 harness.
@@ -65,6 +69,10 @@ class SLO:
     # coverage). Reads result.notes["partial"] — the caller's post fn
     # counts partials there; no notes at all leaves the gate vacuous.
     max_partial_rate: Optional[float] = None
+    # Elastic-fleet arm: ceiling on executed scale-direction reversals
+    # (a 2→4→2 flash-crowd cycle is exactly one flap). Reads
+    # result.notes["scale_flaps"]; vacuous when no autoscaler ran.
+    max_scale_flaps: Optional[int] = None
 
 
 @dataclass
@@ -173,6 +181,16 @@ def evaluate(slo: SLO, result) -> SLOReport:
         else:
             add("max_partial_rate", True, "no partial accounting",
                 slo.max_partial_rate)
+
+    if slo.max_scale_flaps is not None:
+        notes = getattr(result, "notes", {}) or {}
+        if "scale_flaps" in notes:
+            flaps = int(notes["scale_flaps"])
+            add("max_scale_flaps", flaps <= slo.max_scale_flaps,
+                flaps, slo.max_scale_flaps)
+        else:
+            add("max_scale_flaps", True, "no autoscaler accounting",
+                slo.max_scale_flaps)
 
     if slo.recovery_s is not None:
         rec = result.ladder_recovery_s

@@ -13,6 +13,9 @@
 #      throwaway jit — proves the runtime half of the device pass wires
 #      up on this interpreter (jax import, monitoring listener, metrics
 #      families) without a TPU.
+#   4. the autoscaler policy selftest: the canned decision table over the
+#      PURE decide/commit functions (fleet/autoscaler.py) — no processes,
+#      no router, ~1 s; a hysteresis/backoff regression fails pre-commit.
 #
 # Exit: non-zero on the first failing stage. Tier-1 runs this via
 # tests/test_verify_static.py, so CI and the pre-commit habit share one
@@ -61,6 +64,14 @@ try:
 finally:
     ledger.uninstall()
     ledger.reset()
+EOF
+
+echo "== autoscaler policy selftest =="
+python - <<'EOF'
+from kakveda_tpu.fleet.autoscaler import policy_selftest
+
+n = policy_selftest()
+print(f"policy selftest: ok — {n} checks")
 EOF
 
 echo "verify_static: all stages green"
