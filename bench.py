@@ -2703,6 +2703,19 @@ def _bench_storm(backend: str) -> dict:
             "stalls": len(_rep["stalls"]),
         }
 
+    # Trace-plane certification, self-certifying like the SLO gates:
+    # every dispatch span ends in the same finally that buckets its
+    # record, so a storm run with tracing armed must leave ZERO orphan
+    # spans — started minus ended is the span analogue of a lost warn.
+    from kakveda_tpu.core import trace as _trace_mod
+
+    tplane = _trace_mod.get_tracer().plane()
+    if tplane.get("orphaned"):
+        raise AssertionError(
+            f"storm drill leaked {tplane['orphaned']} orphan span(s) "
+            f"(started {tplane['started']}, ended {tplane['ended']})"
+        )
+
     ratio = round(storm_p95 / max(base_p95, 1e-9), 2)
     return {
         "metric": "storm_warn_p95_degradation",
@@ -2728,6 +2741,7 @@ def _bench_storm(backend: str) -> dict:
         "late_p95_ms": res.late_p95_ms(),
         "fleet": fleet_out,
         "sanitizer": sanitizer_out,
+        "trace": tplane,
     }
 
 
@@ -3391,6 +3405,20 @@ def _metrics_plane() -> dict:
         return {}
 
 
+def _trace_plane() -> dict:
+    """Counters of the process-global causal tracer (core/trace.py),
+    folded into every bench JSON line next to metrics_plane: spans
+    started/ended/recorded/dropped plus the orphan count (started minus
+    ended — a nonzero value means some span never terminated, the trace
+    analogue of a lost warn)."""
+    try:
+        from kakveda_tpu.core.trace import get_tracer
+
+        return get_tracer().plane()
+    except Exception:  # noqa: BLE001 — telemetry must never sink a bench line
+        return {}
+
+
 def _lint_findings() -> int:
     """Invariant-lint finding count over this tree (the AST rules of
     scripts/lint_invariants.py, docs/static-analysis.md), folded into the
@@ -3752,6 +3780,7 @@ def main() -> int:
     if which in fns:
         out = fns[which](backend)
         out["metrics_plane"] = _metrics_plane()
+        out["trace_plane"] = _trace_plane()
         out["lint_findings"] = _lint_findings()
         out["concurrency_findings"] = _concurrency_findings()
         out["device_findings"] = _device_findings()
@@ -3834,6 +3863,7 @@ def main() -> int:
     headline = results[0]
     headline["extra_metrics"] = results[1:]
     headline["metrics_plane"] = _metrics_plane()
+    headline["trace_plane"] = _trace_plane()
     headline["lint_findings"] = _lint_findings()
     headline["concurrency_findings"] = _concurrency_findings()
     headline["device_findings"] = _device_findings()

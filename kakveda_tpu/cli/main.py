@@ -735,6 +735,38 @@ def _cmd_traffic(args: argparse.Namespace) -> int:
     return 0 if rep.ok else 1
 
 
+def _cmd_trace(args: argparse.Namespace) -> int:
+    """Fetch one trace from a running server / router and render its tree.
+
+    Against a router the id scatter-assembles across the fleet (GET
+    /trace/{id} merges every replica's ring); against a single replica it
+    is that process's ring only. Prints the ASCII tree plus per-source
+    span counts when present."""
+    import urllib.error
+    import urllib.request
+
+    url = args.url.rstrip("/") + "/trace/" + args.trace_id
+    try:
+        with urllib.request.urlopen(url, timeout=args.timeout) as r:
+            body = json.loads(r.read().decode("utf-8"))
+    except (urllib.error.URLError, OSError, ValueError) as e:
+        print(f"trace fetch failed: {url}: {e}", file=sys.stderr)
+        return 1
+    spans = body.get("spans") or []
+    if not spans:
+        print(f"no spans for trace {args.trace_id} at {args.url}")
+        return 1
+    tree = body.get("tree")
+    if not tree:
+        from kakveda_tpu.core.trace import render_trace
+
+        tree = render_trace(spans)
+    print(tree)
+    if body.get("sources"):
+        print(json.dumps({"sources": body["sources"]}))
+    return 0
+
+
 def _cmd_logs(args: argparse.Namespace) -> int:
     """Tail server.log (written by `up --detach`), optionally following —
     the reference's `logs` verb over a file instead of docker-compose
@@ -848,6 +880,14 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--gossip-ttl", type=float, default=5.0,
                     help="storm: gossip TTL / ladder recovery bound")
     sp.set_defaults(fn=_cmd_traffic)
+
+    sp = sub.add_parser(
+        "trace", help="fetch + render one causal trace (router assembles fleet-wide)"
+    )
+    sp.add_argument("trace_id", help="32-hex trace id (x-request-id of the request)")
+    sp.add_argument("--url", default="http://localhost:8000")
+    sp.add_argument("--timeout", type=float, default=5.0)
+    sp.set_defaults(fn=_cmd_trace)
 
     sp = sub.add_parser("doctor", help="check the runtime environment")
     sp.add_argument("--dir", default=".", help="project root (for .env)")

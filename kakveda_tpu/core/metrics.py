@@ -39,10 +39,12 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import threading
 import time
 import weakref
 from bisect import bisect_left
+from collections import OrderedDict
 from typing import Dict, Iterable, List, Optional, Tuple
 from kakveda_tpu.core import sanitize
 
@@ -80,6 +82,15 @@ def _fmt(v: float) -> str:
     if v == int(v) and abs(v) < 1e15:
         return str(int(v))
     return repr(float(v))
+
+
+def _fmt_exemplar(ex: Optional[Tuple[str, float, float]]) -> str:
+    """OpenMetrics exemplar suffix for a bucket line: a trace id linking
+    the bucket to one recent observation ('' when the bucket has none)."""
+    if not ex:
+        return ""
+    trace_id, v, ts = ex
+    return f' # {{trace_id="{_escape_label(trace_id)}"}} {_fmt(v)} {ts:.3f}'
 
 
 def _escape_label(v: str) -> str:
@@ -201,7 +212,7 @@ class Gauge(_Family):
 
 
 class _HistogramChild:
-    __slots__ = ("_lock", "_bounds", "counts", "sum", "count")
+    __slots__ = ("_lock", "_bounds", "counts", "sum", "count", "exemplars")
 
     def __init__(self, family: "Histogram"):
         self._lock = family._lock
@@ -209,14 +220,20 @@ class _HistogramChild:
         self.counts = [0] * (len(self._bounds) + 1)  # last = overflow (+Inf only)
         self.sum = 0.0
         self.count = 0
+        # Bucket idx → (trace_id, value, ts): one exemplar per bucket,
+        # last-write-wins — bounded by the bucket count, so "warn p95" is
+        # one click from its worst recent trace without growing the child.
+        self.exemplars: Dict[int, Tuple[str, float, float]] = {}
 
-    def observe(self, v: float) -> None:
+    def observe(self, v: float, exemplar: Optional[str] = None) -> None:
         v = float(v)
         idx = bisect_left(self._bounds, v)
         with self._lock:
             self.counts[idx] += 1
             self.sum += v
             self.count += 1
+            if exemplar:
+                self.exemplars[idx] = (str(exemplar), v, time.time())
 
 
 class Histogram(_Family):
@@ -238,8 +255,8 @@ class Histogram(_Family):
     def _child_cls(self):
         return _HistogramChild
 
-    def observe(self, v: float) -> None:
-        self._default().observe(v)
+    def observe(self, v: float, exemplar: Optional[str] = None) -> None:
+        self._default().observe(v, exemplar=exemplar)
 
 
 class MetricsRegistry:
@@ -310,13 +327,16 @@ class MetricsRegistry:
                     with fam._lock:
                         counts = list(child.counts)
                         s, c = child.sum, child.count
+                        exemplars = dict(child.exemplars)
                     acc = 0
-                    for bound, n in zip(fam.buckets, counts):
+                    for i, (bound, n) in enumerate(zip(fam.buckets, counts)):
                         acc += n
                         le = 'le="%s"' % _fmt(bound)
-                        out.append(f"{fam.name}_bucket{fam._label_str(key, le)} {acc}")
+                        out.append(f"{fam.name}_bucket{fam._label_str(key, le)} {acc}"
+                                   + _fmt_exemplar(exemplars.get(i)))
                     inf = 'le="+Inf"'
-                    out.append(f"{fam.name}_bucket{fam._label_str(key, inf)} {c}")
+                    out.append(f"{fam.name}_bucket{fam._label_str(key, inf)} {c}"
+                               + _fmt_exemplar(exemplars.get(len(fam.buckets))))
                     out.append(f"{fam.name}_sum{fam._label_str(key)} {_fmt(s)}")
                     out.append(f"{fam.name}_count{fam._label_str(key)} {c}")
                 else:
@@ -337,9 +357,17 @@ class MetricsRegistry:
                 if isinstance(child, _HistogramChild):
                     with fam._lock:
                         c, s = child.count, child.sum
+                        exemplars = dict(child.exemplars)
                     if compact and c == 0:
                         continue
                     series[label] = {"count": c, "sum": round(s, 6)}
+                    if exemplars:
+                        # Latest exemplar only — the bench line wants "one
+                        # click to the worst trace", not the full set.
+                        tid, v, _ts = max(exemplars.values(), key=lambda e: e[2])
+                        series[label]["exemplar"] = {
+                            "trace_id": tid, "value": round(v, 6),
+                        }
                 else:
                     v = child.value
                     if compact and v == 0:
@@ -556,3 +584,116 @@ def dump_recorders() -> List[dict]:
     ``GET /flightrecorder`` on both HTTP apps."""
     recs = sorted(_RECORDERS, key=lambda r: r.name)
     return [{"name": r.name, "events": r.dump()} for r in recs]
+
+
+# --- fleet federation -------------------------------------------------------
+
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})?\s+(\S+)(?:\s+#.*)?$"
+)
+
+
+def parse_prometheus_text(text: str) -> "OrderedDict[str, dict]":
+    """Parse our own exposition format back into families — the inverse of
+    :meth:`MetricsRegistry.render`, for router-side federation. Returns
+    family name → ``{"type", "help", "samples": [(sample_name, labelstr,
+    value)]}`` with labelstr the raw ``{…}`` part ('' when unlabeled).
+    Exemplar suffixes are dropped (sums across replicas cannot keep a
+    single trace id honest). Unparseable lines are skipped — a replica
+    mid-restart must not take the fleet scrape down."""
+    fams: "OrderedDict[str, dict]" = OrderedDict()
+
+    def fam_for(sample_name: str) -> dict:
+        base = sample_name
+        for suffix in ("_bucket", "_sum", "_count"):
+            if base.endswith(suffix) and base[: -len(suffix)] in fams:
+                base = base[: -len(suffix)]
+                break
+        return fams.setdefault(
+            base, {"type": "untyped", "help": "", "samples": []}
+        )
+
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            parts = line.split(" ", 3)
+            if len(parts) == 4:
+                fams.setdefault(
+                    parts[2], {"type": "untyped", "help": "", "samples": []}
+                )["help"] = parts[3]
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split(" ", 3)
+            if len(parts) == 4:
+                fams.setdefault(
+                    parts[2], {"type": "untyped", "help": "", "samples": []}
+                )["type"] = parts[3]
+            continue
+        if line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            continue
+        name, labels, raw = m.group(1), m.group(2) or "", m.group(3)
+        try:
+            value = float(raw)
+        except ValueError:
+            continue
+        fam_for(name)["samples"].append((name, labels, value))
+    return fams
+
+
+def _with_replica_label(labels: str, replica: str) -> str:
+    tag = f'replica="{_escape_label(replica)}"'
+    if not labels:
+        return "{%s}" % tag
+    inner = labels[1:-1].strip()
+    return "{%s}" % (f"{inner},{tag}" if inner else tag)
+
+
+def federate_renders(per_replica: Dict[str, str]) -> str:
+    """Merge N processes' ``/metrics`` texts into ONE exposition — the
+    router's ``GET /metrics/fleet``. Counters and histogram series
+    (``_bucket``/``_sum``/``_count``) SUM across replicas by (sample,
+    labels) — every process runs the same code, so bucket bounds agree by
+    construction. Gauges are NOT summable (an occupancy averaged over the
+    fleet hides the hot replica), so each gauge sample instead gains a
+    ``replica="<id>"`` label. Family order follows the first replica that
+    exposes each family."""
+    order: List[str] = []
+    merged: Dict[str, dict] = {}
+    for rid in sorted(per_replica):
+        for name, fam in parse_prometheus_text(per_replica[rid]).items():
+            tgt = merged.get(name)
+            if tgt is None:
+                tgt = merged[name] = {
+                    "type": fam["type"], "help": fam["help"],
+                    "sums": OrderedDict(), "gauges": [],
+                }
+                order.append(name)
+            if fam["type"] != "untyped" and tgt["type"] == "untyped":
+                tgt["type"] = fam["type"]
+            if fam["help"] and not tgt["help"]:
+                tgt["help"] = fam["help"]
+            summable = tgt["type"] in ("counter", "histogram")
+            for sample, labels, value in fam["samples"]:
+                if summable:
+                    key = (sample, labels)
+                    tgt["sums"][key] = tgt["sums"].get(key, 0.0) + value
+                else:
+                    tgt["gauges"].append(
+                        (sample, _with_replica_label(labels, rid), value)
+                    )
+    out: List[str] = []
+    for name in order:
+        fam = merged[name]
+        if fam["help"]:
+            out.append(f"# HELP {name} {fam['help']}")
+        out.append(f"# TYPE {name} {fam['type'] if fam['type'] != 'untyped' else 'gauge'}")
+        for (sample, labels), value in fam["sums"].items():
+            out.append(f"{sample}{labels} {_fmt(value)}")
+        for sample, labels, value in fam["gauges"]:
+            out.append(f"{sample}{labels} {_fmt(value)}")
+    return "\n".join(out) + "\n"

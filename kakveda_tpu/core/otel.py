@@ -95,6 +95,38 @@ def add_span_events(name: str, payload: Optional[dict]) -> None:
         pass
 
 
+def export_native_span(span: dict) -> None:
+    """Bridge ONE finished native span (core/trace.py ring dict) into the
+    OTel SDK — called by the native tracer's record path only when
+    ``KAKVEDA_OTEL_ENABLED`` stood setup up (``_tracer`` set), so the off
+    path stays a single None check and zero import. The native trace id is
+    attached as attributes (``kakveda.trace_id``/``span_id``/``parent_id``)
+    — the shared 32-hex id is what parents the export under the server
+    span in the backend; no new hard dependency, never raises."""
+    if _tracer is None or not span:
+        return
+    try:
+        start_ns = int(span.get("ts", 0.0) * 1e9)
+        end_ns = start_ns + int(span.get("dur_ms", 0.0) * 1e6)
+        ot = _tracer.start_span(span.get("name", "span"), start_time=start_ns)
+        try:
+            for k in ("trace_id", "span_id", "parent_id", "outcome", "service"):
+                v = span.get(k)
+                if v:
+                    ot.set_attribute(f"kakveda.{k}", str(v))
+            for k, v in (span.get("attrs") or {}).items():
+                if isinstance(v, (str, bool, int, float)):
+                    ot.set_attribute(str(k), v)
+            if span.get("outcome") == "error":
+                from opentelemetry.trace import Status, StatusCode
+
+                ot.set_status(Status(StatusCode.ERROR))
+        finally:
+            ot.end(end_time=end_ns)
+    except Exception:  # noqa: BLE001 — telemetry must not take the request down
+        pass
+
+
 def otel_middleware():
     """aiohttp middleware: one server span per request (no-op when off).
 

@@ -58,6 +58,7 @@ from pathlib import Path
 from typing import Dict, List, Optional
 
 from kakveda_tpu.core import faults as _faults
+from kakveda_tpu.core import trace as _trace
 from kakveda_tpu.core import metrics as _metrics
 from kakveda_tpu.core import sanitize
 
@@ -577,6 +578,21 @@ class Autoscaler:
         dec.ts = time.time()
         rec = dec.to_dict()
         self._m_decisions.labels(action=dec.action, outcome=dec.outcome).inc()
+        # Scale decisions trace against the ownership epoch that fenced
+        # them — a mid-migration warn anomaly joins its scale event by
+        # trace ring, not log archaeology. tick() skips _ledger for
+        # action "none", so the ring only carries real actions.
+        attrs = dict(
+            action=dec.action, target=dec.target or "",
+            decision=dec.outcome, pressure=round(dec.pressure, 4),
+        )
+        epoch = getattr(getattr(self.router, "ownership", None), "epoch", None)
+        if epoch is not None:
+            attrs["epoch"] = epoch
+        _trace.get_tracer().record_completed(
+            "fleet.scale", ts=dec.ts,
+            outcome="ok" if dec.outcome in ("ok", "noop") else "error",
+            **attrs)
         with self._lock:
             key = f"{dec.action}:{dec.outcome}"
             self._counts[key] = self._counts.get(key, 0) + 1

@@ -37,12 +37,14 @@ import json
 import logging
 import os
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Mapping, Optional
 
 from aiohttp import web
 
 from kakveda_tpu.core import faults as _faults
 from kakveda_tpu.core import metrics as _metrics
+from kakveda_tpu.core import trace as _trace
+from kakveda_tpu.core.runtime import ensure_request_id
 from kakveda_tpu.fleet.gossip import FleetView, sample_from_ready
 from kakveda_tpu.fleet.hashring import HashRing
 
@@ -114,6 +116,14 @@ class Router:
         self.ownership = ownership
         self._own_dirty = False
         self._verdict_seq = 0
+        from kakveda_tpu.core.runtime import get_runtime_config
+
+        # Resolved once (hot forwards must not re-read config): the header
+        # the service tier echoes/logs — propagated per hop so replica
+        # logs join router logs by request id (and by trace id).
+        self._rid_header = get_runtime_config(
+            service_name="kakveda-router"
+        ).request_id_header
         self.ring = HashRing(
             list(self.backends),
             vnodes=_env_int("KAKVEDA_FLEET_VNODES", 64) if vnodes is None else vnodes,
@@ -274,6 +284,38 @@ class Router:
 
     # -- forwarding ------------------------------------------------------
 
+    def _hop_headers(
+        self, body: Optional[bytes], incoming: Optional[Mapping[str, str]]
+    ) -> Dict[str, str]:
+        """Base outgoing headers for one forward/scatter: Content-Type
+        for bodies plus the PROPAGATED incoming request id — without it,
+        replica logs cannot be joined to router logs even by request id."""
+        out: Dict[str, str] = {}
+        if body:
+            out["Content-Type"] = "application/json"
+        if incoming:
+            rid = incoming.get(self._rid_header)
+            if rid:
+                out[self._rid_header] = rid
+        return out
+
+    def _with_hop_context(
+        self,
+        base: Dict[str, str],
+        hop,
+        incoming: Optional[Mapping[str, str]],
+    ) -> Optional[Dict[str, str]]:
+        """Stamp one attempt's trace context: the hop span's traceparent
+        (the replica's server span parents under THIS attempt), falling
+        back to the raw incoming header when tracing is inert."""
+        hdrs = dict(base)
+        tp = hop.traceparent() or (
+            incoming.get(_trace.TRACEPARENT_HEADER, "") if incoming else ""
+        )
+        if tp:
+            hdrs[_trace.TRACEPARENT_HEADER] = tp
+        return hdrs or None
+
     async def forward(
         self,
         method: str,
@@ -283,6 +325,7 @@ class Router:
         *,
         idempotent: bool,
         retry_connect_only: bool = False,
+        headers: Optional[Mapping[str, str]] = None,
     ) -> web.Response:
         """Forward one request along ``key``'s candidate list. Transport
         failures (and 5xx on idempotent routes) walk to the next replica;
@@ -294,6 +337,7 @@ class Router:
 
         attempts = 1 + (self.retries if (idempotent or retry_connect_only) else 0)
         cands = self.candidates(key, attempts)
+        base_headers = self._hop_headers(body, headers)
         t0 = time.perf_counter()
         last_err: Optional[str] = None
         for i, rid in enumerate(cands):
@@ -306,11 +350,18 @@ class Router:
                 last_err = f"{rid} removed"
                 continue
             url = base + path
+            # Each attempt is its own child span (replica + outcome
+            # provenance); the hop's traceparent rides the sub-request so
+            # the replica's server span parents under THIS attempt, not
+            # under a retry that never reached it.
+            hop = _trace.get_tracer().start_span(
+                "router.forward", replica=rid, attempt=i, path=path
+            )
+            hdrs = self._with_hop_context(base_headers, hop, headers)
             try:
                 _FAULT_FORWARD.fire()
                 async with self._client.request(
-                    method, url, data=body,
-                    headers={"Content-Type": "application/json"} if body else None,
+                    method, url, data=body, headers=hdrs,
                 ) as r:
                     content = await r.read()
                     status = r.status
@@ -321,6 +372,7 @@ class Router:
                 self.note_result(rid, False)
                 self._m_fwd[rid]["error"].inc()
                 last_err = f"{type(e).__name__}: {e}"
+                hop.end("error", error=type(e).__name__)
                 continue
             if status >= 500 and idempotent and i + 1 < len(cands):
                 # A dying replica can serve 500s before its socket closes;
@@ -328,7 +380,9 @@ class Router:
                 self.note_result(rid, False)
                 self._m_fwd[rid]["error"].inc()
                 last_err = f"HTTP {status}"
+                hop.end("error", status=status)
                 continue
+            hop.end(_hop_outcome(status), status=status)
             self.note_result(rid, status < 500)
             self._m_fwd[rid]["ok" if status < 500 else "passthrough"].inc()
             if key:
@@ -351,7 +405,13 @@ class Router:
 
     # -- scatter-gather (sharded ownership) ------------------------------
 
-    async def scatter(self, path: str, body: Optional[bytes], merge) -> web.Response:
+    async def scatter(
+        self,
+        path: str,
+        body: Optional[bytes],
+        merge,
+        headers: Optional[Mapping[str, str]] = None,
+    ) -> web.Response:
         """Fan one request out to every live shard and merge — the warn /
         match data plane under sharded ownership (each replica holds only
         its owned + standby ranges, so no single forward sees the corpus).
@@ -371,18 +431,28 @@ class Router:
             rid for rid in view.members
             if rid in self.backends and rid not in ejected
         ] or [rid for rid in view.members if rid in self.backends]
-        headers = {"Content-Type": "application/json"} if body else None
+        base_headers = self._hop_headers(body, headers)
         t0 = time.perf_counter()
 
         async def one(rid: str):
+            # One child span per shard sub-request — the assembled tree
+            # shows every shard's replica + outcome, including the ones
+            # the merge never used.
+            hop = _trace.get_tracer().start_span(
+                "router.scatter", replica=rid, path=path
+            )
+            hdrs = self._with_hop_context(base_headers, hop, headers)
             try:
                 _FAULT_SCATTER.fire()
                 async with self._client.request(
-                    "POST", self.backends[rid] + path, data=body, headers=headers
+                    "POST", self.backends[rid] + path, data=body, headers=hdrs
                 ) as r:
-                    return rid, r.status, await r.read(), r.headers.get("Retry-After")
+                    content = await r.read()
+                    hop.end(_hop_outcome(r.status), status=r.status)
+                    return rid, r.status, content, r.headers.get("Retry-After")
             except (aiohttp.ClientError, asyncio.TimeoutError,
-                    _faults.FaultInjected):
+                    _faults.FaultInjected) as e:
+                hop.end("error", error=type(e).__name__)
                 return rid, None, None, None
 
         results = await asyncio.gather(*(one(rid) for rid in targets))
@@ -499,16 +569,24 @@ class Router:
             raise RuntimeError("ownership disabled")
         old = self.ownership
         new = old.with_members(dict(members))
-        summary = await asyncio.get_running_loop().run_in_executor(
-            None, lambda: _own.run_rebalance(old, new)
-        )
-        for rid, url in new.members.items():
-            self.add_backend(rid, url)
-        for rid in [r for r in self.backends if r not in new.members]:
-            self.remove_backend(rid)
-        self.set_ownership(new)
-        self._m_promote.inc()
-        return summary
+        # Migration traces against the epochs that fence it: a failed
+        # migration's span (error outcome, flipped provenance in the
+        # raised MigrationError) correlates with every replicate_apply
+        # span fenced at epoch_to.
+        with _trace.get_tracer().start_span(
+            "fleet.rebalance", epoch_from=old.epoch, epoch_to=new.epoch,
+            members=len(new.members),
+        ):
+            summary = await asyncio.get_running_loop().run_in_executor(
+                None, lambda: _own.run_rebalance(old, new)
+            )
+            for rid, url in new.members.items():
+                self.add_backend(rid, url)
+            for rid in [r for r in self.backends if r not in new.members]:
+                self.remove_backend(rid)
+            self.set_ownership(new)
+            self._m_promote.inc()
+            return summary
 
     async def resync_member(self, rid: str) -> dict:
         """Heal a replaced member's GFKB gap: snapshot-ship its held
@@ -530,12 +608,16 @@ class Router:
             return {}
         old = view.with_members(donors, epoch=view.epoch)
         new = view.with_epoch(view.epoch + 1)
-        summary = await asyncio.get_running_loop().run_in_executor(
-            None, lambda: _own.run_rebalance(old, new)
-        )
-        self.set_ownership(new)
-        self._m_promote.inc()
-        return summary
+        with _trace.get_tracer().start_span(
+            "fleet.resync", replica=rid,
+            epoch_from=view.epoch, epoch_to=new.epoch,
+        ):
+            summary = await asyncio.get_running_loop().run_in_executor(
+                None, lambda: _own.run_rebalance(old, new)
+            )
+            self.set_ownership(new)
+            self._m_promote.inc()
+            return summary
 
     def add_backend(self, rid: str, url: str) -> None:
         """Grow the routable fleet at runtime (scale-out): extend the
@@ -817,6 +899,18 @@ def _merge_matches(answered: Dict[str, dict]) -> dict:
     return out
 
 
+def _hop_outcome(status: Optional[int]) -> str:
+    """Span outcome for one hop's HTTP verdict — mirrors the admission
+    taxonomy: 429 is a shed, 503 a degraded verdict, other 5xx an error."""
+    if status is None or status >= 500 and status != 503:
+        return "error"
+    if status == 429:
+        return "shed"
+    if status == 503:
+        return "degraded"
+    return "ok"
+
+
 def _route_key(path: str, body: Optional[bytes]) -> str:
     """The shard key for a request: app_id when the body carries one,
     signature_text for raw match calls, first trace's app for batches.
@@ -879,7 +973,37 @@ def make_router_app(
             vnodes=_env_int("KAKVEDA_FLEET_VNODES", 64),
         )
     router = Router(backends, **router_kw)
-    app = web.Application()
+
+    @web.middleware
+    async def _trace_mw(request: web.Request, handler):
+        """Router-side trace root: extract the caller's W3C context or
+        start a new trace folding the request id (same discipline as the
+        service middleware, service/app.py) — hop spans under it carry
+        per-replica, per-attempt outcome provenance."""
+        rid = ensure_request_id(request.headers.get(router._rid_header))
+        span = _trace.get_tracer().start_span(
+            "router.request",
+            traceparent=request.headers.get(_trace.TRACEPARENT_HEADER),
+            trace_id=rid, path=request.path, method=request.method, rid=rid,
+        )
+        span.activate()
+        try:
+            response = await handler(request)
+        except web.HTTPException as e:
+            span.deactivate()
+            span.end(_hop_outcome(e.status), status=e.status)
+            e.headers.setdefault(router._rid_header, rid)
+            raise
+        except BaseException:
+            span.deactivate()
+            span.end("error")
+            raise
+        span.deactivate()
+        span.end(_hop_outcome(response.status), status=response.status)
+        response.headers.setdefault(router._rid_header, rid)
+        return response
+
+    app = web.Application(middlewares=[_trace_mw])
     app[ROUTER_KEY] = router
 
     async def _startup(app):
@@ -934,6 +1058,7 @@ def make_router_app(
             return await router.forward(
                 request.method, request.path, body or None, key,
                 idempotent=idempotent, retry_connect_only=retry_connect_only,
+                headers=request.headers,
             )
 
         return handler
@@ -951,6 +1076,85 @@ def make_router_app(
             headers={"Content-Type": _metrics.PROMETHEUS_CONTENT_TYPE},
         )
 
+    async def metrics_fleet(request):
+        """GET /metrics/fleet — ONE scrape for the whole fleet: every
+        replica's exposition plus the router's own, counters/histograms
+        summed, gauges tagged per replica (core/metrics.py
+        federate_renders). A replica that cannot answer is skipped — a
+        partial fleet scrape beats a failed one."""
+        import aiohttp
+
+        texts = {"__router__": _metrics.get_registry().render()}
+
+        async def pull(rid: str, base: str):
+            try:
+                async with router._client.get(base + "/metrics") as r:
+                    if r.status == 200:
+                        texts[rid] = (await r.read()).decode("utf-8", "replace")
+            except (aiohttp.ClientError, asyncio.TimeoutError):
+                pass
+
+        await asyncio.gather(
+            *(pull(rid, base) for rid, base in list(router.backends.items()))
+        )
+        return web.Response(
+            body=_metrics.federate_renders(texts).encode("utf-8"),
+            headers={"Content-Type": _metrics.PROMETHEUS_CONTENT_TYPE},
+        )
+
+    async def trace_ring(request):
+        tr = _trace.get_tracer()
+        try:
+            limit = int(request.query["n"]) if "n" in request.query else None
+        except ValueError:
+            limit = None
+        return web.json_response(
+            {"plane": tr.plane(), "spans": tr.dump(limit=limit)}
+        )
+
+    async def trace_collect(request):
+        """GET /trace/{id} — the cross-process collector: the router's
+        own ring plus every replica's ``/trace/{id}``, deduped by span id
+        and scatter-assembled into one rendered tree. Per-source span
+        counts ride along (-1 = replica unreachable) so a hole in the
+        tree is attributable."""
+        import aiohttp
+
+        tid = request.match_info["trace_id"]
+        spans = {s["span_id"]: s for s in _trace.get_tracer().dump(tid)}
+        sources = {"__router__": len(spans)}
+
+        async def pull(rid: str, base: str):
+            try:
+                async with router._client.get(base + "/trace/" + tid) as r:
+                    if r.status != 200:
+                        sources[rid] = -1
+                        return
+                    body = json.loads(await r.read())
+            except (aiohttp.ClientError, asyncio.TimeoutError, ValueError):
+                sources[rid] = -1
+                return
+            n = 0
+            for s in body.get("spans") or []:
+                sid = s.get("span_id")
+                if sid and sid not in spans:
+                    spans[sid] = s
+                    n += 1
+            sources[rid] = n
+
+        await asyncio.gather(
+            *(pull(rid, base) for rid, base in list(router.backends.items()))
+        )
+        ordered = sorted(
+            spans.values(), key=lambda s: (s.get("ts") or 0.0, s.get("span_id"))
+        )
+        return web.json_response({
+            "trace_id": tid,
+            "spans": ordered,
+            "sources": sources,
+            "tree": _trace.render_trace(ordered) if ordered else "",
+        })
+
     warm = _keyed(idempotent=True)
     ingest = _keyed(idempotent=False, retry_connect_only=True)
     admin = _keyed(idempotent=False)
@@ -965,7 +1169,9 @@ def make_router_app(
             key = _route_key(request.path, body)
             if key:
                 router.note_key(key)
-            return await router.scatter(request.path, body or None, merge)
+            return await router.scatter(
+                request.path, body or None, merge, headers=request.headers
+            )
 
         return handler
 
@@ -1017,6 +1223,9 @@ def make_router_app(
             web.get("/healthz", healthz),
             web.get("/readyz", readyz),
             web.get("/metrics", metrics_ep),
+            web.get("/metrics/fleet", metrics_fleet),
+            web.get("/trace", trace_ring),
+            web.get("/trace/{trace_id}", trace_collect),
             web.post("/fleet/rebalance", rebalance),
             # Sharded, idempotent: retry-on-next-replica. Under ownership
             # these scatter-gather across owning shards instead.

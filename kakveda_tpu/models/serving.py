@@ -74,6 +74,7 @@ from kakveda_tpu.core import metrics as _metrics
 from kakveda_tpu.core.admission import DeviceUnavailableError, OverloadError
 from kakveda_tpu.core import ledger as _ledger
 from kakveda_tpu.core import sanitize
+from kakveda_tpu.core import trace as _trace
 from kakveda_tpu.models.llama import (
     LlamaConfig,
     Params,
@@ -1472,7 +1473,8 @@ class ServingEngine:
             return None
         wall = time.perf_counter() - tr["submit"]
         rate = n_tokens / wall if wall > 0 else 0.0
-        self._mx["request"].observe(wall)
+        tp = _trace.parse_traceparent(tr.get("traceparent") or "")
+        self._mx["request"].observe(wall, exemplar=tp[0] if tp else None)
         if n_tokens:
             self._mx["rate"].observe(rate)
         self._m_requests.labels(engine=self.name, outcome="completed").inc()
@@ -1490,6 +1492,19 @@ class ServingEngine:
         }
         if self.recorder is not None:
             self.recorder.record("request", **tl)
+        # Timeline -> span: recorded after the fact (the loop thread has
+        # no ambient context), parented on the submitter's traceparent so
+        # a /warn or /generate trace shows queue-wait/prefill/ttft inline.
+        rec = _trace.get_tracer().record_completed(
+            "serving.request",
+            traceparent=tr.get("traceparent") or None,
+            ts=time.time() - wall, dur_ms=tl["wall_ms"], outcome="ok",
+            engine=self.name, queue_wait_ms=tl["queue_wait_ms"],
+            prefill_ms=tl["prefill_ms"], ttft_ms=tl["ttft_ms"] or 0.0,
+            tokens=n_tokens,
+        )
+        if rec:
+            tl["trace_id"] = rec["trace_id"]
         return tl
 
     def fits(self, prompt_len: int, max_new_tokens: int) -> bool:
@@ -1576,6 +1591,11 @@ class ServingEngine:
             t0 = time.perf_counter()
             deadline = t0 + deadline_s if deadline_s is not None else None
             fut: Future = Future()
+            # Trace context is captured HERE (the caller's contextvar) and
+            # rides the Future — the loop thread has no ambient context, so
+            # the serialized traceparent is the only bridge to the
+            # serving.request span recorded at _finish_telemetry.
+            fut.traceparent = _trace.current_traceparent()
             self._q.put(
                 (list(prompt_ids), max_new_tokens, temperature, on_tokens,
                  t0, deadline, fut)
@@ -1818,6 +1838,7 @@ class ServingEngine:
         track = {
             "submit": t_submit, "admit": t_admit, "first": None, "tokens": 0,
             "deadline": deadline,
+            "traceparent": getattr(fut, "traceparent", None),
         }
         mx_ttft = self._mx["ttft"]
 

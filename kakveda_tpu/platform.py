@@ -29,6 +29,7 @@ from typing import List, Optional, Sequence
 from jax.sharding import Mesh
 
 from kakveda_tpu.core import metrics as _metrics
+from kakveda_tpu.core import trace as _trace
 from kakveda_tpu.core.config import ConfigStore
 from kakveda_tpu.core.fingerprint import signature_text
 from kakveda_tpu.core.schemas import (
@@ -231,29 +232,35 @@ class Platform:
                 for rid in view.holders(shard_key_of_row(row)):
                     if rid != self.replica_id:
                         by_target.setdefault(rid, []).append(row)
+            tp = _trace.current_traceparent()
             for rid in sorted(by_target):
                 topic = replicate_topic(rid)
                 if self.bus.has_subscribers(topic):
-                    await self.bus.publish(
-                        topic,
-                        {
-                            "id": new_event_id(),
-                            "origin": self.replica_id,
-                            "ts": time.time(),
-                            "epoch": view.epoch,
-                            "rows": by_target[rid],
-                        },
-                    )
+                    event = {
+                        "id": new_event_id(),
+                        "origin": self.replica_id,
+                        "ts": time.time(),
+                        "epoch": view.epoch,
+                        "rows": by_target[rid],
+                    }
+                    # The envelope carries the causal context, so a peer's
+                    # apply — or this event's DLQ record and its eventual
+                    # `dlq replay` redelivery — continues the ingest's
+                    # trace instead of starting an uncorrelated one.
+                    if tp:
+                        event["trace"] = tp
+                    await self.bus.publish(topic, event)
         elif self.bus.has_subscribers(TOPIC_GFKB_REPLICATE):
-            await self.bus.publish(
-                TOPIC_GFKB_REPLICATE,
-                {
-                    "id": new_event_id(),
-                    "origin": self.replica_id,
-                    "ts": time.time(),
-                    "rows": rows,
-                },
-            )
+            event = {
+                "id": new_event_id(),
+                "origin": self.replica_id,
+                "ts": time.time(),
+                "rows": rows,
+            }
+            tp = _trace.current_traceparent()
+            if tp:
+                event["trace"] = tp
+            await self.bus.publish(TOPIC_GFKB_REPLICATE, event)
 
     async def ingest(self, trace: TracePayload) -> None:
         """The reference's POST /ingest → publish trace.ingested

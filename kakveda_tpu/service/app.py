@@ -36,6 +36,7 @@ from kakveda_tpu.core import admission as _admission
 from kakveda_tpu.core import faults as _faults
 from kakveda_tpu.core.admission import DeviceUnavailableError, OverloadError
 from kakveda_tpu.core import sanitize
+from kakveda_tpu.core import trace as _trace
 from kakveda_tpu.core.runtime import ensure_request_id, get_runtime_config
 from kakveda_tpu.core.schemas import (
     FailureMatchRequest,
@@ -133,9 +134,27 @@ def metrics_routes() -> list:
     async def flightrecorder_ep(request):
         return web.json_response({"recorders": _metrics.dump_recorders()})
 
+    async def trace_ring_ep(request):
+        tr = _trace.get_tracer()
+        try:
+            limit = int(request.query["n"]) if "n" in request.query else None
+        except ValueError:
+            limit = None
+        return web.json_response(
+            {"plane": tr.plane(), "spans": tr.dump(limit=limit)}
+        )
+
+    async def trace_one_ep(request):
+        tid = request.match_info["trace_id"]
+        return web.json_response(
+            {"trace_id": tid, "spans": _trace.get_tracer().dump(tid)}
+        )
+
     return [
         web.get("/metrics", metrics_ep),
         web.get("/flightrecorder", flightrecorder_ep),
+        web.get("/trace", trace_ring_ep),
+        web.get("/trace/{trace_id}", trace_one_ep),
     ]
 
 
@@ -150,6 +169,21 @@ async def request_context_middleware(request: web.Request, handler):
     rid = request.get("request_id") or ensure_request_id(
         request.headers.get(cfg.request_id_header)
     )
+    # Causal trace (core/trace.py): extract the incoming W3C context or
+    # start a new root that FOLDS the request id (ensure_request_id mints
+    # 32 lowercase hex — a valid trace id), so logs, the echoed header and
+    # the cross-process span tree all join on one key. Handlers reach the
+    # span via request["trace_span"] to attach provenance.
+    span = _trace.get_tracer().start_span(
+        "service.request",
+        traceparent=request.headers.get(_trace.TRACEPARENT_HEADER),
+        trace_id=rid,
+        path=request.path,
+        method=request.method,
+        rid=rid,
+    )
+    request["trace_span"] = span
+    span.activate()
     started = time.perf_counter()
     try:
         _FAULT_HANDLER.fire()
@@ -164,9 +198,28 @@ async def request_context_middleware(request: web.Request, handler):
         response = degraded_response(e)
     except web.HTTPException as e:
         e.headers[cfg.request_id_header] = rid
+        span.deactivate()
+        span.end(
+            "error" if e.status >= 500
+            else "shed" if e.status == 429
+            else span.outcome,
+            status=e.status,
+        )
+        raise
+    except BaseException:
+        span.deactivate()
+        span.end("error")
         raise
     duration_ms = int((time.perf_counter() - started) * 1000)
     response.headers[cfg.request_id_header] = rid
+    span.deactivate()
+    span.end(
+        "shed" if response.status == 429
+        else "degraded" if response.status == 503
+        else "error" if response.status >= 500
+        else span.outcome,  # a 200 degraded-warn handler may have marked it
+        status=response.status,
+    )
     log.info(
         "request",
         extra={
@@ -236,6 +289,19 @@ def make_app(
         middlewares.insert(0, otel.otel_middleware())
     app = web.Application(middlewares=middlewares)
     app[PLATFORM_KEY] = plat
+
+    # Trace provenance resolved ONCE at construction (hot paths must not
+    # re-derive it per request): recorded spans carry the replica id, and
+    # warn spans note whether the native scorer could have served them.
+    _trace.get_tracer().service = plat.replica_id or ""
+    _native_avail = bool(_native_status().get("available"))
+    from kakveda_tpu.core import metrics as _metrics_reg
+
+    _h_warn = _metrics_reg.get_registry().histogram(
+        "kakveda_warn_request_seconds",
+        "End-to-end /warn wall inside the service handler "
+        "(exemplar-linked to its trace id)",
+    )
 
     # Micro-batcher shape is operator surface now that fleets tune it per
     # replica (docs/scale-out.md): KAKVEDA_WARN_MAX_BATCH coalesced
@@ -468,7 +534,10 @@ def make_app(
             except (ValidationError, ValueError) as e:
                 return _json_error(422, str(e))
             traffic_rec.record("ingest", app_id=req.trace.app_id, n=1)
-            await plat.ingest(req.trace)
+            with _trace.get_tracer().start_span(
+                "gfkb.ingest", app_id=req.trace.app_id, n=1
+            ):
+                await plat.ingest(req.trace)
         return web.json_response({"ok": True, "trace_id": req.trace.trace_id})
 
     async def ingest_batch(request):
@@ -491,7 +560,10 @@ def make_app(
             traffic_rec.record(
                 "ingest", app_id=req.traces[0].app_id, n=len(req.traces)
             )
-            signals = await plat.ingest_batch(req.traces)
+            with _trace.get_tracer().start_span(
+                "gfkb.ingest", app_id=req.traces[0].app_id, n=len(req.traces)
+            ):
+                signals = await plat.ingest_batch(req.traces)
         return web.json_response(
             {"ok": True, "n": len(req.traces), "failures": len(signals)}
         )
@@ -538,45 +610,60 @@ def make_app(
         event_id, rows = body.get("id"), body.get("rows")
         if not isinstance(event_id, str) or not isinstance(rows, list):
             return _json_error(422, "id (str) and rows (list) required")
-        dropped = 0
-        epoch = body.get("epoch")
-        if (
-            own_state is not None
-            and isinstance(epoch, int)
-            and epoch < own_state.view.epoch
-        ):
-            from kakveda_tpu.fleet.ownership import shard_key_of_row
+        # Continue the ORIGIN's trace (envelope "trace" stamp, set by
+        # Platform.replicate_rows) — replication, DLQ dead-letter and
+        # `dlq replay` redelivery all correlate back to the ingest that
+        # produced the rows. No stamp → parent under the local request.
+        with _trace.get_tracer().start_span(
+            "gfkb.replicate_apply",
+            traceparent=body.get("trace") or None,
+            origin=body.get("origin"), event_id=event_id, n=len(rows),
+        ) as rspan:
+            dropped = 0
+            epoch = body.get("epoch")
+            if isinstance(epoch, int):
+                rspan.set(epoch=epoch)
+            if (
+                own_state is not None
+                and isinstance(epoch, int)
+                and epoch < own_state.view.epoch
+            ):
+                from kakveda_tpu.fleet.ownership import shard_key_of_row
 
-            view = own_state.view
-            kept = [
-                r for r in rows
-                if isinstance(r, dict)
-                and view.is_holder(own_state.self_id, shard_key_of_row(r))
-            ]
-            dropped = len(rows) - len(kept)
+                view = own_state.view
+                kept = [
+                    r for r in rows
+                    if isinstance(r, dict)
+                    and view.is_holder(own_state.self_id, shard_key_of_row(r))
+                ]
+                dropped = len(rows) - len(kept)
+                if dropped:
+                    _m_fence.inc(dropped)
+                if not kept:
+                    rspan.set(dropped=dropped, reason="stale_epoch")
+                    return web.json_response(
+                        {"ok": True, "applied": 0, "deduped": False,
+                         "dropped": dropped, "reason": "stale_epoch"}
+                    )
+                rows = kept
+            _FAULT_REPLICATE.fire()
+            import asyncio as _asyncio
+
+            loop = _asyncio.get_running_loop()
+            with adm.slot("ingest"):
+                try:
+                    applied = await loop.run_in_executor(
+                        None, plat.gfkb.apply_replication, rows, event_id
+                    )
+                except (KeyError, ValueError) as e:  # malformed row payload
+                    rspan.set(error=type(e).__name__)
+                    rspan.end("error")
+                    return _json_error(422, f"bad replication rows: {e}")
+            rspan.set(applied=applied, deduped=applied == 0)
+            out = {"ok": True, "applied": applied, "deduped": applied == 0}
             if dropped:
-                _m_fence.inc(dropped)
-            if not kept:
-                return web.json_response(
-                    {"ok": True, "applied": 0, "deduped": False,
-                     "dropped": dropped, "reason": "stale_epoch"}
-                )
-            rows = kept
-        _FAULT_REPLICATE.fire()
-        import asyncio as _asyncio
-
-        loop = _asyncio.get_running_loop()
-        with adm.slot("ingest"):
-            try:
-                applied = await loop.run_in_executor(
-                    None, plat.gfkb.apply_replication, rows, event_id
-                )
-            except (KeyError, ValueError) as e:  # malformed row payload
-                return _json_error(422, f"bad replication rows: {e}")
-        out = {"ok": True, "applied": applied, "deduped": applied == 0}
-        if dropped:
-            out["dropped"] = dropped
-        return web.json_response(out)
+                out["dropped"] = dropped
+            return web.json_response(out)
 
     async def fleet_ownership_get(request):
         if own_state is None:
@@ -716,7 +803,23 @@ def make_app(
         # limit IS the admission bound); a degraded backend still answers
         # here through the GFKB host fallback — warn is the last class to
         # go dark, by design.
-        res = await warn_batcher.submit(req)
+        t0 = time.perf_counter()
+        with _trace.get_tracer().start_span(
+            "gfkb.warn", app_id=req.app_id
+        ) as gspan:
+            res = await warn_batcher.submit(req)
+            gspan.set(
+                tier=res.tier, nprobe=res.nprobe, degraded=res.degraded,
+                native=_native_avail, action=res.action,
+            )
+            if res.degraded:
+                gspan.outcome = "degraded"
+                parent = request.get("trace_span")
+                if parent is not None:
+                    parent.outcome = "degraded"
+        _h_warn.observe(
+            time.perf_counter() - t0, exemplar=gspan.trace_id or None
+        )
         return web.json_response(res.model_dump())
 
     # --- GFKB -----------------------------------------------------------

@@ -44,6 +44,7 @@ from dataclasses import dataclass, field
 from typing import Awaitable, Callable, Dict, List, Optional
 
 from kakveda_tpu.core import faults
+from kakveda_tpu.core import trace as _trace
 from kakveda_tpu.core.faults import FaultInjected
 
 log = logging.getLogger("kakveda.traffic")
@@ -121,6 +122,17 @@ async def _dispatch(e: dict, sched_t: float, sem: asyncio.Semaphore,
     rec = {"klass": e.get("klass", "warn"), "phase": e.get("phase", ""),
            "status": "error", "latency_ms": 0.0, "late_ms": 0.0}
     loop = asyncio.get_running_loop()
+    # One span per dispatch, ended in the SAME finally that buckets the
+    # record — a dispatch span terminates in exactly one bucket, so the
+    # storm bench's zero-orphan certification mirrors the zero-lost
+    # accounting. The span is client-side only: the request body stays
+    # byte-faithful for warn replay.
+    span = _trace.get_tracer().start_span(
+        "traffic.dispatch", klass=rec["klass"], path=e.get("path", ""),
+        phase=rec["phase"])
+    if span.trace_id:
+        rec["trace"] = span.trace_id
+    span.activate()
     try:
         async with sem:
             send_t = loop.time()
@@ -160,6 +172,9 @@ async def _dispatch(e: dict, sched_t: float, sem: asyncio.Semaphore,
         log.warning("dispatch %s failed: %s: %s",
                     e.get("path"), type(ex).__name__, ex)
     finally:
+        span.deactivate()
+        span.end(rec["status"], late_ms=rec["late_ms"],
+                 latency_ms=rec["latency_ms"])
         result.records.append(rec)
 
 

@@ -81,10 +81,18 @@ class Gate:
     ok: bool
     observed: object
     bound: object
+    # Trace ids of the dispatches that broke the gate (slowest / hung /
+    # shed offenders) — a failing gate is one `cli trace <id>` away from
+    # its cross-process cause. Populated only on failure, only when the
+    # replay tagged records with trace ids.
+    exemplars: Optional[List[str]] = None
 
     def to_dict(self) -> dict:
-        return {"gate": self.gate, "ok": self.ok,
-                "observed": self.observed, "bound": self.bound}
+        d = {"gate": self.gate, "ok": self.ok,
+             "observed": self.observed, "bound": self.bound}
+        if self.exemplars:
+            d["exemplars"] = list(self.exemplars)
+        return d
 
 
 @dataclass
@@ -108,6 +116,17 @@ class SLOReport:
             for g in self.failures()
         )
         return f"SLO {self.slo}: FAILED — {bad}"
+
+
+def _exemplar_traces(records, status=None, klass=None, n=3) -> List[str]:
+    """Worst-offender trace ids for a failing gate: matching records,
+    slowest first. Records without a trace tag (sampling off) drop out —
+    exemplars are best-effort, never a gate input."""
+    cand = [r for r in records if r.get("trace")
+            and (status is None or r.get("status") == status)
+            and (klass is None or r.get("klass") == klass)]
+    cand.sort(key=lambda r: r.get("latency_ms", 0.0), reverse=True)
+    return [r["trace"] for r in cand[:n]]
 
 
 def evaluate(slo: SLO, result) -> SLOReport:
@@ -199,5 +218,20 @@ def evaluate(slo: SLO, result) -> SLOReport:
         else:
             add("recovery_s", rec <= slo.recovery_s,
                 round(rec, 3), slo.recovery_s)
+
+    records = getattr(result, "records", None) or []
+    for g in gates:
+        if g.ok or not records:
+            continue
+        if g.gate.startswith(("warn_p", "ttft_")):
+            g.exemplars = _exemplar_traces(records, status="ok") or None
+        elif g.gate == "zero_hung":
+            g.exemplars = _exemplar_traces(records, status="hung") or None
+        elif g.gate.startswith("max_shed_rate["):
+            klass = g.gate[len("max_shed_rate["):-1]
+            g.exemplars = _exemplar_traces(
+                records, status="shed", klass=klass) or None
+        elif g.gate == "shed_only":
+            g.exemplars = _exemplar_traces(records, status="shed") or None
 
     return SLOReport(slo=slo.name, ok=all(g.ok for g in gates), gates=gates)

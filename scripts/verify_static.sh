@@ -13,7 +13,11 @@
 #      throwaway jit — proves the runtime half of the device pass wires
 #      up on this interpreter (jax import, monitoring listener, metrics
 #      families) without a TPU.
-#   4. the autoscaler policy selftest: the canned decision table over the
+#   4. a trace smoke: a private core/trace.py Tracer builds a 3-span
+#      tree, round-trips the W3C traceparent wire format, asserts the
+#      ring dump + orphan accounting, and proves an armed trace.record
+#      fault drops the span without raising (~1 s, no backend).
+#   5. the autoscaler policy selftest: the canned decision table over the
 #      PURE decide/commit functions (fleet/autoscaler.py) — no processes,
 #      no router, ~1 s; a hysteresis/backoff regression fails pre-commit.
 #
@@ -64,6 +68,47 @@ try:
 finally:
     ledger.uninstall()
     ledger.reset()
+EOF
+
+echo "== trace smoke =="
+python - <<'EOF'
+from kakveda_tpu.core import faults
+from kakveda_tpu.core.trace import (
+    Tracer, assemble_tree, format_traceparent, parse_traceparent, render_trace,
+)
+
+tr = Tracer(capacity=64, sample=1.0)
+with tr.start_span("router.request", path="/warn") as root:
+    root.activate()
+    try:
+        with tr.start_span("router.scatter", replica="r0") as hop:
+            # wire round-trip: serialize, parse, continue on "the peer"
+            tp = hop.traceparent()
+            parsed = parse_traceparent(tp)
+            assert parsed is not None and parsed[0] == root.trace_id, tp
+            assert format_traceparent(*parsed) == tp
+            child = tr.start_span("service.request", traceparent=tp)
+            child.end("ok")
+    finally:
+        root.deactivate()
+spans = tr.dump(root.trace_id)
+assert len(spans) == 3, spans
+tree = assemble_tree(spans)
+assert len(tree) == 1 and tree[0]["name"] == "router.request"
+assert render_trace(spans).startswith(f"trace {root.trace_id}")
+p = tr.plane()
+assert p["started"] == p["ended"] == 3 and p["orphaned"] == 0, p
+# failure contract: an armed trace.record site drops the span, never raises
+faults.arm("trace.record:1.0:1")
+try:
+    with tr.start_span("chaos.victim"):
+        pass
+finally:
+    faults.disarm()
+p = tr.plane()
+assert p["orphaned"] == 0 and p["dropped"] == 1, p
+print("trace smoke: ok — 3-span tree assembled, wire round-trip, "
+      "armed recorder dropped 1 span without raising")
 EOF
 
 echo "== autoscaler policy selftest =="

@@ -1,0 +1,471 @@
+"""Causal-tracing tests (core/trace.py, docs/observability.md § Tracing):
+wire-format round-trip, deterministic head sampling, ring bounds + orphan
+accounting, the never-fail-a-warn chaos contract (trace.record), trace
+continuity across router scatter-gather (fleet drill), bus replication →
+DLQ → replay continuing the origin trace, histogram exemplars, and
+/metrics federation."""
+
+import asyncio
+import time
+import uuid
+from datetime import datetime, timezone
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from kakveda_tpu.core import faults
+from kakveda_tpu.core import trace as _trace
+from kakveda_tpu.core.trace import (
+    Tracer,
+    assemble_tree,
+    format_traceparent,
+    parse_traceparent,
+    render_trace,
+)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+@pytest.fixture(autouse=True)
+def _clean_tracer():
+    _trace.get_tracer().reset()
+    yield
+    _trace.get_tracer().reset()
+    faults.disarm()
+
+
+# ---------------------------------------------------------------------------
+# wire format
+# ---------------------------------------------------------------------------
+
+
+def test_traceparent_roundtrip():
+    tid, sid = uuid.uuid4().hex, uuid.uuid4().hex[:16]
+    for sampled in (True, False):
+        tp = format_traceparent(tid, sid, sampled)
+        assert parse_traceparent(tp) == (tid, sid, sampled)
+
+
+@pytest.mark.parametrize("garbage", [
+    "", "garbage", "00-short-span-01",
+    "00-" + "0" * 32 + "-" + "1" * 16 + "-01",   # all-zero trace id
+    "00-" + "a" * 32 + "-" + "0" * 16 + "-01",   # all-zero span id
+    "00-" + "g" * 32 + "-" + "1" * 16 + "-01",   # non-hex
+    "ff-" + "a" * 32 + "-" + "1" * 16 + "-01",   # unknown version
+])
+def test_traceparent_rejects_garbage(garbage):
+    assert parse_traceparent(garbage) is None
+
+
+def test_start_span_folds_request_id():
+    """A fresh x-request-id is 32 lowercase hex — a valid trace id — so
+    the request id IS the trace id end to end."""
+    tr = Tracer(capacity=16, sample=1.0)
+    rid = uuid.uuid4().hex
+    span = tr.start_span("service.request", trace_id=rid)
+    assert span.trace_id == rid
+    span.end("ok")
+    # an invalid fold candidate is ignored, never an error
+    span = tr.start_span("service.request", trace_id="not-a-trace-id")
+    assert span.trace_id != "not-a-trace-id" and len(span.trace_id) == 32
+    span.end("ok")
+
+
+# ---------------------------------------------------------------------------
+# sampling
+# ---------------------------------------------------------------------------
+
+
+def test_sampling_deterministic_across_processes():
+    """Head sampling is a pure function of (trace_id, rate): every process
+    makes the SAME decision for the same trace — a sampled router hop is
+    sampled on the replica too, with zero coordination."""
+    a, b = Tracer(capacity=16, sample=0.5), Tracer(capacity=16, sample=0.5)
+    ids = [uuid.uuid4().hex for _ in range(200)]
+    assert [a.sample_decision(t) for t in ids] == [
+        b.sample_decision(t) for t in ids
+    ]
+    # the decision threshold is the id's leading 32 bits
+    assert a.sample_decision("00" * 16)
+    assert not a.sample_decision("ff" * 16)
+
+
+def test_sample_zero_still_records_bad_outcomes():
+    """KAKVEDA_TRACE_SAMPLE=0: ok spans never touch the ring (hot path
+    cost is the sample check), but error/shed/degraded outcomes ALWAYS
+    record — the failure platform never drops its own failures."""
+    tr = Tracer(capacity=16, sample=0.0)
+    tr.start_span("warn").end("ok")
+    assert tr.dump() == []
+    for outcome in ("error", "shed", "degraded"):
+        tr.start_span("warn").end(outcome)
+    assert sorted(s["outcome"] for s in tr.dump()) == [
+        "degraded", "error", "shed"
+    ]
+    p = tr.plane()
+    assert p["started"] == p["ended"] == 4 and p["orphaned"] == 0
+
+
+def test_ring_bounded_and_counts_dropped():
+    tr = Tracer(capacity=4, sample=1.0)
+    for i in range(10):
+        tr.start_span(f"s{i}").end("ok")
+    spans = tr.dump()
+    assert len(spans) == 4
+    assert [s["name"] for s in spans] == ["s6", "s7", "s8", "s9"]
+    p = tr.plane()
+    assert p["recorded"] == 10 and p["dropped"] == 6
+    assert p["orphaned"] == 0
+
+
+def test_assemble_tree_and_render():
+    tr = Tracer(capacity=16, sample=1.0)
+    with tr.start_span("root") as root:
+        with tr.start_span("mid"):
+            tr.start_span("leaf").end("ok")
+    spans = tr.dump(root.trace_id)
+    # duplicates (scatter-assembly) dedupe by span id
+    tree = assemble_tree(spans + spans)
+    assert len(tree) == 1
+    assert tree[0]["name"] == "root"
+    assert tree[0]["children"][0]["name"] == "mid"
+    assert tree[0]["children"][0]["children"][0]["name"] == "leaf"
+    out = render_trace(spans)
+    assert out.splitlines()[0].startswith(f"trace {root.trace_id}")
+    assert "root" in out and "leaf" in out
+    # a missing parent renders as a root instead of vanishing
+    orphan_tree = assemble_tree([s for s in spans if s["name"] != "root"])
+    assert [t["name"] for t in orphan_tree] == ["mid"]
+
+
+# ---------------------------------------------------------------------------
+# chaos: a failing tracer never fails a warn
+# ---------------------------------------------------------------------------
+
+
+def _platform(tmp_path, name="p"):
+    from kakveda_tpu.platform import Platform
+
+    return Platform(data_dir=tmp_path / name, capacity=256, dim=1024)
+
+
+def _ingest_trace(app_id, prompt):
+    from kakveda_tpu.models.runtime import STUB_RESPONSE
+
+    return {
+        "trace_id": str(uuid.uuid4()),
+        "ts": datetime.now(timezone.utc).isoformat(),
+        "app_id": app_id,
+        "agent_id": "agent-1",
+        "prompt": prompt,
+        "response": STUB_RESPONSE,
+        "model": "stub",
+        "tools": [],
+        "env": {"os": "linux"},
+    }
+
+
+@pytest.mark.chaos
+def test_trace_record_fault_never_fails_warn(tmp_path):
+    """Armed trace.record: every ring append dies — the warn still
+    answers 200, spans are counted dropped, nothing orphans. The tracer's
+    failure mode is silence, never a failed request."""
+    from kakveda_tpu.service.app import make_app
+
+    faults.disarm()
+    plat = _platform(tmp_path)
+    app = make_app(platform=plat)
+
+    async def go():
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            faults.arm("trace.record:1.0:-1")
+            r = await client.post(
+                "/warn", json={"app_id": "app-1", "prompt": "hello world"}
+            )
+            assert r.status == 200
+            body = await r.json()
+            assert "action" in body
+        finally:
+            faults.disarm()
+            await client.close()
+
+    run(go())
+    p = _trace.get_tracer().plane()
+    assert p["dropped"] > 0
+    assert p["orphaned"] == 0
+
+
+# ---------------------------------------------------------------------------
+# fleet drill: one warn, one assembled cross-process tree
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_drill_assembles_one_tree(tmp_path):
+    """One warn through the ownership router over two live replicas:
+    GET /trace/{id} on the router returns ONE assembled tree carrying the
+    router root, both scatter hops with replica + outcome provenance, the
+    replicas' service spans, and the GFKB verdict's tier provenance. The
+    trace id is the warn's x-request-id."""
+    from kakveda_tpu.fleet.ownership import OwnershipView
+    from kakveda_tpu.fleet.router import make_router_app
+    from kakveda_tpu.service.app import make_app
+
+    plat_a = _platform(tmp_path, "a")
+    plat_b = _platform(tmp_path, "b")
+
+    async def go():
+        ca = TestClient(TestServer(make_app(platform=plat_a)))
+        cb = TestClient(TestServer(make_app(platform=plat_b)))
+        await ca.start_server()
+        await cb.start_server()
+        urls = {
+            "r0": str(ca.make_url("")).rstrip("/"),
+            "r1": str(cb.make_url("")).rstrip("/"),
+        }
+        router = make_router_app(
+            urls, probe_interval_s=30.0, eject_fails=5, retries=1,
+            timeout_s=10.0, ownership=OwnershipView(urls, replication=1),
+        )
+        rc = TestClient(TestServer(router))
+        await rc.start_server()
+        try:
+            await ca.post("/ingest", json=_ingest_trace("app-1", "seed row"))
+            r = await rc.post(
+                "/warn", json={"app_id": "app-1", "prompt": "hello"}
+            )
+            assert r.status == 200
+            tid = r.headers.get("x-request-id")
+            assert tid and len(tid) == 32
+
+            r = await rc.get(f"/trace/{tid}")
+            assert r.status == 200
+            body = await r.json()
+            spans = body["spans"]
+            assert spans and all(s["trace_id"] == tid for s in spans)
+            by_name = {}
+            for s in spans:
+                by_name.setdefault(s["name"], []).append(s)
+            assert "router.request" in by_name
+            hops = by_name.get("router.scatter", [])
+            assert {h["attrs"]["replica"] for h in hops} == {"r0", "r1"}
+            assert all(h["outcome"] == "ok" for h in hops)
+            assert len(by_name.get("service.request", [])) == 2
+            warns = by_name.get("gfkb.warn", [])
+            assert len(warns) == 2 and all("tier" in w["attrs"] for w in warns)
+            # one tree: every span hangs off the single router root
+            tree = assemble_tree(spans)
+            assert len(tree) == 1 and tree[0]["name"] == "router.request"
+            assert body["tree"].startswith(f"trace {tid}")
+            assert body["sources"]["__router__"] >= 1
+            assert set(body["sources"]) == {"__router__", "r0", "r1"}
+            assert all(v >= 0 for v in body["sources"].values())
+        finally:
+            await rc.close()
+            await ca.close()
+            await cb.close()
+
+    run(go())
+
+
+# ---------------------------------------------------------------------------
+# replication → DLQ → replay continues the origin trace
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_dlq_replay_continues_origin_trace(tmp_path, monkeypatch):
+    """The replication envelope carries the ingest's trace context, the
+    DLQ record preserves the envelope verbatim, and `dlq replay`'s
+    redelivery applies under the SAME trace id — a lost-then-healed row
+    is one trace from origin ingest to converged peer."""
+    monkeypatch.setenv("KAKVEDA_BUS_RETRIES", "2")
+    monkeypatch.setenv("KAKVEDA_BUS_RETRY_BASE", "0.01")
+    faults.disarm()
+    from kakveda_tpu.events.bus import TOPIC_GFKB_REPLICATE, replay_dlq_file
+    from kakveda_tpu.service.app import make_app
+
+    plat_a = _platform(tmp_path, "a")
+    plat_b = _platform(tmp_path, "b")
+    dlq = tmp_path / "a" / "dlq.jsonl"
+
+    async def go():
+        ca = TestClient(TestServer(make_app(platform=plat_a)))
+        cb = TestClient(TestServer(make_app(platform=plat_b)))
+        await ca.start_server()
+        await cb.start_server()
+        try:
+            plat_a.bus.subscribe(
+                TOPIC_GFKB_REPLICATE, str(cb.make_url("/replicate"))
+            )
+            faults.arm("fleet.replicate_apply:1.0:-1")
+            r = await ca.post("/ingest/batch", json={"traces": [
+                _ingest_trace(
+                    "app-x", f"Cite sources for claim {i} even if unavailable."
+                )
+                for i in range(3)
+            ]})
+            assert r.status == 200
+            assert (await r.json())["failures"] >= 1
+            tid = r.headers.get("x-request-id")
+            assert tid and len(tid) == 32
+            # delivery retries + dead-lettering run off the response path
+            for _ in range(100):
+                if dlq.exists() and dlq.read_text().strip():
+                    break
+                await asyncio.sleep(0.05)
+            assert dlq.exists() and dlq.read_text().strip()
+        finally:
+            await ca.close()
+            faults.disarm()
+            out = await asyncio.get_running_loop().run_in_executor(
+                None, lambda: replay_dlq_file(dlq, timeout=5.0)
+            )
+            assert out["failed"] == 0 and out["replayed"] >= 1
+            await cb.close()
+        return tid
+
+    tid = run(go())
+    spans = _trace.get_tracer().dump(tid)
+    applies = [s for s in spans if s["name"] == "gfkb.replicate_apply"]
+    # the armed first delivery errored under the same trace; the replay
+    # redelivery applied ok — BOTH continue the origin ingest's trace.
+    assert any(s["outcome"] == "error" for s in applies)
+    ok = [s for s in applies if s["outcome"] == "ok"]
+    assert ok and ok[-1]["attrs"].get("applied", 0) >= 1
+    assert any(s["name"] == "gfkb.ingest" for s in spans)
+
+
+# ---------------------------------------------------------------------------
+# histogram exemplars + federation (core/metrics.py)
+# ---------------------------------------------------------------------------
+
+
+def test_histogram_exemplars_render_and_snapshot():
+    from kakveda_tpu.core.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    h = reg.histogram("t_warn_seconds", "test latency")
+    tid_a, tid_b = uuid.uuid4().hex, uuid.uuid4().hex
+    h.observe(0.01, exemplar=tid_a)
+    h.observe(0.01, exemplar=tid_b)  # last-write-wins per bucket
+    h.observe(0.02)  # no exemplar: bucket keeps the old one
+    text = reg.render()
+    assert f'# {{trace_id="{tid_b}"}} 0.01' in text
+    assert tid_a not in text
+    snap = reg.snapshot()
+    series = snap["t_warn_seconds"]["series"]
+    ex = next(iter(series.values()))["exemplar"]
+    assert ex["trace_id"] == tid_b and ex["value"] == 0.01
+
+
+def test_metrics_federation_sums_and_labels():
+    """federate_renders: counters and histogram buckets SUM across
+    replicas; gauges get a replica label instead (summing occupancies is
+    a lie); exemplar suffixes never break the parser."""
+    from kakveda_tpu.core.metrics import federate_renders, parse_prometheus_text
+
+    r0 = "\n".join([
+        "# HELP w_total warns",
+        "# TYPE w_total counter",
+        'w_total{app="a"} 3',
+        "# HELP occ occupancy",
+        "# TYPE occ gauge",
+        "occ 0.5",
+        "# HELP lat_seconds latency",
+        "# TYPE lat_seconds histogram",
+        'lat_seconds_bucket{le="0.1"} 2 # {trace_id="abc"} 0.05',
+        'lat_seconds_bucket{le="+Inf"} 3',
+        "lat_seconds_sum 0.4",
+        "lat_seconds_count 3",
+    ]) + "\n"
+    r1 = "\n".join([
+        "# TYPE w_total counter",
+        'w_total{app="a"} 4',
+        "# TYPE occ gauge",
+        "occ 0.9",
+        "# TYPE lat_seconds histogram",
+        'lat_seconds_bucket{le="0.1"} 5',
+        'lat_seconds_bucket{le="+Inf"} 6',
+        "lat_seconds_sum 1.0",
+        "lat_seconds_count 6",
+    ]) + "\n"
+    out = federate_renders({"r0": r0, "r1": r1})
+    assert 'w_total{app="a"} 7' in out
+    assert 'occ{replica="r0"} 0.5' in out
+    assert 'occ{replica="r1"} 0.9' in out
+    assert 'lat_seconds_bucket{le="0.1"} 7' in out
+    assert 'lat_seconds_bucket{le="+Inf"} 9' in out
+    assert "lat_seconds_sum 1.4" in out
+    assert "lat_seconds_count 9" in out
+    # the federated text is itself parseable (round-trip sanity)
+    fams = parse_prometheus_text(out)
+    assert fams["w_total"]["type"] == "counter"
+    assert fams["occ"]["type"] == "gauge"
+
+
+def test_service_trace_endpoints(tmp_path):
+    """GET /trace returns the plane + ring; GET /trace/{id} filters to
+    one trace — the per-process collection surface the router's
+    scatter-assembler pulls from."""
+    from kakveda_tpu.service.app import make_app
+
+    plat = _platform(tmp_path)
+    app = make_app(platform=plat)
+
+    async def go():
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            r = await client.post(
+                "/warn", json={"app_id": "app-1", "prompt": "hi"}
+            )
+            assert r.status == 200
+            tid = r.headers["x-request-id"]
+            body = await (await client.get("/trace")).json()
+            # the GET /trace request's own span is still in flight while
+            # the handler snapshots the plane — at most that one orphan
+            assert body["plane"]["orphaned"] <= 1
+            assert any(s["trace_id"] == tid for s in body["spans"])
+            body = await (await client.get(f"/trace/{tid}")).json()
+            assert body["trace_id"] == tid
+            names = {s["name"] for s in body["spans"]}
+            assert {"service.request", "gfkb.warn"} <= names
+        finally:
+            await client.close()
+
+    run(go())
+
+
+def test_replay_dispatch_spans_tag_records_and_balance():
+    """Every replayed dispatch carries a trace tag and its span ends in
+    exactly one bucket — the zero-orphan invariant the storm bench row
+    certifies — and a failing latency gate emits exemplar trace ids."""
+    from kakveda_tpu.traffic.replay import ReplayResult, replay
+    from kakveda_tpu.traffic.slo import SLO, evaluate
+
+    events = [
+        {"t": 0.0, "klass": "warn", "path": "/warn", "body": {}, "phase": "x"}
+        for _ in range(4)
+    ]
+
+    async def post(path, body):
+        await asyncio.sleep(0.01)
+        return 200
+
+    res = run(replay(events, post=post, speed=1000.0, timeout_s=2.0,
+                     result=ReplayResult()))
+    assert len(res.records) == 4
+    assert all(r.get("trace") for r in res.records)
+    assert all(r["status"] == "ok" for r in res.records)
+    p = _trace.get_tracer().plane()
+    assert p["orphaned"] == 0
+    # an impossible latency bound fails — with worst-offender exemplars
+    report = evaluate(SLO(name="t", warn_p95_ms=0.0001, zero_lost=()), res)
+    gate = next(g for g in report.gates if g.gate == "warn_p95_ms")
+    assert not gate.ok and gate.exemplars
+    assert gate.exemplars[0] in {r["trace"] for r in res.records}
+    assert "exemplars" in gate.to_dict()
