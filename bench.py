@@ -6,11 +6,15 @@ warn-under-decode and pattern mining, and prints ONE JSON line —
 headline = the warn north star, with the rest under ``extra_metrics`` so
 the driver's BENCH_r{N}.json carries every number.
 ``KAKVEDA_BENCH_METRIC=warn|ingest|decode|spec|continuous|mixed|
-mixed-decode|mine|serve|overload|tiered|fleet|storm|elastic`` runs a
-single metric instead (``overload`` floods the HTTP tier past its
+mixed-decode|mine|serve|overload|tiered|recovery|fleet|storm|elastic``
+runs a single metric instead (``overload`` floods the HTTP tier past its
 admission bounds and proves shedding keeps warn p95 bounded; ``tiered``
 A/Bs the IVF-routed tiered GFKB against the exact oracle at 1M rows plus
 a 10M host/disk arm — docs/robustness.md, docs/performance.md § tiered;
+``recovery`` certifies the GFKB durability lifecycle — ≥5× restart
+replay after compaction, recall@1 parity, aging resident-bytes bound,
+crash-point sweep with zero corrupt recoveries — docs/robustness.md
+§ failure-memory lifecycle;
 ``storm`` replays the seeded hot-key-skew + failure-storm scenario with
 its chaos timeline through the traffic harness and self-certifies the
 SLO gates — kakveda_tpu/traffic/, docs/robustness.md § traffic harness;
@@ -3392,6 +3396,293 @@ def _bench_tiered(backend: str) -> dict:
     }
 
 
+_RECOVERY_CHILD = r'''
+import json, sys, time
+import jax
+jax.config.update("jax_platforms", "cpu")
+from pathlib import Path
+from kakveda_tpu.index.gfkb import GFKB
+
+mode, data = sys.argv[1], Path(sys.argv[2])
+cap, dim, n, versions = (int(a) for a in sys.argv[3:7])
+sig = lambda i: (
+    f"recovery bench failure signature {i} stack frame worker pool shard {i % 17}"
+)
+if mode == "seed":
+    kb = GFKB(data_dir=data, capacity=cap, dim=dim)
+    B = 1024
+    t0 = time.perf_counter()
+    for v in range(versions):
+        for s in range(0, n, B):
+            kb.upsert_failures_batch([
+                {"failure_type": "oom" if i % 2 else "timeout",
+                 "signature_text": sig(i), "app_id": f"app-{i % 7}",
+                 "impact_severity": "high"}
+                for i in range(s, min(n, s + B))
+            ])
+    kb.close()
+    print(json.dumps({
+        "seed_s": round(time.perf_counter() - t0, 2),
+        "log_bytes": (data / "failures.jsonl").stat().st_size,
+        "log_lines": n * versions,
+    }))
+elif mode == "open":
+    queries = json.loads(sys.stdin.read())
+    # Warm the process on a throwaway store of the SAME row count, then
+    # compact+reopen it: jit compilation is code-and-shape-shaped, not
+    # state-shaped — a production restart with a persistent compile
+    # cache would not re-pay the replay-path OR bulk-restore-path
+    # compiles per stored row. Both arms (uncompacted and compacted)
+    # get the identical treatment, so the timed delta is purely
+    # replay-vs-checkpoint.
+    import tempfile
+    _wd = Path(tempfile.mkdtemp())
+    _wk = GFKB(data_dir=_wd, capacity=cap, dim=dim)
+    for _s in range(0, n, 1024):
+        _wk.upsert_failures_batch([
+            {"failure_type": "oom", "signature_text": f"warmup row {_i}",
+             "app_id": "warm", "impact_severity": "high"}
+            for _i in range(_s, min(n, _s + 1024))
+        ])
+    _wk.compact()
+    _wk.close()
+    GFKB(data_dir=_wd, capacity=cap, dim=dim).close()
+    t0 = time.perf_counter()
+    kb = GFKB(data_dir=data, capacity=cap, dim=dim)
+    open_s = time.perf_counter() - t0
+    top1 = [
+        [str(m[0].failure_id), float(m[0].score)] if m else None
+        for m in kb.match_batch(queries)
+    ]
+    info = kb.lifecycle_info()
+    kb.close()
+    print(json.dumps({"open_s": round(open_s, 3), "top1": top1,
+                      "rows": len(kb._records), "lifecycle": info}))
+elif mode == "compact":
+    kb = GFKB(data_dir=data, capacity=cap, dim=dim)
+    out = kb.compact()
+    kb.close()
+    print(json.dumps(out))
+elif mode == "aging":
+    # Month-compressed aging: replay the aging scenario's ingest events
+    # into a fresh store stamping each cohort at its VIRTUAL time, then
+    # run the TTL pass with an injected clock and compact. Certifies the
+    # resident-bytes bound without waiting out real weeks.
+    import datetime
+    from kakveda_tpu.traffic.scenarios import make_scenario
+    sc = make_scenario("aging", seed=11, duration_s=8.0)
+    kb = GFKB(data_dir=data, capacity=cap, dim=dim)
+    comp = sc.notes["compression"]
+    now0 = time.time()
+    for e in sc.events:
+        if e["klass"] != "ingest":
+            continue
+        res = kb.upsert_failures_batch([
+            {"failure_type": "hallucinated_citation",
+             "signature_text": t["prompt"],
+             "app_id": e["app_id"], "impact_severity": "high"}
+            for t in e["body"]["traces"]
+        ])
+        # Stamp the touched records at the event's VIRTUAL timestamp —
+        # upsert returns the stored objects, so age_rows sees cohort k as
+        # k virtual weeks old even though the whole replay took seconds.
+        vts = datetime.datetime.fromtimestamp(
+            now0 + e["t"] * comp, tz=datetime.timezone.utc
+        )
+        with kb._lock:
+            for rec, _created in res:
+                rec.updated_at = vts
+    bytes_before = (data / "failures.jsonl").stat().st_size
+    rows_before = len(kb._records)
+    now_virtual = now0 + sc.duration_s * comp
+    aged = kb.age_rows(ttl_s=sc.notes["age_ttl_virtual_s"], now=now_virtual)
+    out = kb.compact()
+    kb.close()
+    print(json.dumps({
+        "rows": rows_before,
+        "aged": aged["tombstoned"],
+        "bytes_before": bytes_before,
+        "bytes_after": (data / "failures.jsonl").stat().st_size
+        + (data / "tombstones.jsonl").stat().st_size,
+        "compact": out,
+    }))
+else:
+    raise SystemExit(f"unknown mode {mode}")
+'''
+
+
+def _bench_recovery(backend: str) -> dict:
+    """GFKB durability-lifecycle certification, self-certifying end to end.
+
+    Four sub-certifications, each of which RAISES on failure (ISSUE 18):
+    (1) restart-replay wall at ``KAKVEDA_BENCH_RECOVERY_N × _VERSIONS``
+    log lines (default 10k signatures × 30 occurrence bumps = 300k —
+    the months-of-recurrences shape the lifecycle exists for: a
+    signature recurring daily for a month appends 30 update lines the
+    checkpoint folds into one) must improve ≥
+    ``KAKVEDA_BENCH_RECOVERY_IMPROVE``× (default 5×) after checkpoint+
+    delta compaction; (2) recall@1 parity on a held-out warn set vs the
+    uncompacted oracle (top-1 id equal, or score tie within 1e-5); (3)
+    the month-compressed aging scenario tombstones its expired cohorts
+    and ends with failures-log+tombstone bytes strictly below the
+    uncompacted log (resident-bytes bound); (4) the crash-point sweep
+    over every lifecycle kill offset reports ``corrupt_recoveries == 0``.
+
+    Host-durability by design: every store open/seed/compact runs in a
+    CPU-pinned child process (the sitecustomize TPU pin is overridden
+    in-child), so this metric survives a chip outage and never holds —
+    or wedges — the device lease.
+    """
+    import shutil
+    import subprocess
+    import tempfile
+    from pathlib import Path
+
+    n = int(os.environ.get("KAKVEDA_BENCH_RECOVERY_N", 10_000))
+    versions = int(os.environ.get("KAKVEDA_BENCH_RECOVERY_VERSIONS", 30))
+    n_queries = int(os.environ.get("KAKVEDA_BENCH_RECOVERY_QUERIES", 64))
+    improve_min = float(os.environ.get("KAKVEDA_BENCH_RECOVERY_IMPROVE", 5.0))
+    cap = int(os.environ.get("KAKVEDA_BENCH_RECOVERY_CAP", 2048))
+    dim = 256
+    print(
+        f"bench[recovery]: n={n} versions={versions} queries={n_queries} "
+        f"improve_min={improve_min}x",
+        file=sys.stderr,
+    )
+
+    env = {k: v for k, v in os.environ.items() if not k.startswith("KAKVEDA_")}
+    # Tiered serving shape: rows past the hot cap live in the host warm
+    # tier, which is the realistic ≥100k-row production profile AND what
+    # the restore path is optimized for (device scatter for hot rows
+    # only, numpy install for warm).
+    env["KAKVEDA_GFKB_HOT_ROWS"] = str(cap)
+
+    def child(mode: str, data: Path, stdin: str = "") -> dict:
+        proc = subprocess.run(
+            [sys.executable, "-c", _RECOVERY_CHILD, mode, str(data),
+             str(cap), str(dim), str(n), str(versions)],
+            input=stdin, capture_output=True, text=True, env=env,
+            timeout=3600,
+        )
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"bench[recovery] {mode} child failed rc={proc.returncode}:\n"
+                f"{proc.stderr[-2000:]}"
+            )
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+
+    root = Path(tempfile.mkdtemp(prefix="kakveda-recovery-"))
+    try:
+        store = root / "store"
+        store.mkdir()
+        seeded = child("seed", store)
+        print(
+            f"bench[recovery]: seeded {seeded['log_lines']:,} log lines "
+            f"({seeded['log_bytes']:,}B) in {seeded['seed_s']}s",
+            file=sys.stderr,
+        )
+        rng = np.random.default_rng(23)
+        queries = [
+            f"recovery bench failure signature {i} stack frame worker pool "
+            f"shard {i % 17}"
+            for i in rng.integers(0, n, size=n_queries).tolist()
+        ]
+        qjson = json.dumps(queries)
+
+        # Uncompacted oracle: replay the full version-append history.
+        pre = child("open", store, stdin=qjson)
+        # Compact, then reopen: checkpoint + (empty) delta.
+        child("compact", store)
+        post = child("open", store, stdin=qjson)
+        improve = pre["open_s"] / max(post["open_s"], 1e-9)
+        parity = [
+            a is None and b is None
+            or (a is not None and b is not None
+                and (a[0] == b[0] or b[1] >= a[1] - 1e-5))
+            for a, b in zip(pre["top1"], post["top1"])
+        ]
+        recall = float(np.mean(parity))
+        print(
+            f"bench[recovery]: replay {pre['open_s']}s -> {post['open_s']}s "
+            f"({improve:.1f}x) recall@1={recall:.4f}",
+            file=sys.stderr,
+        )
+        if improve < improve_min:
+            raise RuntimeError(
+                f"bench[recovery]: compaction replay speedup {improve:.2f}x "
+                f"< required {improve_min}x"
+            )
+        if recall < 1.0:
+            raise RuntimeError(
+                f"bench[recovery]: recall@1 parity {recall:.4f} < 1.0 vs "
+                f"uncompacted oracle"
+            )
+
+        # Month-compressed aging scenario: resident-bytes bound.
+        aging_dir = root / "aging"
+        aging_dir.mkdir()
+        aging = child("aging", aging_dir)
+        print(
+            f"bench[recovery]: aging scenario rows={aging['rows']} "
+            f"aged={aging['aged']} bytes {aging['bytes_before']:,} -> "
+            f"{aging['bytes_after']:,}",
+            file=sys.stderr,
+        )
+        if aging["aged"] <= 0:
+            raise RuntimeError(
+                "bench[recovery]: aging scenario tombstoned no rows"
+            )
+        if aging["bytes_after"] >= aging["bytes_before"]:
+            raise RuntimeError(
+                f"bench[recovery]: resident bytes not bound after aging "
+                f"({aging['bytes_before']} -> {aging['bytes_after']})"
+            )
+
+        # Crash-point sweep: every lifecycle kill offset must recover.
+        from kakveda_tpu.index.crashsweep import run_sweep
+
+        sweep = run_sweep(rows=8, aged=4)
+        print(
+            f"bench[recovery]: crash sweep kill_points="
+            f"{sweep['kill_points']} corrupt={sweep['corrupt_recoveries']}",
+            file=sys.stderr,
+        )
+        if sweep["corrupt_recoveries"] != 0:
+            raise RuntimeError(
+                f"bench[recovery]: crash sweep found "
+                f"{sweep['corrupt_recoveries']} corrupt recoveries: "
+                f"{sweep['failures'][:3]}"
+            )
+
+        return {
+            "metric": f"recovery_replay_speedup_at_{n * versions}_lines",
+            "value": round(improve, 2),
+            "unit": "x",
+            "vs_baseline": round(improve, 1),
+            "replay_uncompacted_s": pre["open_s"],
+            "replay_compacted_s": post["open_s"],
+            "log_bytes": seeded["log_bytes"],
+            "log_lines": seeded["log_lines"],
+            "recall_at1": round(recall, 4),
+            "recall_ok": bool(recall >= 1.0),
+            "speedup_ok": bool(improve >= improve_min),
+            "aging": {
+                "rows": aging["rows"],
+                "aged": aging["aged"],
+                "bytes_before": aging["bytes_before"],
+                "bytes_after": aging["bytes_after"],
+                "bytes_bound_ok": True,
+            },
+            "crash_sweep": {
+                "kill_points": sweep["kill_points"],
+                "corrupt_recoveries": sweep["corrupt_recoveries"],
+                "sites": sweep["sites"],
+            },
+        }
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def _metrics_plane() -> dict:
     """Compact snapshot of the process-global metrics registry, folded
     into every emitted bench JSON line: BENCH_*.json then carries the
@@ -3772,6 +4063,7 @@ def main() -> int:
         "serve": _bench_serve,
         "overload": _bench_overload,
         "tiered": _bench_tiered,
+        "recovery": _bench_recovery,
         "fleet": _bench_fleet,
         "ownership": _bench_ownership,
         "storm": _bench_storm,
@@ -3824,6 +4116,7 @@ def main() -> int:
         _bench_mixed_decode,
         _bench_mine,
         _bench_tiered,
+        _bench_recovery,
         _bench_fleet,
         _bench_ownership,
         _bench_storm,

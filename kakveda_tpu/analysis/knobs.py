@@ -29,6 +29,7 @@ SITE_RE = re.compile(r"""\bsite\(\s*["']([a-z0-9_]+(?:\.[a-z0-9_]+)+)["']\s*\)""
 ALLOWLIST = frozenset({
     "KAKVEDA_PROCESS_ID",  # set per-process by the multihost launcher
     "KAKVEDA_TEST_PLATFORM",  # test-suite lever (tests/conftest.py), named here
+    "KAKVEDA_CRASHSWEEP_CHILD",  # marker set per-child by the crash sweep
 })
 
 # Knobs the docs legitimately mention without the scanned code tree reading

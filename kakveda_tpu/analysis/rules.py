@@ -769,3 +769,127 @@ class KnobDocsParity(Rule):
                 "dead-knob drift sends operators tuning a no-op",
             ))
         return out
+
+
+# ---------------------------------------------------------------------------
+# atomic-log-rewrite
+# ---------------------------------------------------------------------------
+
+# The replayed stores: every byte of these files is state (restart = replay),
+# so an in-place "w"-mode rewrite that crashes mid-write IS data loss. The
+# only legal rewrite is write-tmp -> fsync -> os.replace (the compaction
+# idiom); expressions routed through .with_suffix() derive such a tmp/bak
+# sibling and pass.
+_REPLAYED_LOG_ATTRS = frozenset({
+    "failures_path", "patterns_path", "applied_path", "tombstones_path",
+})
+_REPLAYED_LOG_NAMES = (
+    "failures.jsonl", "patterns.jsonl", "applied_events.jsonl",
+    "tombstones.jsonl",
+)
+_TRUNCATING_WRITERS = frozenset({"write_text", "write_bytes"})
+
+
+def _replayed_log_ref(node: ast.AST) -> Optional[str]:
+    """The replayed log this path expression refers to (else None).
+    ``.with_suffix``-derived expressions name a tmp/bak sibling, not the
+    log itself — they return None by design."""
+    for n in ast.walk(node):
+        if isinstance(n, ast.Attribute) and n.attr == "with_suffix":
+            return None
+    for n in ast.walk(node):
+        if isinstance(n, ast.Attribute) and n.attr in _REPLAYED_LOG_ATTRS:
+            return n.attr
+        s = _const_str(n)
+        if s is not None:
+            for name in _REPLAYED_LOG_NAMES:
+                if s == name or s.endswith("/" + name):
+                    return name
+    return None
+
+
+def _truncating_write_target(call: ast.Call) -> Optional[ast.AST]:
+    """The path expression a call truncates, if it is a truncating write:
+    ``X.write_text(...)`` / ``X.write_bytes(...)`` / ``X.open("w"…)`` /
+    ``open(X, "w"…)`` — else None. Append ("a") and read modes pass."""
+    f = call.func
+    if isinstance(f, ast.Attribute) and f.attr in _TRUNCATING_WRITERS:
+        return f.value
+    if isinstance(f, ast.Attribute) and f.attr == "open":
+        mode = _const_str(call.args[0]) if call.args else None
+        if mode is None:
+            for kw in call.keywords:
+                if kw.arg == "mode":
+                    mode = _const_str(kw.value)
+        if mode is not None and mode.startswith("w"):
+            return f.value
+    if isinstance(f, ast.Name) and f.id == "open" and len(call.args) >= 2:
+        mode = _const_str(call.args[1])
+        if mode is not None and mode.startswith("w"):
+            return call.args[0]
+    return None
+
+
+@register
+class AtomicLogRewrite(Rule):
+    id = "atomic-log-rewrite"
+    invariant = (
+        "replayed logs (failures/patterns/applied_events/tombstones "
+        ".jsonl) are never opened 'w' in place — rewrites go write-tmp + "
+        "fsync + os.replace (crash at any byte leaves old or new log "
+        "fully live); torn-FINAL-line truncation is the only in-place "
+        "surgery and it goes through _truncate_pending"
+    )
+    scope = ("kakveda_tpu/", "bench.py", "scripts/", "__graft_entry__.py")
+
+    def visit_file(self, fc: FileContext, ctx: TreeContext) -> List[Finding]:
+        out: List[Finding] = []
+        # Local helpers that "w"-rewrite one of their own parameters: a
+        # call passing a replayed-log path into one is the same hazard one
+        # hop away (the routes_admin _purge_jsonl shape).
+        rewriting_helpers: Dict[str, Set[int]] = {}
+        for n in ast.walk(fc.tree):
+            if not isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            params = {a.arg for a in n.args.args if a.arg != "self"}
+            if not params:
+                continue
+            hit = {
+                i for i, a in enumerate(n.args.args)
+                for c in ast.walk(n)
+                if isinstance(c, ast.Call)
+                and (t := _truncating_write_target(c)) is not None
+                and isinstance(t, ast.Name) and t.id == a.arg
+            }
+            if hit:
+                rewriting_helpers[n.name] = hit
+        for n in ast.walk(fc.tree):
+            if not isinstance(n, ast.Call):
+                continue
+            target = _truncating_write_target(n)
+            if target is not None:
+                ref = _replayed_log_ref(target)
+                if ref is not None:
+                    out.append(Finding(
+                        self.id, fc.rel, n.lineno,
+                        f"in-place 'w'-mode rewrite of replayed log "
+                        f"{ref!r} — a crash mid-write loses committed "
+                        "state; write a .tmp sibling, fsync, then "
+                        "os.replace (or append)",
+                    ))
+                continue
+            if isinstance(n.func, ast.Name) and n.func.id in rewriting_helpers:
+                for i, arg in enumerate(n.args):
+                    if i not in rewriting_helpers[n.func.id]:
+                        continue
+                    ref = _replayed_log_ref(arg)
+                    if ref is not None:
+                        out.append(Finding(
+                            self.id, fc.rel, n.lineno,
+                            f"replayed log {ref!r} passed into "
+                            f"{n.func.id}(), which rewrites its argument "
+                            "in place with mode 'w' — a crash mid-write "
+                            "loses committed state; rewrite via .tmp + "
+                            "os.replace",
+                        ))
+        return out

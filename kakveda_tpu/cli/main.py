@@ -1,4 +1,4 @@
-"""`kakveda-tpu` CLI: init | up | down | status | reset | logs | dlq | traffic | doctor | version.
+"""`kakveda-tpu` CLI: init | up | down | status | reset | logs | dlq | traffic | compact | doctor | version.
 
 Verb parity with the reference CLI (reference: kakveda_cli/cli.py:46-409),
 re-targeted at the single-process TPU platform: where the reference
@@ -238,7 +238,32 @@ def _cmd_doctor(args: argparse.Namespace) -> int:
                                f"{last.get('outcome')}→{last.get('target')}")
         return f"{' '.join(parts)} fleet_mode={worst}{own_note}{scale_note}"
 
+    def _replay_budget():
+        """Durability posture vs the operator's recovery-time budget:
+        KAKVEDA_GFKB_REPLAY_BUDGET_S > 0 turns the replay estimate into a
+        hard doctor check — a restart that would replay longer than the
+        budget is an error to fix with `kakveda-tpu compact`, not a
+        surprise during the next incident."""
+        data = Path(args.dir) / "data"
+        if not data.exists():
+            return "no data dir yet"
+        post = _durability_posture(data)
+        budget = float(os.environ.get("KAKVEDA_GFKB_REPLAY_BUDGET_S", "0"))
+        est = post["replay_estimate_s"]
+        note = (
+            f"replay≈{est}s ({post['replayable_bytes']}B replayable, "
+            f"generation {post['compact_generation']}, "
+            f"{post['tombstoned_rows']} tombstoned)"
+        )
+        if budget > 0 and est > budget:
+            raise RuntimeError(
+                f"{note} exceeds KAKVEDA_GFKB_REPLAY_BUDGET_S={budget} — "
+                f"run `kakveda-tpu compact`"
+            )
+        return note
+
     check("python", lambda: sys.version.split()[0])
+    check("replay budget", _replay_budget)
     check("fleet", _fleet)
     check("jax", _jax)
     check("device mesh", _mesh)
@@ -306,6 +331,68 @@ def _pid_alive(pid: int) -> bool:
         return True
 
 
+def _durability_posture(data: Path) -> dict:
+    """Per-store durability posture from the files alone — no jax, no
+    GFKB construction, safe against a live server holding the store.
+
+    Replay time is estimated as replayable-bytes / KAKVEDA_GFKB_REPLAY_RATE
+    (bytes/s, default 4 MiB/s — conservative for the pydantic JSONL parse
+    path); replayable bytes start at the snapshot manifest's log_offset,
+    so a compaction directly shrinks the estimate the operator sees."""
+    rate = float(os.environ.get("KAKVEDA_GFKB_REPLAY_RATE", str(4 << 20)))
+    stores = {}
+    replayable = 0
+    for name in ("failures", "patterns", "applied_events", "tombstones"):
+        f = data / f"{name}.jsonl"
+        try:
+            size = f.stat().st_size
+        except OSError:
+            size = 0
+        stores[name] = {"bytes": size}
+        replayable += size
+    manifest = {}
+    try:
+        manifest = json.loads((data / "snapshot" / "manifest.json").read_text())
+    except (OSError, ValueError):
+        pass
+    offset = int(manifest.get("log_offset", 0) or 0)
+    # The snapshot replaces log replay up to log_offset.
+    stores["failures"]["replayable_bytes"] = max(
+        0, stores["failures"]["bytes"] - offset
+    )
+    replayable -= min(offset, stores["failures"]["bytes"])
+    tomb = 0
+    f = data / "tombstones.jsonl"
+    if f.exists():
+        net = {}
+        try:
+            for ln in f.read_text().splitlines():
+                ln = ln.strip()
+                if not ln:
+                    continue
+                try:
+                    rec = json.loads(ln)
+                except ValueError:
+                    continue  # torn tail — the store's replay handles it
+                if rec.get("op") == "tomb":
+                    net[rec.get("id")] = rec.get("reason")
+                else:
+                    net.pop(rec.get("id"), None)
+            tomb = len(net)
+        except OSError:
+            pass
+    compact = manifest.get("compact") or {}
+    return {
+        "stores": stores,
+        "snapshot_rows": int(manifest.get("n", 0) or 0),
+        "compact_generation": int(compact.get("generation", 0) or 0),
+        "last_compact_ts": float(compact.get("ts", 0.0) or 0.0),
+        "tombstoned_rows": tomb,
+        "replayable_bytes": max(0, replayable),
+        "replay_estimate_s": round(max(0, replayable) / rate, 3),
+    }
+
+
 def _cmd_status(args: argparse.Namespace) -> int:
     root = Path(args.dir)
     data = root / "data"
@@ -313,6 +400,8 @@ def _cmd_status(args: argparse.Namespace) -> int:
     for name in ("failures", "patterns", "health"):
         f = data / f"{name}.jsonl"
         status[name] = sum(1 for ln in f.read_text().splitlines() if ln.strip()) if f.exists() else 0
+    if data.exists():
+        status["durability"] = _durability_posture(data)
     pid = _read_pid(root)
     status["server"] = (
         {"pid": pid, "running": _pid_alive(pid)} if pid else {"pid": None, "running": False}
@@ -366,6 +455,65 @@ def _cmd_status(args: argparse.Namespace) -> int:
                 block["decisions"] = 0
             status["autoscale"] = block
     print(json.dumps(status, indent=2))
+    return 0
+
+
+def _cmd_compact(args: argparse.Namespace) -> int:
+    """Offline failures-log compaction: open the GFKB against the data
+    dir, checkpoint + rewrite, print the posture delta. Refuses while a
+    recorded server owns the store — the GFKB is single-writer, and a
+    live process would keep appending into the pre-swap inode."""
+    root = Path(args.dir)
+    data = root / "data"
+    pid = _read_pid(root)
+    if pid and _pid_alive(pid) and not args.force:
+        print(
+            f"server pid {pid} is running against {data} — stop it first "
+            f"(or --force if you know the pid file is stale)",
+            file=sys.stderr,
+        )
+        return 1
+    if not (data / "failures.jsonl").exists():
+        print(f"nothing to compact: no failures log under {data}")
+        return 0
+    before = _durability_posture(data)
+    import jax
+
+    # In-process override beats the image's TPU-pinning sitecustomize; a
+    # maintenance rewrite must never touch (or wedge) the device lease.
+    jax.config.update("jax_platforms", "cpu")
+    from kakveda_tpu.core.config import ConfigStore
+    from kakveda_tpu.index.gfkb import GFKB
+
+    dim = args.dim or ConfigStore().embedding_dim()
+    kb = GFKB(data_dir=data, capacity=args.capacity, dim=dim)
+    try:
+        if args.age_ttl > 0:
+            aged = kb.age_rows(ttl_s=args.age_ttl)
+            print(f"aged out {aged['tombstoned']} rows (ttl {args.age_ttl}s)")
+        if args.collapse > 1:
+            col = kb.collapse_duplicates(min_cluster=args.collapse)
+            print(
+                f"collapsed {col['collapsed']} rows across "
+                f"{col['clusters']} clusters"
+            )
+        out = kb.compact()
+    finally:
+        kb.close()
+    after = _durability_posture(data)
+    print(
+        json.dumps(
+            {
+                "compact": out,
+                "replay_estimate_s": {
+                    "before": before["replay_estimate_s"],
+                    "after": after["replay_estimate_s"],
+                },
+                "durability": after,
+            },
+            indent=2,
+        )
+    )
     return 0
 
 
@@ -866,7 +1014,8 @@ def build_parser() -> argparse.ArgumentParser:
                     help="replay: traffic log to drive")
     sp.add_argument("--scenario", default=None,
                     help="replay: named scenario instead of a log "
-                         "(diurnal|hot_key|failure_storm|near_dup|mixed|storm)")
+                         "(diurnal|hot_key|failure_storm|near_dup|mixed|"
+                         "storm|aging)")
     sp.add_argument("--seed", type=int, default=0)
     sp.add_argument("--duration", type=float, default=12.0,
                     help="scenario duration in seconds")
@@ -888,6 +1037,26 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--url", default="http://localhost:8000")
     sp.add_argument("--timeout", type=float, default=5.0)
     sp.set_defaults(fn=_cmd_trace)
+
+    sp = sub.add_parser(
+        "compact",
+        help="offline GFKB lifecycle maintenance: optional aging/collapse, "
+             "then checkpoint+delta log compaction (server must be down)",
+    )
+    sp.add_argument("--dir", default=".")
+    sp.add_argument("--capacity", type=int, default=1 << 14,
+                    help="GFKB device capacity (match the server's)")
+    sp.add_argument("--dim", type=int, default=0,
+                    help="embedding dim (0 = from config)")
+    sp.add_argument("--age-ttl", type=float, default=0.0,
+                    help="tombstone rows idle longer than this many seconds "
+                         "before compacting (0 = skip aging)")
+    sp.add_argument("--collapse", type=int, default=0,
+                    help="collapse mining clusters with ≥ N near-duplicate "
+                         "members to one exemplar (0 = skip)")
+    sp.add_argument("--force", action="store_true",
+                    help="compact even though server.pid looks alive")
+    sp.set_defaults(fn=_cmd_compact)
 
     sp = sub.add_parser("doctor", help="check the runtime environment")
     sp.add_argument("--dir", default=".", help="project root (for .env)")

@@ -29,6 +29,15 @@ Design (mirrors the metrics plane's resolve-once pattern):
 * An injection raises :class:`FaultInjected` at the site and increments
   ``kakveda_faults_injected_total{site=…}`` — chaos runs are observable on
   the same /metrics plane as the recovery they exercise.
+* **Crash points** (:func:`arm_crash` / ``KAKVEDA_FAULTS_CRASH=site:nth,…``)
+  are the power-cut mode: the n-th pass through the site hard-kills the
+  process with ``os._exit(137)`` — no exception, no ``finally``, no atexit,
+  no buffered-write flush. The crash-point recovery sweep
+  (index/crashsweep.py) arms these in a child process at every durable
+  write seam of a compaction/aging cycle and certifies the recovered store.
+  Crash arming composes with (and is cleared by) :func:`arm`/:func:`disarm`
+  like any other arming, so the standard test teardown can never leave a
+  process-killing trap behind.
 
 The fault-site catalog lives in docs/robustness.md; adding a site means
 adding it there — scripts/check_knobs.py (tier-1) fails when a ``site("…")``
@@ -58,6 +67,7 @@ __all__ = [
     "FaultSchedule",
     "site",
     "arm",
+    "arm_crash",
     "disarm",
     "armed_sites",
     "schedule",
@@ -78,7 +88,7 @@ class FaultSite:
     """One named injection point. ``fire()`` is the hot-path call: a bare
     attribute check when unarmed, a lock + seeded draw when armed."""
 
-    __slots__ = ("name", "armed", "prob", "remaining", "fired")
+    __slots__ = ("name", "armed", "prob", "remaining", "fired", "crash_at", "passes")
 
     def __init__(self, name: str):
         self.name = name
@@ -86,6 +96,8 @@ class FaultSite:
         self.prob = 0.0
         self.remaining = 0  # -1 = unlimited
         self.fired = 0
+        self.crash_at = 0  # CrashPoint mode: kill process at the n-th pass
+        self.passes = 0
 
     def fire(self) -> None:
         if not self.armed:
@@ -119,6 +131,18 @@ def _fire(s: FaultSite) -> None:
     with _lock:
         if not s.armed:  # lost the race with disarm()
             return
+        if s.crash_at:
+            # CrashPoint mode: the n-th pass through the site is a power
+            # cut — os._exit skips exception handlers, finally blocks,
+            # atexit and buffered flushes, which is exactly the point.
+            s.passes += 1
+            if s.passes >= s.crash_at:
+                try:
+                    os.write(2, f"kakveda crash point: {s.name} pass {s.passes}\n".encode())
+                except OSError:  # pragma: no cover - stderr gone
+                    pass
+                os._exit(137)
+            return  # passes below n fall through silently
         if s.prob < 1.0 and _rng.random() >= s.prob:
             return
         s.fired += 1
@@ -163,6 +187,8 @@ def arm(spec: str, seed: Optional[int] = None) -> None:
             s.armed = False
             s.prob = 0.0
             s.remaining = 0
+            s.crash_at = 0
+            s.passes = 0
         for name, prob, count in parsed:
             s = _sites.get(name)
             if s is None:
@@ -173,6 +199,42 @@ def arm(spec: str, seed: Optional[int] = None) -> None:
             s.fired = 0  # each arming is a fresh experiment
     if parsed:
         log.warning("fault sites armed: %s", ", ".join(p[0] for p in parsed))
+
+
+def arm_crash(spec: str) -> None:
+    """Arm crash points from a ``site:nth,…`` spec (``nth`` defaults to 1):
+    the n-th ``fire()`` at the site calls ``os._exit(137)``. Additive over
+    probabilistic arming on OTHER sites, but replaces any previous crash
+    arming; :func:`arm`/:func:`disarm` clear crash state like everything
+    else, so the standard teardown path can't leak a live trap."""
+    parsed = []
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        fields = part.split(":")
+        name = fields[0]
+        try:
+            nth = int(fields[1]) if len(fields) > 1 and fields[1] else 1
+        except ValueError as e:
+            raise ValueError(f"bad KAKVEDA_FAULTS_CRASH entry {part!r}: {e}") from e
+        parsed.append((name, max(1, nth)))
+    with _lock:
+        for s in _sites.values():
+            s.crash_at = 0
+            s.passes = 0
+        for name, nth in parsed:
+            s = _sites.get(name)
+            if s is None:
+                s = _sites[name] = FaultSite(name)
+            s.crash_at = nth
+            s.passes = 0
+            s.armed = True
+    if parsed:
+        log.warning(
+            "crash points armed: %s",
+            ", ".join(f"{name}@{nth}" for name, nth in parsed),
+        )
 
 
 def disarm() -> None:
@@ -287,6 +349,14 @@ if _env_spec:
 # import. This is how a SUBPROCESS (fleet replica under the storm bench /
 # traffic replayer) gets a mid-run outage window without an admin API: the
 # parent sets the env, the child arms and disarms itself on schedule.
+# Env crash points: KAKVEDA_FAULTS_CRASH=site:nth,… — the subprocess half
+# of the crash-point recovery sweep (index/crashsweep.py): the parent sets
+# the env, the child dies mid-write at the n-th pass, the parent certifies
+# the recovered store.
+_env_crash = os.environ.get("KAKVEDA_FAULTS_CRASH", "")
+if _env_crash:
+    arm_crash(_env_crash)
+
 _env_timeline = os.environ.get("KAKVEDA_FAULTS_TIMELINE", "")
 if _env_timeline:
     import json as _json

@@ -187,7 +187,10 @@ def setup(app: web.Application) -> None:
                 kept.append(line)
             path.write_text("\n".join(kept) + ("\n" if kept else ""), encoding="utf-8")
 
-        _purge_jsonl(plat.gfkb.failures_path)
+        # Admin-only, confirmed purge: a timestamped .bak was copied above
+        # and gfkb.reload() below replays the result — a crash mid-rewrite
+        # loses at most this purge, recoverable from the backup.
+        _purge_jsonl(plat.gfkb.failures_path)  # kakveda: allow[atomic-log-rewrite]
         _purge_jsonl(plat.health.health_path)
         # The patterns log is DELTA-append (each line carries only that
         # upsert's new members), so line filtering can't remove an app from
@@ -210,7 +213,9 @@ def setup(app: web.Application) -> None:
         rewritten = "\n".join(kept_lines) + ("\n" if kept_lines else "")
         await loop.run_in_executor(
             None,
-            lambda: plat.gfkb.patterns_path.write_text(rewritten, encoding="utf-8"),
+            # Same admin-purge exception as _purge_jsonl: .bak taken above,
+            # reload() replays below.
+            lambda: plat.gfkb.patterns_path.write_text(rewritten, encoding="utf-8"),  # kakveda: allow[atomic-log-rewrite]
         )
         for app_id in demo_apps:
             ctx.db.execute("DELETE FROM trace_runs WHERE app_id=?", (app_id,))

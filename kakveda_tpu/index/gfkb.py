@@ -125,6 +125,28 @@ class GFKB:
         self.applied_path = self.data_dir / "applied_events.jsonl"
         self._applied_events: "OrderedDict[str, bool]" = OrderedDict()
         self._applied_max = int(os.environ.get("KAKVEDA_GFKB_APPLIED_MAX", "65536"))
+        # Lifecycle side-log (docs/robustness.md § failure-memory
+        # lifecycle): row aging and duplicate collapse append
+        # {"op": "tomb"|"live", "id", "reason", "ts"} lines here instead
+        # of touching the record schema — a KAKVEDA_GFKB_COMPACT=0 store
+        # stays byte-identical to the pre-lifecycle format. Tombstoned
+        # slots keep their records, ids and (type, signature) keys: slot
+        # stability is load-bearing for dense id minting, replay
+        # latest-wins and replication cursors. They are filtered out of
+        # every match assembly host-side, zeroed on device (so they never
+        # consume top-k candidates), fence replicated re-inserts (2xx
+        # drop), and resurrect in place on an ORGANIC upsert.
+        self.tombstones_path = self.data_dir / "tombstones.jsonl"
+        self._tombstoned: Dict[int, str] = {}  # slot -> reason
+        # Compaction posture: generation/ts live in the snapshot manifest's
+        # "compact" section; the age auto-trigger counts from process start
+        # when the store has never compacted.
+        self._opened_ts = time.time()
+        self._last_compact_ts = 0.0
+        self._compact_generation = 0
+        self._compact_inflight = False
+        self._compact_bytes = int(os.environ.get("KAKVEDA_GFKB_COMPACT_BYTES", "0"))
+        self._compact_age_s = float(os.environ.get("KAKVEDA_GFKB_COMPACT_AGE_S", "0"))
 
         self.mesh = mesh if mesh is not None else create_mesh("data:-1")
         self.featurizer = featurizer or HashedNGramFeaturizer(dim=dim)
@@ -148,7 +170,12 @@ class GFKB:
         # serialize cost O(N²) over a failure stream. Full-record lines from
         # older logs replay identically (union of growing prefixes).
         self._pattern_state: Dict[str, dict] = {}  # name -> mutable state
-        self._snapshot_write_lock = sanitize.named_lock("GFKB._snapshot_write_lock")
+        # Reentrant: compact() snapshots and swaps the log under ONE
+        # critical section (a snapshot racing in between would pin a log
+        # offset the swap is about to invalidate).
+        self._snapshot_write_lock = sanitize.named_lock(
+            "GFKB._snapshot_write_lock", kind="rlock"
+        )
         # Bumped by reload(); snapshot() aborts if it changed mid-write so a
         # purge (external log rewrite + reload) can't race a snapshot into
         # resurrecting pre-purge records.
@@ -183,6 +210,13 @@ class GFKB:
         self._fault_append = _faults.site("gfkb.append")
         self._fault_snapshot = _faults.site("gfkb.snapshot")
         self._fault_mine = _faults.site("gfkb.mine_state")
+        # Durable-write seams of the compaction fence + the tombstone
+        # append — the crash-point sweep (index/crashsweep.py) arms these
+        # one at a time and certifies recovery at every kill offset.
+        self._fault_compact_delta = _faults.site("gfkb.compact_delta")
+        self._fault_compact_fence = _faults.site("gfkb.compact_fence")
+        self._fault_compact_swap = _faults.site("gfkb.compact_swap")
+        self._fault_tombstone = _faults.site("gfkb.tombstone")
         # Device-loss drill site, SHARED with the device-health probe
         # (core/admission.py): armed, every match dispatch fails exactly
         # like a wedged backend — and the probe keeps failing until it is
@@ -214,10 +248,42 @@ class GFKB:
         _rep = _metrics.get_registry().counter(
             "kakveda_gfkb_replicate_apply_total",
             "Bus-replicated ingest events applied to this GFKB by outcome "
-            "(applied|dedup)", ("outcome",),
+            "(applied|dedup; fenced counts individual tombstoned ROWS "
+            "dropped by the lifecycle fence)", ("outcome",),
         )
         self._m_rep_applied = _rep.labels(outcome="applied")
         self._m_rep_dedup = _rep.labels(outcome="dedup")
+        self._m_rep_fenced = _rep.labels(outcome="fenced")
+        # Lifecycle metrics — children resolved here, BEFORE _replay():
+        # the startup applied-log compaction already counts into the
+        # shared kakveda_gfkb_compact_total family.
+        _reg0 = _metrics.get_registry()
+        _cmp = _reg0.counter(
+            "kakveda_gfkb_compact_total",
+            "Durable-log compactions by store and outcome (ok|skipped|"
+            "error|stale_tmp; stale_tmp = leftover temp file from a "
+            "crashed rewrite, removed before the next attempt)",
+            ("store", "outcome"),
+        )
+        self._m_compact = {
+            (st, oc): _cmp.labels(store=st, outcome=oc)
+            for st in ("failures", "applied", "tombstones")
+            for oc in ("ok", "skipped", "error", "stale_tmp")
+        }
+        _tmb = _reg0.counter(
+            "kakveda_gfkb_tombstone_total",
+            "Row lifecycle transitions by reason (aged = TTL demotion, "
+            "collapsed = near-duplicate fold, resurrected = organic "
+            "re-upsert of a tombstoned signature)",
+            ("reason",),
+        )
+        self._m_tombstone = {
+            r: _tmb.labels(reason=r) for r in ("aged", "collapsed", "resurrected")
+        }
+        self._g_tombstoned = _reg0.gauge(
+            "kakveda_gfkb_tombstoned_rows",
+            "Currently tombstoned (resident but never matched) GFKB rows",
+        )
 
         # Incremental mining state (KAKVEDA_MINE_INCREMENTAL=0 restores
         # the full-sweep-only behavior bit-for-bit: no state, no cache, no
@@ -437,6 +503,30 @@ class GFKB:
                     self._applied_note_locked(eid)
             self._compact_applied_log(n_lines)
 
+        if self.tombstones_path.exists():
+            # Lifecycle side-log: net tombstone state replays from byte 0
+            # (tiny — one op line per transition; compact() rewrites it to
+            # net state). Unknown ids skip-with-warning — the failures log
+            # can be independently rewritten (purge) or truncated.
+            for rec in self._iter_log_lines(self.tombstones_path, 0, json.loads):
+                if not isinstance(rec, dict):
+                    log.warning("non-object tombstone line skipped")
+                    continue
+                fid = rec.get("id")
+                slot = self._slot_by_id.get(fid) if isinstance(fid, str) else None
+                if slot is None:
+                    log.warning("tombstone line for unknown id %r skipped", fid)
+                    continue
+                if rec.get("op") == "tomb":
+                    self._tombstoned[slot] = str(rec.get("reason", "aged"))
+                else:
+                    self._tombstoned.pop(slot, None)
+            if self._tombstoned:
+                # The replay above re-embedded every row; re-zero the
+                # tombstoned ones so they never consume top-k candidates.
+                self._zero_device_rows_locked(sorted(self._tombstoned))
+            self._g_tombstoned.set(len(self._tombstoned))
+
     def _compact_applied_log(self, n_lines: int) -> None:
         """Rewrite ``applied_events.jsonl`` to the retained dedup tail.
 
@@ -449,25 +539,39 @@ class GFKB:
         the bounded set were unreplayable as dedup evidence anyway — their
         events re-apply as occurrence bumps, the documented FIFO contract.
         ``KAKVEDA_GFKB_APPLIED_COMPACT=0`` opts out (docs/scale-out.md)."""
-        if not self.persist or n_lines <= len(self._applied_events):
+        if not self.persist:
+            return
+        tmp = self.applied_path.with_suffix(".tmp")
+        if tmp.exists():
+            # A crash between the tmp write and os.replace strands the
+            # temp file — it is never valid input (the real log is still
+            # live), so remove it before any early return can leak it.
+            try:
+                tmp.unlink()
+                self._m_compact[("applied", "stale_tmp")].inc()
+            except OSError as e:
+                log.warning("stale %s could not be removed: %s", tmp, e)
+        if n_lines <= len(self._applied_events):
             return
         if os.environ.get("KAKVEDA_GFKB_APPLIED_COMPACT", "1") == "0":
+            self._m_compact[("applied", "skipped")].inc()
             return
         # A pending torn-tail truncation is handled by the rewrite itself
         # (only fully parsed ids survive), so drop the schedule.
         self._truncate_pending.pop(self.applied_path, None)
-        tmp = self.applied_path.with_suffix(".tmp")
         try:
             with tmp.open("w", encoding="utf-8") as f:
                 for eid in self._applied_events:
                     f.write(json.dumps({"id": eid}) + "\n")
             os.replace(tmp, self.applied_path)
+            self._m_compact[("applied", "ok")].inc()
             log.info(
                 "compacted %s: %d -> %d ids",
                 self.applied_path, n_lines, len(self._applied_events),
             )
         except OSError as e:  # disk trouble: keep the uncompacted log
             log.warning("applied-log compaction skipped: %s", e)
+            self._m_compact[("applied", "error")].inc()
             tmp.unlink(missing_ok=True)
 
     # --- snapshot / restore --------------------------------------------
@@ -633,6 +737,13 @@ class GFKB:
                     # Content checksum: restore verifies it and
                     # degrades to full replay on any mismatch.
                     "checksum": self._snapshot_checksum(tmp),
+                    # Compaction posture survives snapshot rewrites — the
+                    # generation fence (compact()) bumps it via its own
+                    # manifest rewrite.
+                    "compact": {
+                        "generation": self._compact_generation,
+                        "ts": self._last_compact_ts,
+                    },
                 }
                 if mine_labels is not None:
                     import hashlib
@@ -713,6 +824,9 @@ class GFKB:
                     "and replaying the full log", sd,
                 )
                 return 0
+            cm = manifest.get("compact") or {}
+            self._compact_generation = int(cm.get("generation", 0))
+            self._last_compact_ts = float(cm.get("ts", 0.0) or 0.0)
             n = int(manifest["n"])
             records = []
             with (sd / "records.jsonl").open("r", encoding="utf-8") as f:
@@ -898,6 +1012,7 @@ class GFKB:
             self._pattern_state = {}
             self._ids_by_type = {}
             self._apps_by_type = {}
+            self._tombstoned = {}
             # The rewrite replaced the files; any torn-tail truncation
             # scheduled against the OLD files must not fire on the new ones.
             self._truncate_pending = {}
@@ -1126,6 +1241,7 @@ class GFKB:
             slot = self._slot_by_key.get(key)
             now = utcnow()
             gen = self._generation
+            revived = False
             if slot is None:
                 created = True
                 rec = CanonicalFailureRecord(
@@ -1167,12 +1283,21 @@ class GFKB:
                 rec.resolution = resolution or rec.resolution
                 rec.context_signature = context_signature or rec.context_signature
                 self._records[slot] = rec
-                # Same signature text => identical embedding; no device write.
+                if slot in self._tombstoned:
+                    # Organic resurrection: the signature is live traffic
+                    # again. Durable "live" line, then re-embed below —
+                    # the device row was zeroed at tombstone time.
+                    self._resurrect_locked(slot, rec)
+                    tid = self._type_id(failure_type)
+                    revived = True
+                # Same signature text => identical embedding; an un-tombstoned
+                # update needs no device write.
+            need_embed = created or revived
             self._append_jsonl(self.failures_path, rec.model_dump(mode="json"))
             self._flush_logs()
-            if created:
+            if need_embed:
                 self._pending_embeds += 1
-        if created:
+        if need_embed:
             self._embed_new_slots([slot], [signature_text], [tid], gen)
         return rec, created
 
@@ -1210,7 +1335,9 @@ class GFKB:
         readiness-probe cadence that is noise next to a device match."""
         out: Dict[str, int] = {}
         with self._lock:
-            for rec in self._records:
+            for slot, rec in enumerate(self._records):
+                if slot in self._tombstoned:
+                    continue  # retired rows are not placement-relevant residency
                 k = self.shard_key_of(rec)
                 out[k] = out.get(k, 0) + 1
         return out
@@ -1226,9 +1353,15 @@ class GFKB:
         record's app span, and re-encode their signature on apply — the
         hashed-ngram featurizer is deterministic, so the receiver's vectors
         are identical to the source's. Slots only ever append (updates stay
-        in place), so a slot range IS a consistent delta cursor."""
+        in place), so a slot range IS a consistent delta cursor.
+        Tombstoned rows are excluded — a migration must not re-materialize
+        a row the lifecycle retired (the receiver would serve it)."""
         with self._lock:
-            recs = list(self._records[since:])
+            recs = [
+                r
+                for i, r in enumerate(self._records[since:], start=since)
+                if i not in self._tombstoned
+            ]
             count = len(self._records)
         rows = [
             {
@@ -1262,7 +1395,11 @@ class GFKB:
         # Ledger attribution: embed/scatter compiles and uploads land on
         # the ingest entry/phase.
         with _ledger.entry("ingest"), _ledger.phase("ingest"):
-            return self._upsert_failures_batch(items, event_id)
+            out = self._upsert_failures_batch(items, event_id)
+        # Size/age compaction trigger rides the ingest cadence (background
+        # thread — the batch never waits on a checkpoint write).
+        self._maybe_auto_compact()
+        return out
 
     def _upsert_failures_batch(
         self, items: Sequence[dict], event_id: Optional[str] = None
@@ -1315,6 +1452,14 @@ class GFKB:
                     new_tids.append(self._type_id(rec.failure_type))
                     out.append((rec, True))
                 else:
+                    if event_id is not None and slot in self._tombstoned:
+                        # Lifecycle fence: a replicated event (at-least-once
+                        # redelivery, DLQ replay) re-carrying a tombstoned
+                        # row drops it cleanly — same 2xx-drop shape as the
+                        # stale-epoch ownership fence (docs/scale-out.md).
+                        # Only an ORGANIC upsert resurrects.
+                        self._m_rep_fenced.inc()
+                        continue
                     old = self._records[slot]
                     rec = old.model_copy(deep=True)
                     rec.version += 1
@@ -1332,6 +1477,13 @@ class GFKB:
                     rec.resolution = item.get("resolution") or rec.resolution
                     rec.context_signature = item.get("context_signature") or rec.context_signature
                     self._records[slot] = rec
+                    if slot in self._tombstoned:
+                        # Organic resurrection: re-embed via the new-slot
+                        # scatter below (the device row was zeroed).
+                        self._resurrect_locked(slot, rec)
+                        new_slots.append(slot)
+                        new_texts.append(rec.signature_text)
+                        new_tids.append(self._type_id(rec.failure_type))
                     out.append((rec, False))
                 self._append_line(self.failures_path, rec.model_dump_json())
             if event_id is not None:
@@ -1626,6 +1778,408 @@ class GFKB:
             self._embeds_cv.wait(timeout=30.0)
 
     # ------------------------------------------------------------------
+    # lifecycle: row aging, duplicate collapse, log compaction
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def _fsync_dir(path: Path) -> None:
+        """fsync a directory so a just-completed rename is durable, not
+        merely ordered — best-effort (not every platform supports it)."""
+        try:
+            fd = os.open(path, os.O_RDONLY)
+            try:
+                os.fsync(fd)
+            finally:
+                os.close(fd)
+        except OSError:
+            pass
+
+    def _zero_device_rows_locked(self, slots) -> None:
+        """Overwrite device rows with zeros (pad-only sparse rows — the
+        scatter's SET semantics make that a clean row wipe) and un-type
+        them (tid -1 matches no real type id), so a tombstoned row can
+        neither score nor pass the type pre-filter. Warm/cold tier rows
+        stay in place: the warm inverted index keeps postings for
+        overwritten slots by design, so every host-path assembly filters
+        tombstoned slots explicitly instead. Caller holds ``_lock`` (or
+        is single-threaded init replay)."""
+        arr = np.asarray(sorted(int(s) for s in slots), np.int32)
+        arr = arr[arr < min(self._hot_cap(), self._knn.capacity)]
+        if not len(arr):
+            return
+        sp_idx = np.full((len(arr), 1), self._knn.dim, np.int32)
+        sp_val = np.zeros((len(arr), 1), np.float32)
+        self._emb, self._valid, self._types = self._knn.insert_sparse(
+            self._emb, self._valid, self._types,
+            sp_idx, sp_val, arr, np.full(len(arr), -1, np.int32),
+        )
+        self._publish()
+
+    def _tombstone_rows_locked(
+        self, slots, reason: str, now: Optional[float] = None
+    ) -> List[int]:
+        """Durable "tomb" op line first, then the state flip, per slot —
+        a crash between rows leaves every completed transition replayable
+        and the rest simply not taken. Returns the slots actually
+        tombstoned (already-tombstoned slots are skipped). Caller holds
+        ``_lock`` and zeroes the device rows afterwards."""
+        wrote: List[int] = []
+        ts = now if now is not None else time.time()
+        for slot in slots:
+            slot = int(slot)
+            if slot in self._tombstoned or not 0 <= slot < len(self._records):
+                continue
+            try:
+                self._fault_tombstone.fire()
+                self._append_jsonl(
+                    self.tombstones_path,
+                    {
+                        "op": "tomb",
+                        "id": self._records[slot].failure_id,
+                        "reason": reason,
+                        "ts": ts,
+                    },
+                )
+            except (OSError, _faults.FaultInjected) as e:
+                # Durable-before-visible: a transition that never hit disk
+                # never happened — the row STAYS LIVE and the pass stops
+                # (IO trouble is file-wide, not per-row). Aging/collapse
+                # report fewer rows; nothing is half-tombstoned.
+                log.warning(
+                    "tombstone write failed after %d rows (%s: %s)",
+                    len(wrote), type(e).__name__, e,
+                )
+                break
+            self._tombstoned[slot] = reason
+            self._m_tombstone[reason].inc()
+            wrote.append(slot)
+        if wrote:
+            self._flush_logs()
+            self._g_tombstoned.set(len(self._tombstoned))
+        return wrote
+
+    def _resurrect_locked(self, slot: int, rec: CanonicalFailureRecord) -> None:
+        """Organic upsert over a tombstoned slot brings it back: durable
+        "live" op line, state flip, metrics. Caller holds ``_lock`` and
+        re-embeds the slot (its device row was zeroed at tombstone
+        time)."""
+        self._append_jsonl(
+            self.tombstones_path,
+            {"op": "live", "id": rec.failure_id, "ts": time.time()},
+        )
+        self._tombstoned.pop(slot, None)
+        self._m_tombstone["resurrected"].inc()
+        self._g_tombstoned.set(len(self._tombstoned))
+
+    def age_rows(
+        self, ttl_s: Optional[float] = None, now: Optional[float] = None
+    ) -> dict:
+        """TTL demotion — the terminal hop of hot→warm→cold→tombstone:
+        retire every row whose last version write predates ``now - ttl_s``,
+        EXCEPT slots in the cold tier's promote-LRU (recently paged in by
+        live queries — touch evidence the record timestamps don't carry,
+        index/tiers.py ``recently_promoted_slots``). Tombstoning is
+        terminal-but-resident: slots, ids and keys stay stable (dense id
+        minting, replay latest-wins and replication cursors depend on
+        that); reclaiming LOG bytes is :meth:`compact`'s job. ``now`` is
+        injectable so the month-compressed aging scenario and the recovery
+        bench run without waiting out a real TTL."""
+        if ttl_s is None:
+            ttl_s = float(os.environ.get("KAKVEDA_GFKB_AGE_TTL_S", "0"))
+        if ttl_s <= 0:
+            return {"tombstoned": 0, "ttl_s": ttl_s}
+        ts = now if now is not None else time.time()
+        with self._lock:
+            exempt = (
+                self._tiers.recently_promoted_slots()
+                if self._tiers is not None
+                else set()
+            )
+            victims = [
+                slot
+                for slot, rec in enumerate(self._records)
+                if slot not in self._tombstoned
+                and slot not in exempt
+                and ts - rec.updated_at.timestamp() > ttl_s
+            ]
+            wrote = self._tombstone_rows_locked(victims, "aged", now=ts)
+            if wrote:
+                self._zero_device_rows_locked(wrote)
+        return {"tombstoned": len(wrote), "ttl_s": ttl_s, "exempt": len(exempt)}
+
+    def collapse_duplicates(self, min_cluster: Optional[int] = None) -> dict:
+        """Near-duplicate collapse over the incremental mining clusters:
+        every cluster with ≥ ``min_cluster`` live members keeps ONE
+        exemplar (the min live slot — the labels' own min-member
+        convention), folds the victims' occurrence counts and app spans
+        into it via a normal version-bump log line (replayable, no new
+        record shape), and tombstones the victims. Mining is derived
+        state: a stale or behind state means NO collapse this round —
+        never collapse on unverified labels."""
+        if min_cluster is None:
+            min_cluster = int(os.environ.get("KAKVEDA_GFKB_DUP_COLLAPSE", "0"))
+        out = {"collapsed": 0, "clusters": 0, "min_cluster": min_cluster}
+        if min_cluster <= 1:
+            return out
+        from kakveda_tpu.ops.incremental import collapse_groups
+
+        with self._lock:
+            m = self._mine
+            if m is None:
+                out["reason"] = "incremental mining disabled"
+                return out
+            self._mine_drain_locked()
+            if m.stale or m.n_rows != len(self._records):
+                out["reason"] = "mine state stale or behind"
+                return out
+            now = utcnow()
+            for exemplar, victims in collapse_groups(
+                m.labels(), min_cluster, exclude=self._tombstoned
+            ):
+                ex = self._records[exemplar].model_copy(deep=True)
+                ex.version += 1
+                ex.updated_at = now
+                for v in victims:
+                    vr = self._records[v]
+                    ex.occurrences += vr.occurrences
+                    for app in vr.affected_apps:
+                        if app not in ex.affected_apps:
+                            ex.affected_apps.append(app)
+                self._apps_by_type.setdefault(ex.failure_type, set()).update(
+                    ex.affected_apps
+                )
+                m.note_apps(exemplar, list(ex.affected_apps))
+                self._records[exemplar] = ex
+                self._append_line(self.failures_path, ex.model_dump_json())
+                wrote = self._tombstone_rows_locked(victims, "collapsed")
+                if wrote:
+                    self._zero_device_rows_locked(wrote)
+                out["collapsed"] += len(wrote)
+                out["clusters"] += 1
+            self._flush_logs()
+        return out
+
+    def compact(self) -> dict:
+        """Checkpoint+delta rewrite of the failures log.
+
+        Takes a fresh snapshot (the checkpoint), rewrites failures.jsonl
+        down to ONLY the bytes appended after it, and rewrites the
+        tombstone side-log to net state — restart replay then parses the
+        delta instead of the full version-append history. The swap is
+        FENCED by the snapshot manifest: the manifest (log_offset=0,
+        generation bump) swaps via temp+fsync+rename BEFORE the log does,
+        so a crash at ANY byte leaves a (manifest, log) pair that replays
+        to the pre- or post-compaction state, never a hybrid:
+
+          * before the manifest swap — the old manifest still covers the
+            old log at its recorded offset (pre-state);
+          * between the two swaps — offset 0 replays the FULL old log
+            over the snapshot; versioned upserts replay latest-wins in
+            place, converging to the same records (post-state);
+          * after the log swap — offset 0 replays exactly the delta
+            (post-state).
+
+        The patterns log is untouched (delta-append is already compact —
+        lines carry only new members). ``KAKVEDA_GFKB_COMPACT=0`` refuses
+        outright — the bit-for-bit append-only opt-out. A concurrent
+        reload aborts via the snapshot generation check. Auto-trigger:
+        ``KAKVEDA_GFKB_COMPACT_BYTES`` / ``KAKVEDA_GFKB_COMPACT_AGE_S``
+        (checked post-ingest-batch, default off)."""
+        if not self.persist:
+            raise SnapshotError("compaction requires a persistent GFKB (persist=True)")
+        if os.environ.get("KAKVEDA_GFKB_COMPACT", "1") == "0":
+            self._m_compact[("failures", "skipped")].inc()
+            return {"compacted": False, "reason": "KAKVEDA_GFKB_COMPACT=0"}
+        stale = self.failures_path.with_suffix(".compact-tmp")
+        if stale.exists():
+            # A crash between the delta write and the log swap strands the
+            # temp file; it is never valid input (whichever log is live at
+            # failures.jsonl wins) — remove it before this attempt.
+            try:
+                stale.unlink()
+                self._m_compact[("failures", "stale_tmp")].inc()
+            except OSError as e:
+                log.warning("stale %s could not be removed: %s", stale, e)
+        with self._snapshot_write_lock:
+            try:
+                self.snapshot()
+                out = self._compact_swap_locked()
+            except SnapshotError:
+                self._m_compact[("failures", "skipped")].inc()
+                raise
+            except (OSError, _faults.FaultInjected) as e:
+                self._m_compact[("failures", "error")].inc()
+                log.error("failures-log compaction failed: %s", e)
+                raise
+        self._m_compact[("failures", "ok")].inc()
+        log.info(
+            "compacted %s: %d -> %d bytes (generation %d)",
+            self.failures_path, out["bytes_before"], out["bytes_after"],
+            out["generation"],
+        )
+        return out
+
+    def _compact_swap_locked(self) -> dict:
+        """The fenced swap — caller holds the snapshot-write lock with the
+        just-written snapshot installed; takes ``_lock`` for the swap so
+        no append lands between the tail read and the log replace. Every
+        file move is temp+fsync+rename inside data_dir."""
+        with self._lock:
+            sd = self._snapshot_dir()
+            manifest_path = sd / "manifest.json"
+            manifest = json.loads(manifest_path.read_text())
+            offset = int(manifest.get("log_offset", 0))
+            size = (
+                self.failures_path.stat().st_size
+                if self.failures_path.exists()
+                else 0
+            )
+            with self.failures_path.open("rb") as f:
+                f.seek(offset)
+                tail = f.read()
+            # A torn final line the last replay tolerated must not survive
+            # into the new log (truncation is the contract, never leniency).
+            pend = self._truncate_pending.get(self.failures_path)
+            if pend is not None and pend >= offset:
+                tail = tail[: pend - offset]
+            tmp = self.failures_path.with_suffix(".compact-tmp")
+            with tmp.open("wb") as f:
+                f.write(tail)
+                f.flush()
+                os.fsync(f.fileno())
+            self._fault_compact_delta.fire()
+            gen = self._compact_generation + 1
+            manifest["log_offset"] = 0
+            manifest["log_hash"] = ""
+            manifest["compact"] = {"generation": gen, "ts": time.time()}
+            mtmp = sd / "manifest.json.tmp"
+            with mtmp.open("w", encoding="utf-8") as f:
+                f.write(json.dumps(manifest))
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(mtmp, manifest_path)
+            self._fsync_dir(sd)
+            # THE FENCE: from here, replay starts at byte 0 of whichever
+            # file is live at failures.jsonl — the full old log (latest-
+            # wins convergence) or the delta below; both reach post-state.
+            self._fault_compact_fence.fire()
+            os.replace(tmp, self.failures_path)
+            self._fsync_dir(self.data_dir)
+            self._fault_compact_swap.fire()
+            # The append handle points at the replaced inode — reopen; and
+            # a torn-tail truncation scheduled against the old file must
+            # not fire on the new one (the rewrite dropped the torn bytes).
+            self._close_locked()
+            self._truncate_pending.pop(self.failures_path, None)
+            self._compact_generation = gen
+            self._last_compact_ts = time.time()
+            n_tomb = self._compact_tombstones_locked()
+        return {
+            "compacted": True,
+            "generation": gen,
+            "bytes_before": size,
+            "bytes_after": len(tail),
+            "checkpoint_rows": int(manifest.get("n", 0)),
+            "tombstone_lines": n_tomb,
+        }
+
+    def _compact_tombstones_locked(self) -> int:
+        """Rewrite the tombstone side-log to net state (one "tomb" line
+        per currently tombstoned slot) through the same temp+fsync+rename
+        seam. A crash mid-rewrite keeps the old log, which replays to the
+        same net state. Returns the lines written."""
+        if not self._tombstoned and not self.tombstones_path.exists():
+            return 0
+        lg = self._logs.pop(self.tombstones_path, None)
+        if lg is not None:
+            lg.close()
+        tmp = self.tombstones_path.with_suffix(".compact-tmp")
+        try:
+            with tmp.open("w", encoding="utf-8") as f:
+                for slot in sorted(self._tombstoned):
+                    f.write(
+                        json.dumps(
+                            {
+                                "op": "tomb",
+                                "id": self._records[slot].failure_id,
+                                "reason": self._tombstoned[slot],
+                                "ts": self._last_compact_ts,
+                            }
+                        )
+                        + "\n"
+                    )
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.tombstones_path)
+            self._truncate_pending.pop(self.tombstones_path, None)
+            self._m_compact[("tombstones", "ok")].inc()
+        except OSError as e:
+            log.warning("tombstone-log compaction skipped: %s", e)
+            tmp.unlink(missing_ok=True)
+            self._m_compact[("tombstones", "error")].inc()
+        return len(self._tombstoned)
+
+    def _maybe_auto_compact(self) -> None:
+        """Size/age compaction trigger (KAKVEDA_GFKB_COMPACT_BYTES /
+        _AGE_S, 0 = off), checked after each ingest batch. The compaction
+        runs on a daemon thread — ingest never waits on a checkpoint
+        write; one inflight flag keeps it single-flight."""
+        if not self.persist or self._compact_inflight:
+            return
+        if self._compact_bytes <= 0 and self._compact_age_s <= 0:
+            return
+        if os.environ.get("KAKVEDA_GFKB_COMPACT", "1") == "0":
+            return
+        try:
+            size = self.failures_path.stat().st_size
+        except OSError:
+            return
+        due = self._compact_bytes > 0 and size >= self._compact_bytes
+        if not due and self._compact_age_s > 0 and size > 0:
+            last = self._last_compact_ts or self._opened_ts
+            due = (time.time() - last) >= self._compact_age_s
+        if not due:
+            return
+        with self._lock:
+            if self._compact_inflight:
+                return
+            self._compact_inflight = True
+
+        def _run() -> None:
+            try:
+                self.compact()
+            except Exception as e:  # noqa: BLE001 — never fail/abort ingest
+                log.warning("auto-compaction failed (%s: %s)", type(e).__name__, e)
+            finally:
+                self._compact_inflight = False
+
+        threading.Thread(
+            target=_run, name="kakveda-gfkb-compact", daemon=True
+        ).start()
+
+    def lifecycle_info(self) -> dict:
+        """Durability/lifecycle posture (cli status, tests): tombstone
+        counts by reason, compaction generation/timestamp, current
+        failures-log byte size."""
+        with self._lock:
+            by_reason: Dict[str, int] = {}
+            for r in self._tombstoned.values():
+                by_reason[r] = by_reason.get(r, 0) + 1
+            size = 0
+            if self.persist:
+                try:
+                    size = self.failures_path.stat().st_size
+                except OSError:
+                    size = 0
+            return {
+                "tombstoned": len(self._tombstoned),
+                "by_reason": by_reason,
+                "compact_generation": self._compact_generation,
+                "last_compact_ts": self._last_compact_ts,
+                "failures_log_bytes": size,
+            }
+
+    # ------------------------------------------------------------------
     # host tiers (degraded mode, overflow, restore — one hierarchy)
     # ------------------------------------------------------------------
 
@@ -1680,6 +2234,7 @@ class GFKB:
         q_idx, q_val = self.featurizer.encode_batch_sparse(list(signature_texts))
         with self._lock:
             records = list(self._records)
+            tomb = set(self._tombstoned)
         n = len(records)
         if n == 0:
             return [[] for _ in signature_texts], {"tier": "warm", "nprobe": None}
@@ -1693,8 +2248,8 @@ class GFKB:
             routed = routed or mode == "routed"
             row: List[FailureMatch] = []
             for s, slot in zip(scores.tolist(), slots.tolist()):
-                if s <= 0.0 or slot >= n:
-                    continue
+                if s <= 0.0 or slot >= n or slot in tomb:
+                    continue  # padding / tombstoned rows never surface
                 rec = records[slot]
                 if failure_type and rec.failure_type != failure_type:
                     continue
@@ -1791,6 +2346,11 @@ class GFKB:
 
         with self._lock:
             knn, emb, valid, types, records = self._view
+            # Tombstone filter set: device rows are zeroed (score 0, never
+            # outrank a real match) but can still occupy candidate
+            # positions — the assembly drop below is what guarantees a
+            # retired row never surfaces in a verdict.
+            tomb = set(self._tombstoned) if self._tombstoned else ()
             n = len(records)
             if n == 0:
                 return [[] for _ in signature_texts], {"tier": "hot", "nprobe": None}
@@ -1860,8 +2420,8 @@ class GFKB:
         for i in range(b):
             row: List[FailureMatch] = []
             for s, slot in zip(scores[i], slots[i]):
-                if s <= -1.0 or slot >= n:
-                    continue  # padding / invalid rows
+                if s <= -1.0 or slot >= n or int(slot) in tomb:
+                    continue  # padding / invalid / tombstoned rows
                 rec = records[int(slot)]
                 if failure_type and rec.failure_type != failure_type:
                     continue

@@ -1471,6 +1471,17 @@ class TieredIndex:
 
     # -- lifecycle --------------------------------------------------------
 
+    def recently_promoted_slots(self) -> set:
+        """Global slots currently in the cold tier's promote-LRU — rows a
+        recent query paged in from disk. This is the tiers' touch evidence:
+        row aging (GFKB.age_rows) exempts these slots, because a record's
+        ``updated_at`` only moves on WRITES and a cold row that live queries
+        keep paging in is working set, whatever its timestamp says."""
+        with self.lock:
+            if self.cold is None:
+                return set()
+            return set(self.cold.promoted.keys())
+
     def reset(self) -> None:
         """Drop everything (GFKB.reload — the append log was rewritten;
         cold shards describe pre-rewrite slots and must go with it)."""

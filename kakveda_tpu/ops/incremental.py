@@ -60,7 +60,36 @@ __all__ = [
     "delta_topk_dense",
     "unpack_topk",
     "centroids_from_sparse",
+    "collapse_groups",
 ]
+
+
+def collapse_groups(
+    labels, min_size: int, exclude: Iterable[int] = ()
+) -> List[Tuple[int, List[int]]]:
+    """Turn a label partition into duplicate-collapse work items.
+
+    Groups slots by cluster label, drops excluded members (already
+    tombstoned), and returns ``(exemplar, victims)`` pairs for every
+    cluster with at least ``min_size`` LIVE members — the GFKB keeps the
+    exemplar, folds the victims' occurrence counts into it and tombstones
+    them (index/gfkb.py ``collapse_duplicates``). The exemplar is the
+    smallest live slot, matching the min-member label convention (and the
+    oldest record — stable across repeated collapse rounds). Pure numpy
+    grouping; deterministic in label order."""
+    excluded = set(int(s) for s in exclude)
+    groups: Dict[int, List[int]] = {}
+    for slot, lab in enumerate(np.asarray(labels).tolist()):
+        if slot in excluded:
+            continue
+        groups.setdefault(int(lab), []).append(slot)
+    out: List[Tuple[int, List[int]]] = []
+    for lab in sorted(groups):
+        members = groups[lab]  # appended in slot order → members[0] is min
+        if len(members) < max(2, min_size):
+            continue
+        out.append((members[0], members[1:]))
+    return out
 
 
 def centroids_from_sparse(labels, rows_fn, dim: int, chunk: int = 1 << 14):

@@ -510,6 +510,55 @@ def flash_crowd(seed: int = 0, *, baseline_s: float = 8.0,
     )
 
 
+def aging(seed: int = 0, *, duration_s: float = 8.0,
+          virtual_days: float = 28.0, cohorts: int = 4,
+          warn_rps: float = 20.0, ingest_rps: float = 4.0,
+          age_ttl_virtual_days: float = 14.0) -> Scenario:
+    """A month of failure memory compressed into ``duration_s``: app
+    cohorts arrive in weekly waves — cohort k ingests (and warns) only
+    during its own week, then goes quiet forever. By the end of the run
+    the oldest cohorts are past any ``age_ttl_virtual_days`` TTL while the
+    young ones are fresh, which is exactly the differential the lifecycle
+    tier must honor: aged cohorts tombstone, live cohorts keep answering,
+    and resident/log bytes stay bound instead of growing with history.
+
+    Pure in (seed, knobs) like every scenario — virtual time derives from
+    the scheduled arrival offset (``t / compression``), never wall clock.
+    ``notes`` carry the compression factor and TTL so a consumer (the
+    recovery bench row, a replay harness) can convert run time to virtual
+    seconds and drive ``GFKB.age_rows(ttl_s=…, now=…)`` with an injected
+    clock instead of waiting out real weeks."""
+    rng = random.Random(seed)
+    cohorts = max(1, cohorts)
+    compression = (virtual_days * 86400.0) / duration_s
+    week = duration_s / cohorts
+    events = []
+    for c in range(cohorts):
+        lo, hi = c * week, (c + 1) * week
+        in_week = lambda t: warn_rps / cohorts if lo <= t < hi else 0.0  # noqa: E731
+        for i, t in enumerate(_arrivals(rng, duration_s, in_week)):
+            events.append(_warn_event(t, f"app-c{c}-{i % 3}", i, f"week{c}"))
+        for j, t in enumerate(_arrivals(rng, duration_s,
+                                        lambda t: ingest_rps / cohorts
+                                        if lo <= t < hi else 0.0)):
+            app = f"app-c{c}-{j % 3}"
+            events.append({
+                "t": t, "method": "POST", "path": "/ingest/batch",
+                "klass": "ingest", "app_id": app, "phase": f"week{c}",
+                "body": {"traces": synth_traces(seed * 7919 + c * 97 + j,
+                                                app, 6)},
+            })
+    events.sort(key=lambda e: e["t"])
+    return Scenario(
+        name="aging", seed=seed, duration_s=duration_s, events=events,
+        slo=SLO(shed_only=("interactive", "background"), zero_lost=("warn",)),
+        notes={"compression": compression,
+               "virtual_days": virtual_days,
+               "cohorts": float(cohorts),
+               "age_ttl_virtual_s": age_ttl_virtual_days * 86400.0},
+    )
+
+
 SCENARIOS: Dict[str, Callable[..., Scenario]] = {
     "diurnal": diurnal_wave,
     "hot_key": hot_key_skew,
@@ -519,6 +568,7 @@ SCENARIOS: Dict[str, Callable[..., Scenario]] = {
     "storm": storm,
     "rebalance_storm": rebalance_storm,
     "flash_crowd": flash_crowd,
+    "aging": aging,
 }
 
 

@@ -20,6 +20,10 @@
 #   5. the autoscaler policy selftest: the canned decision table over the
 #      PURE decide/commit functions (fleet/autoscaler.py) — no processes,
 #      no router, ~1 s; a hysteresis/backoff regression fails pre-commit.
+#   6. a compaction smoke: a tiny GFKB takes rows + occurrence bumps,
+#      compacts (checkpoint+delta fence), reopens, and must serve the
+#      identical top-1 match with the manifest generation advanced —
+#      the failure-memory lifecycle's restart contract in ~1 s on CPU.
 #
 # Exit: non-zero on the first failing stage. Tier-1 runs this via
 # tests/test_verify_static.py, so CI and the pre-commit habit share one
@@ -117,6 +121,44 @@ from kakveda_tpu.fleet.autoscaler import policy_selftest
 
 n = policy_selftest()
 print(f"policy selftest: ok — {n} checks")
+EOF
+
+echo "== compaction smoke =="
+python - <<'EOF'
+import jax
+
+jax.config.update("jax_platforms", "cpu")  # never touch the remote TPU
+import json
+import tempfile
+from pathlib import Path
+
+from kakveda_tpu.index.gfkb import GFKB
+
+data = Path(tempfile.mkdtemp(prefix="kakveda-compact-smoke-"))
+kb = GFKB(data_dir=data, capacity=64, dim=256)
+rows = [
+    {"failure_type": "oom", "signature_text": f"compact smoke sig {i}",
+     "app_id": f"a{i % 3}", "impact_severity": "high"}
+    for i in range(24)
+]
+kb.upsert_failures_batch(rows)
+kb.upsert_failures_batch(rows[:12])  # occurrence bumps = delta history
+before = kb.match_batch(["compact smoke sig 7"])[0]
+assert before, "no match before compaction"
+out = kb.compact()
+assert out["compacted"], out
+kb.close()
+
+kb2 = GFKB(data_dir=data, capacity=64, dim=256)
+after = kb2.match_batch(["compact smoke sig 7"])[0]
+assert after and after[0].failure_id == before[0].failure_id, (before, after)
+assert abs(after[0].score - before[0].score) < 1e-5, (before, after)
+man = json.loads((data / "snapshot" / "manifest.json").read_text())
+assert man["compact"]["generation"] == out["generation"], man
+assert man["log_offset"] == 0, man
+kb2.close()
+print(f"compaction smoke: ok — gen {out['generation']}, "
+      f"{out['checkpoint_rows']} rows checkpointed, top-1 parity held")
 EOF
 
 echo "verify_static: all stages green"

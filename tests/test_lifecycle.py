@@ -1,0 +1,345 @@
+"""Failure-memory lifecycle (ISSUE 18): checkpoint+delta compaction,
+row aging/tombstones, duplicate collapse, the replication fence, and the
+crash-point recovery certification (docs/robustness.md § failure-memory
+lifecycle).
+
+The contracts under test:
+  * compact() swaps behind a manifest fence — reopen serves identical
+    matches, `KAKVEDA_GFKB_COMPACT=0` is bit-for-bit append-only;
+  * the crash-safe replay contracts (ONE torn final line tolerated,
+    mid-file corruption raises) hold unchanged on a compacted log;
+  * tombstones are durable-before-visible, survive restart, fence
+    replicated/DLQ-replayed events, and only ORGANIC upserts resurrect;
+  * the crash sweep certifies every kill offset recovers to a legal
+    pre/mid/post state (chaos-marked, subprocess kills).
+"""
+
+import json
+import time
+
+import pytest
+
+from kakveda_tpu.core import faults
+from kakveda_tpu.core.schemas import Severity
+from kakveda_tpu.index.gfkb import GFKB
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    faults.disarm()
+    yield
+    faults.disarm()
+
+
+def _mk(tmp_path, **kw):
+    kw.setdefault("capacity", 64)
+    kw.setdefault("dim", 256)
+    return GFKB(data_dir=tmp_path / "data", **kw)
+
+
+def _sig(i):
+    return f"lifecycle test failure signature {i} worker shard {i % 5}"
+
+
+def _seed(kb, n, apps=3):
+    kb.upsert_failures_batch([
+        {"failure_type": "oom" if i % 2 else "timeout",
+         "signature_text": _sig(i), "app_id": f"app-{i % apps}",
+         "impact_severity": Severity.high}
+        for i in range(n)
+    ])
+
+
+# ---------------------------------------------------------------------------
+# compaction
+# ---------------------------------------------------------------------------
+
+
+def test_compact_roundtrip_parity(tmp_path):
+    kb = _mk(tmp_path)
+    _seed(kb, 12)
+    _seed(kb, 12)  # occurrence bumps: version-append history to fold
+    before = kb.match_batch([_sig(3), _sig(8)])
+    recs_before = [(r.failure_id, r.version, r.occurrences) for r in kb._records]
+    bytes_before = (tmp_path / "data" / "failures.jsonl").stat().st_size
+
+    out = kb.compact()
+    assert out["compacted"] and out["generation"] == 1
+    assert out["checkpoint_rows"] == 12
+    assert out["bytes_after"] < bytes_before
+    kb.close()
+
+    kb2 = _mk(tmp_path)
+    assert [(r.failure_id, r.version, r.occurrences) for r in kb2._records] == recs_before
+    after = kb2.match_batch([_sig(3), _sig(8)])
+    for a, b in zip(before, after):
+        assert a and b and a[0].failure_id == b[0].failure_id
+        assert abs(a[0].score - b[0].score) < 1e-5
+    assert kb2.lifecycle_info()["compact_generation"] == 1
+    # delta appends land AFTER the checkpoint and survive another restart
+    _seed(kb2, 13)
+    kb2.close()
+    kb3 = _mk(tmp_path)
+    assert len(kb3._records) == 13
+    kb3.close()
+
+
+def test_compact_optout_is_bit_for_bit(tmp_path, monkeypatch):
+    kb = _mk(tmp_path)
+    _seed(kb, 6)
+    _seed(kb, 6)
+    log = tmp_path / "data" / "failures.jsonl"
+    raw = log.read_bytes()
+    monkeypatch.setenv("KAKVEDA_GFKB_COMPACT", "0")
+    out = kb.compact()
+    assert out["compacted"] is False and "KAKVEDA_GFKB_COMPACT=0" in out["reason"]
+    assert log.read_bytes() == raw  # untouched, byte for byte
+    kb.close()
+
+
+def test_torn_tail_contract_survives_compaction(tmp_path):
+    """Post-compaction, the log is checkpoint+delta — the torn-FINAL-line
+    tolerance (warn + truncate-on-next-append) must hold on the delta."""
+    kb = _mk(tmp_path)
+    _seed(kb, 4)
+    assert kb.compact()["compacted"]
+    _seed(kb, 5)  # one delta line past the checkpoint
+    kb.close()
+
+    log = tmp_path / "data" / "failures.jsonl"
+    with log.open("ab") as f:
+        f.write(b'{"failure_type": "torn", "signa')
+
+    kb2 = _mk(tmp_path)  # warns, does not raise
+    assert len(kb2._records) == 5
+    _seed(kb2, 6)  # next append truncates the torn bytes first
+    kb2.close()
+    for line in log.read_text().splitlines():
+        json.loads(line)
+    kb3 = _mk(tmp_path)
+    assert len(kb3._records) == 6
+    kb3.close()
+
+
+def test_midfile_corruption_in_delta_still_raises(tmp_path):
+    kb = _mk(tmp_path)
+    _seed(kb, 3)
+    assert kb.compact()["compacted"]
+    _seed(kb, 5)  # two delta lines
+    kb.close()
+    log = tmp_path / "data" / "failures.jsonl"
+    lines = log.read_text().splitlines()
+    assert len(lines) >= 2
+    lines.insert(1, '{"torn": "mid-file')
+    log.write_text("\n".join(lines) + "\n")
+    with pytest.raises(ValueError, match="mid-file"):
+        _mk(tmp_path)
+
+
+def test_auto_compact_trigger(tmp_path, monkeypatch):
+    monkeypatch.setenv("KAKVEDA_GFKB_COMPACT_BYTES", "1")
+    kb = _mk(tmp_path)
+    _seed(kb, 8)  # post-batch check sees size >= 1 byte -> background compact
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        if kb.lifecycle_info()["compact_generation"] >= 1:
+            break
+        time.sleep(0.05)
+    assert kb.lifecycle_info()["compact_generation"] >= 1
+    # compacted store still serves and still accepts appends
+    assert kb.match_batch([_sig(2)])[0]
+    _seed(kb, 9)
+    kb.close()
+
+
+def test_applied_log_stale_tmp_removed_at_startup(tmp_path):
+    kb = _mk(tmp_path)
+    row = {"failure_type": "oom", "signature_text": _sig(0),
+           "app_id": "app-peer", "impact_severity": "high"}
+    kb.apply_replication([row], event_id="evt-stale-tmp")
+    kb.close()
+    # crash window: tmp written, os.replace never ran — the old log is live
+    stale = tmp_path / "data" / "applied_events.tmp"
+    stale.write_text('{"id": "half-written')
+    kb2 = _mk(tmp_path)  # startup compaction removes the stranded temp
+    assert not stale.exists()
+    # and the dedup evidence from the REAL log still fences the event
+    assert kb2.apply_replication([row], event_id="evt-stale-tmp") == 0
+    kb2.close()
+
+
+# ---------------------------------------------------------------------------
+# aging, resurrection, collapse
+# ---------------------------------------------------------------------------
+
+
+def test_aging_tombstones_and_organic_resurrection_across_restart(tmp_path):
+    kb = _mk(tmp_path)
+    _seed(kb, 6)
+    future = time.time() + 10_000
+    out = kb.age_rows(ttl_s=100, now=future)
+    assert out["tombstoned"] == 6
+    info = kb.lifecycle_info()
+    assert info["tombstoned"] == 6 and info["by_reason"] == {"aged": 6}
+    # tombstoned rows never match …
+    assert all(
+        not m or m[0].score < 0.5 for m in kb.match_batch([_sig(0), _sig(1)])
+    )
+    # … and never ship to shard peers
+    rows, _ = kb.export_rows()
+    assert rows == []
+    kb.close()
+
+    kb2 = _mk(tmp_path)  # tombstones replay across restart
+    assert kb2.lifecycle_info()["tombstoned"] == 6
+    # ORGANIC upsert resurrects with history intact
+    rec, created = kb2.upsert_failure(
+        failure_type="oom", signature_text=_sig(1), app_id="app-new",
+        impact_severity=Severity.high,
+    )
+    assert not created and rec.occurrences == 2
+    assert kb2.lifecycle_info()["tombstoned"] == 5
+    m = kb2.match_batch([_sig(1)])[0]
+    assert m and m[0].failure_id == rec.failure_id and m[0].score > 0.9
+    kb2.close()
+
+    kb3 = _mk(tmp_path)  # the "live" op line replays too
+    assert kb3.lifecycle_info()["tombstoned"] == 5
+    assert kb3.match_batch([_sig(1)])[0][0].failure_id == rec.failure_id
+    kb3.close()
+
+
+def test_collapse_duplicates_folds_cluster_into_exemplar(tmp_path):
+    kb = _mk(tmp_path, dim=1024)
+    family = [
+        ("timeout", f"timeout while calling payments api attempt {i}", f"app-{i}")
+        for i in range(3)
+    ]
+    for ftype, sig, app in family:
+        kb.upsert_failure(
+            failure_type=ftype, signature_text=sig, app_id=app,
+            impact_severity=Severity.medium,
+        )
+    kb.upsert_failure(
+        failure_type="schema", signature_text="totally different shape xyz",
+        app_id="app-solo", impact_severity=Severity.medium,
+    )
+    out = kb.collapse_duplicates(min_cluster=3)
+    assert out["clusters"] == 1 and out["collapsed"] == 2
+    info = kb.lifecycle_info()
+    assert info["by_reason"] == {"collapsed": 2}
+    # exemplar carries the folded history; victims stopped matching
+    ex = kb._records[0]
+    assert ex.occurrences == 3
+    assert set(ex.affected_apps) == {"app-0", "app-1", "app-2"}
+    m = kb.match_batch(["timeout while calling payments api attempt 2"])[0]
+    assert m and m[0].failure_id == ex.failure_id
+    # the singleton is untouched
+    assert kb.match_batch(["totally different shape xyz"])[0][0].score > 0.9
+    kb.close()
+    kb2 = _mk(tmp_path, dim=1024)  # fold + tombstones replay
+    assert kb2._records[0].occurrences == 3
+    assert kb2.lifecycle_info()["tombstoned"] == 2
+    kb2.close()
+
+
+def test_collapse_refuses_on_stale_mine_state(tmp_path, monkeypatch):
+    monkeypatch.setenv("KAKVEDA_MINE_INCREMENTAL", "0")
+    kb = _mk(tmp_path)
+    _seed(kb, 4)
+    out = kb.collapse_duplicates(min_cluster=2)
+    assert out["collapsed"] == 0 and "reason" in out
+    kb.close()
+
+
+# ---------------------------------------------------------------------------
+# replication fence
+# ---------------------------------------------------------------------------
+
+
+def test_replicated_event_never_resurrects_tombstoned_row(tmp_path):
+    kb = _mk(tmp_path)
+    _seed(kb, 3)
+    kb.age_rows(ttl_s=100, now=time.time() + 10_000)
+    assert kb.lifecycle_info()["tombstoned"] == 3
+    row = {
+        "failure_type": "timeout", "signature_text": _sig(0),
+        "app_id": "app-peer", "impact_severity": "high",
+    }
+    # DLQ-replayed shape: replicated event id -> fenced, clean no-op
+    kb.apply_replication([row], event_id="evt-dlq-1")
+    assert kb.lifecycle_info()["tombstoned"] == 3
+    assert kb._records[0].occurrences == 1  # no bump through the fence
+    kb.close()
+
+    kb2 = _mk(tmp_path)  # fence state survives restart
+    kb2.apply_replication([row], event_id="evt-dlq-2")
+    assert kb2.lifecycle_info()["tombstoned"] == 3
+    assert kb2._records[0].occurrences == 1
+    # organic traffic (no event id) DOES resurrect
+    rec, _ = kb2.upsert_failure(
+        failure_type="timeout", signature_text=_sig(0), app_id="app-peer",
+        impact_severity=Severity.high,
+    )
+    assert rec.occurrences == 2
+    assert kb2.lifecycle_info()["tombstoned"] == 2
+    kb2.close()
+
+
+# ---------------------------------------------------------------------------
+# chaos: fault sites + the crash-point sweep
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_tombstone_write_fault_leaves_rows_live(tmp_path):
+    """gfkb.tombstone contract: the transition that never hit disk never
+    happened — the faulted row (and the rest of the pass) stays LIVE,
+    age_rows reports fewer rows, nothing raises."""
+    kb = _mk(tmp_path)
+    _seed(kb, 4)
+    faults.arm("gfkb.tombstone:1.0:1")
+    out = kb.age_rows(ttl_s=100, now=time.time() + 10_000)
+    assert out["tombstoned"] == 0  # first write faulted -> pass stopped
+    assert kb.lifecycle_info()["tombstoned"] == 0
+    faults.disarm()
+    assert kb.age_rows(ttl_s=100, now=time.time() + 10_000)["tombstoned"] == 4
+    kb.close()
+
+
+@pytest.mark.chaos
+def test_compact_fault_keeps_old_log_live(tmp_path):
+    """A fault while writing the compacted delta aborts the swap with the
+    old (manifest, log) pair fully live — replay is unaffected."""
+    kb = _mk(tmp_path)
+    _seed(kb, 5)
+    log = tmp_path / "data" / "failures.jsonl"
+    raw = log.read_bytes()
+    faults.arm("gfkb.compact_delta:1.0:1")
+    with pytest.raises(Exception):
+        kb.compact()
+    faults.disarm()
+    assert log.read_bytes() == raw
+    assert kb.lifecycle_info()["compact_generation"] == 0
+    kb.close()
+    kb2 = _mk(tmp_path)
+    assert len(kb2._records) == 5
+    assert kb2.compact()["compacted"]  # next attempt succeeds cleanly
+    kb2.close()
+
+
+@pytest.mark.chaos
+def test_crash_sweep_certifies_compaction_windows():
+    """Subprocess kill at each compaction fence boundary; the recovered
+    store must equal a legal pre/mid/post oracle with top-1 parity. The
+    full site list runs in the `recovery` bench row — this keeps the
+    tier-1 cost to the two fence-critical windows."""
+    from kakveda_tpu.index.crashsweep import run_sweep
+
+    out = run_sweep(
+        rows=6, aged=3,
+        sites=("gfkb.compact_fence", "gfkb.compact_swap"),
+    )
+    assert out["corrupt_recoveries"] == 0, out["failures"]
+    assert out["kill_points"] >= 2
+    assert out["stable_queries"]
