@@ -2749,6 +2749,160 @@ def _bench_storm(backend: str) -> dict:
     }
 
 
+def _bench_tenants(backend: str) -> dict:
+    """Noisy-neighbor tenant-isolation drill (docs/robustness.md §
+    multi-tenancy): replay the seeded `noisy_neighbor` scenario — victim
+    apps warm up alone, then ONE flooder opens up at ~10x the warn drain
+    rate — open-loop through the real HTTP tier, and self-certify the
+    isolation contract IN-RUN via the tenant SLO gates:
+
+    * ``min_flood_shed_share`` — ≥90% of all sheds land on the flooder
+      (the tenant-aware queue bound aims the pain at whoever owns the
+      backlog);
+    * ``max_victim_shed_rate`` — victims keep ≥95% admission;
+    * ``victim_p95_x_baseline`` — victim ok-p95 during the flood within
+      the declared multiple of the same victims' baseline-phase p95
+      (deficit round-robin batch composition, not luck);
+    * ``max_tenant_starvation_s`` — no victim goes a bounded span of
+      scheduled time without one success (the promotion bound, observed).
+
+    The warn device call carries an emulated dispatch RTT
+    (KAKVEDA_WARN_RTT_EMU_MS) sized so the flooder actually saturates the
+    drain rate on a local CPU backend — without it the batch returns in
+    microseconds and nobody sheds, which certifies nothing. Any gate
+    failing raises — an isolation row where victims absorbed the flood is
+    not a result."""
+    import asyncio
+    import tempfile
+    from pathlib import Path
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from kakveda_tpu.core import admission as _adm
+    from kakveda_tpu.core import faults as _faults
+    from kakveda_tpu import traffic as _traffic
+    from kakveda_tpu.traffic.slo import percentile as _pct
+
+    seed = int(os.environ.get("KAKVEDA_BENCH_TENANTS_SEED", 7))
+    duration = float(os.environ.get("KAKVEDA_BENCH_TENANTS_DUR", 8.0))
+    speed = float(os.environ.get("KAKVEDA_BENCH_TENANTS_SPEED", 1.0))
+    flood_rps = float(os.environ.get("KAKVEDA_BENCH_TENANTS_FLOOD_RPS", 150.0))
+    rtt_ms = os.environ.get("KAKVEDA_BENCH_TENANTS_RTT_MS", "50")
+    max_batch = os.environ.get("KAKVEDA_BENCH_TENANTS_MAX_BATCH", "4")
+
+    tmp = Path(tempfile.mkdtemp(prefix="kakveda-bench-tenants-"))
+
+    from kakveda_tpu.platform import Platform
+    from kakveda_tpu.service.app import make_app as make_service_app
+
+    sc = _traffic.make_scenario(
+        "noisy_neighbor", seed=seed, duration_s=duration,
+        flood_rps=flood_rps,
+    )
+    brown = _adm.BrownoutController(
+        enabled=True, enter=0.85, exit=0.5, dwell_s=0.25,
+    )
+    # warn sized SMALL on purpose: the whole drill is what happens when
+    # the warn queue saturates — the tenant-aware bound (not the ladder,
+    # which never sheds warn) must decide who eats the 429s.
+    adm = _adm.AdmissionController(
+        limits={"warn": 16, "ingest": 2, "interactive": 8, "background": 1},
+        enabled=True, brownout=brown,
+    )
+
+    # Shape the drain rate below the flood rate: max_batch items per
+    # emulated-RTT device call. Env knobs are read at make_app time, so
+    # set-and-restore around construction only.
+    _saved = {k: os.environ.get(k) for k in
+              ("KAKVEDA_WARN_RTT_EMU_MS", "KAKVEDA_WARN_MAX_BATCH")}
+    os.environ["KAKVEDA_WARN_RTT_EMU_MS"] = rtt_ms
+    os.environ["KAKVEDA_WARN_MAX_BATCH"] = max_batch
+    try:
+        plat = Platform(data_dir=tmp / "data", capacity=1 << 10, dim=1024)
+        svc = make_service_app(platform=plat, admission=adm)
+    finally:
+        for k, v in _saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    async def run():
+        client = TestClient(TestServer(svc))
+        await client.start_server()
+        try:
+            async def post(path, body):
+                resp = await client.post(path, json=body)
+                await resp.read()
+                return resp.status
+
+            return await _traffic.run_scenario(
+                sc, post=post, speed=speed, admission=adm,
+            )
+        finally:
+            await client.close()
+
+    try:
+        res = asyncio.run(run())
+    finally:
+        _faults.disarm()
+    report = _traffic.evaluate(sc.slo, res)
+
+    flood_app = sc.slo.flood_app
+    tenant_counts = res.tenant_counts("warn")
+    flood_c = tenant_counts.get(flood_app, {})
+    victim_c: dict = {}
+    for app, c in tenant_counts.items():
+        if app and app != flood_app:
+            for k, v in c.items():
+                victim_c[k] = victim_c.get(k, 0) + v
+    total_sheds = sum(c.get("shed", 0) for c in tenant_counts.values())
+    flood_share = (flood_c.get("shed", 0) / total_sheds) if total_sheds else 1.0
+    victim_total = sum(victim_c.values())
+    victim_shed_rate = (victim_c.get("shed", 0) / victim_total
+                        if victim_total else 0.0)
+    vic_apps = [a for a in tenant_counts if a and a != flood_app]
+    base_p95 = _pct([x for a in vic_apps
+                     for x in res.tenant_latencies_ms(a, phase="baseline")], 95)
+    flood_p95 = _pct([x for a in vic_apps
+                      for x in res.tenant_latencies_ms(a, phase="flood")], 95)
+    ratio = round(flood_p95 / max(base_p95, 1e-9), 2)
+    print(
+        f"bench[tenants]: {len(res.records)} dispatched, flooder "
+        f"{flood_c}, victims {victim_c}; victim p95 baseline "
+        f"{base_p95:.1f} ms / flood {flood_p95:.1f} ms ({ratio}x), "
+        f"flood shed share {flood_share:.3f}; {report.summary()}",
+        file=sys.stderr,
+    )
+    if not report.ok:
+        raise AssertionError(
+            f"tenant isolation drill failed its SLO — {report.summary()}"
+        )
+
+    return {
+        "metric": "tenants_victim_p95_degradation",
+        "value": ratio,
+        "unit": "x_baseline",
+        "vs_baseline": ratio,
+        "slo_ok": report.ok,
+        "slo": report.to_dict(),
+        "scenario": {"name": "noisy_neighbor", "seed": seed,
+                     "duration_s": duration, "speed": speed,
+                     "flood_rps": flood_rps, "rtt_emu_ms": float(rtt_ms),
+                     "warn_max_batch": int(max_batch)},
+        "victim_p95_baseline_ms": round(base_p95, 2),
+        "victim_p95_flood_ms": round(flood_p95, 2),
+        "victim_shed_rate": round(victim_shed_rate, 4),
+        "flood_shed_share": round(flood_share, 4),
+        "tenant_counts": tenant_counts,
+        "dispatched": len(res.records),
+        "class_counts": res.class_counts(),
+        "shed_counts": adm.shed_counts(),
+        "admission_tenants": adm.tenants_info(),
+        "late_p95_ms": res.late_p95_ms(),
+    }
+
+
 def _bench_elastic(backend: str) -> dict:
     """Elastic self-healing fleet drill (fleet/autoscaler.py,
     docs/scale-out.md § elastic fleet) — self-certifying, any gate
@@ -4067,6 +4221,7 @@ def main() -> int:
         "fleet": _bench_fleet,
         "ownership": _bench_ownership,
         "storm": _bench_storm,
+        "tenants": _bench_tenants,
         "elastic": _bench_elastic,
     }
     if which in fns:
@@ -4120,6 +4275,7 @@ def main() -> int:
         _bench_fleet,
         _bench_ownership,
         _bench_storm,
+        _bench_tenants,
         _bench_elastic,
     )
     for fn in order:

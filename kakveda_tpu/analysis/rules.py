@@ -260,7 +260,7 @@ class ForwardFlagParity(Rule):
 
 _SINGLE_WRITER = {
     "kakveda_tpu/models/serving.py": ("_set_gate_state",),
-    "kakveda_tpu/core/admission.py": ("_set_brownout_state",),
+    "kakveda_tpu/core/admission.py": ("_set_brownout_state", "_set_tenant_state"),
     "kakveda_tpu/fleet/autoscaler.py": ("_set_scale_state",),
 }
 _ANY_KEY = object()

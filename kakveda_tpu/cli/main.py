@@ -238,6 +238,46 @@ def _cmd_doctor(args: argparse.Namespace) -> int:
                                f"{last.get('outcome')}→{last.get('target')}")
         return f"{' '.join(parts)} fleet_mode={worst}{own_note}{scale_note}"
 
+    def _tenant_plane():
+        """Tenant fairness posture (docs/robustness.md § multi-tenancy):
+        reads the live server's /readyz admission.tenants block. A tenant
+        pinned at 100% shed — many sheds, ZERO admits — is a doctor
+        ERROR: either a flooder that should be talked to, or (if it's a
+        victim) an isolation bug. No live server is fine (the plane only
+        exists in-process)."""
+        pid = _read_pid(Path(args.dir))
+        if not (pid and _pid_alive(pid)):
+            return "no live server (probes /readyz when one is up)"
+        import httpx
+
+        body = httpx.get(args.url + "/readyz", timeout=2.0).json()
+        tenants = (body.get("admission") or {}).get("tenants")
+        if not tenants:
+            return "admission reports no tenant block (older server?)"
+        if not tenants.get("fair", False):
+            return "KAKVEDA_TENANT_FAIR=0 — global FIFO, no isolation"
+        pinned = [
+            row for row in tenants.get("top_shed", [])
+            if row.get("sheds", 0) >= 20 and row.get("admits", 0) == 0
+        ]
+        note = (
+            f"fair=on table={tenants.get('table_size')}/"
+            f"{tenants.get('table_max')} share_cap={tenants.get('max_share')} "
+            f"promotions={tenants.get('promotions') or {}}"
+        )
+        top = tenants.get("top_shed", [])
+        if top:
+            worst = top[0]
+            note += (f" top_shed={worst.get('tenant')}:"
+                     f"{worst.get('sheds')}")
+        if pinned:
+            raise RuntimeError(
+                f"{note} — tenant(s) pinned at 100% shed: "
+                + ", ".join(f"{r['tenant']} ({r['sheds']} sheds, 0 admits)"
+                            for r in pinned)
+            )
+        return note
+
     def _replay_budget():
         """Durability posture vs the operator's recovery-time budget:
         KAKVEDA_GFKB_REPLAY_BUDGET_S > 0 turns the replay estimate into a
@@ -264,6 +304,7 @@ def _cmd_doctor(args: argparse.Namespace) -> int:
 
     check("python", lambda: sys.version.split()[0])
     check("replay budget", _replay_budget)
+    check("tenant plane", _tenant_plane)
     check("fleet", _fleet)
     check("jax", _jax)
     check("device mesh", _mesh)
@@ -406,6 +447,20 @@ def _cmd_status(args: argparse.Namespace) -> int:
     status["server"] = (
         {"pid": pid, "running": _pid_alive(pid)} if pid else {"pid": None, "running": False}
     )
+    if status["server"]["running"]:
+        # Tenant plane (docs/robustness.md § multi-tenancy): quota table
+        # occupancy + top shed tenants + promotion counts, straight from
+        # the live server's /readyz admission block. Best effort — an
+        # unreachable server just omits the block.
+        try:
+            import httpx
+
+            body = httpx.get(args.url + "/readyz", timeout=2.0).json()
+            tenants = (body.get("admission") or {}).get("tenants")
+            if tenants:
+                status["tenants"] = tenants
+        except Exception:  # noqa: BLE001 — status reports, never crashes
+            pass
     replicas = {}
     for pidp in sorted(root.glob("replica-*.pid")):
         try:
@@ -982,6 +1037,8 @@ def build_parser() -> argparse.ArgumentParser:
 
     sp = sub.add_parser("status", help="show data-store row counts")
     sp.add_argument("--dir", default=".")
+    sp.add_argument("--url", default="http://127.0.0.1:8100",
+                    help="live server base URL for the tenant-plane probe")
     sp.set_defaults(fn=_cmd_status)
 
     sp = sub.add_parser("reset", help="delete local data stores")
@@ -1060,6 +1117,8 @@ def build_parser() -> argparse.ArgumentParser:
 
     sp = sub.add_parser("doctor", help="check the runtime environment")
     sp.add_argument("--dir", default=".", help="project root (for .env)")
+    sp.add_argument("--url", default="http://127.0.0.1:8100",
+                    help="live server base URL for the tenant-plane probe")
     sp.set_defaults(fn=_cmd_doctor)
 
     sp = sub.add_parser("version", help="print version")

@@ -40,11 +40,32 @@ Everything is process-global by default (:func:`get_admission`,
 warn pipeline must see ONE pressure picture. Tests build private instances
 and/or call :func:`reset_for_tests`.
 
+Per-tenant fairness (docs/robustness.md § multi-tenancy): admission is
+also TENANT-aware — call sites that know the requesting app key pass it
+as ``tenant=`` and the controller enforces a per-tenant share quota
+INSIDE each class (``KAKVEDA_TENANT_MAX_SHARE`` of the class bound,
+work-conserving: a lone tenant may still use the whole class). Tenant
+state lives in ONE bounded LRU table (``KAKVEDA_TENANT_TABLE`` rows,
+overflow folds into an ``other`` bucket that never quota-sheds — fail
+open, never wrong-but-confident) and every mutation of it flows through
+the single-writer :meth:`AdmissionController._set_tenant_state` helper
+(table + size gauge + per-tenant shed counter + flight recorder move
+together; machine-enforced by scripts/lint_invariants.py). A quota shed
+raises the same typed :class:`OverloadError` with ``reason=
+"tenant_quota"`` and tenant provenance; its Retry-After derives from
+THAT tenant's own drain rate when one has been observed. The
+``admission.tenant_quota`` fault site fails OPEN: an armed fault skips
+quota bookkeeping and admits on class capacity alone (a bookkeeping
+failure must degrade to coarser fairness, never become a shed storm).
+``KAKVEDA_TENANT_FAIR=0`` disables the whole tenant plane bit-for-bit.
+
 Knobs (docs/robustness.md): ``KAKVEDA_ADMIT`` (0 disables shedding),
 ``KAKVEDA_ADMIT_WARN/_INGEST/_INTERACTIVE/_BACKGROUND`` per-class bounds,
 ``KAKVEDA_BROWNOUT`` (0 disables the ladder), ``KAKVEDA_BROWNOUT_ENTER`` /
 ``KAKVEDA_BROWNOUT_EXIT`` / ``KAKVEDA_BROWNOUT_DWELL`` /
-``KAKVEDA_BROWNOUT_TOKEN_CAP``, ``KAKVEDA_DEGRADED_PROBE``.
+``KAKVEDA_BROWNOUT_TOKEN_CAP``, ``KAKVEDA_DEGRADED_PROBE``,
+``KAKVEDA_TENANT_FAIR`` / ``KAKVEDA_TENANT_TABLE`` /
+``KAKVEDA_TENANT_MAX_SHARE`` / ``KAKVEDA_TENANT_TOPK``.
 """
 
 from __future__ import annotations
@@ -54,7 +75,7 @@ import os
 import random
 import threading
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from typing import Dict, Optional, Tuple
 
 from kakveda_tpu.core import faults as _faults
@@ -72,6 +93,9 @@ __all__ = [
     "get_admission",
     "get_device_health",
     "reset_for_tests",
+    "tenant_fair_enabled",
+    "note_tenant_promotion",
+    "tenant_promotions",
     "CLASSES",
 ]
 
@@ -101,11 +125,15 @@ class OverloadError(Exception):
     """
 
     def __init__(self, message: str, retry_after: float = 1.0,
-                 klass: str = "", reason: str = ""):
+                 klass: str = "", reason: str = "", tenant: str = ""):
         super().__init__(message)
         self.retry_after = max(0.1, float(retry_after))
         self.klass = klass
         self.reason = reason
+        # Tenant provenance: the app key whose traffic was shed (empty for
+        # tenant-blind call sites). The HTTP tier and the traffic harness's
+        # per-tenant accounting both read it.
+        self.tenant = tenant
 
 
 class DeviceUnavailableError(Exception):
@@ -132,6 +160,45 @@ def _env_float(name: str, default: float) -> float:
         return float(os.environ.get(name, str(default)))
     except ValueError:
         return default
+
+
+def tenant_fair_enabled() -> bool:
+    """The ONE switch for the whole tenant plane (admission quotas, warn
+    micro-batcher DRR, serving-slot weighted-fair). ``KAKVEDA_TENANT_FAIR=0``
+    must keep every scheduler bit-for-bit FIFO — each consumer resolves
+    this at construction, the same discipline as every other knob."""
+    return os.environ.get("KAKVEDA_TENANT_FAIR", "1") != "0"
+
+
+# Starvation-promotion accounting, shared across planes (the serving
+# engine's max-wait promotion lives in models/serving.py but the tenant
+# plane's observability surface — info()/readyz/cli status — is here).
+_PROMOTIONS_LOCK = threading.Lock()
+_PROMOTIONS: Dict[str, int] = {}
+_PROMOTIONS_CHILDREN: Dict[str, object] = {}
+
+
+def note_tenant_promotion(plane: str) -> None:
+    """Count one starvation promotion (a waiting item force-admitted after
+    sitting out the max fair-scheduling rounds). ``plane`` is a bounded
+    enum ("serving", …), never a tenant id — cardinality stays O(planes)."""
+    with _PROMOTIONS_LOCK:
+        _PROMOTIONS[plane] = _PROMOTIONS.get(plane, 0) + 1
+        child = _PROMOTIONS_CHILDREN.get(plane)
+        if child is None:
+            child = _metrics.get_registry().counter(
+                "kakveda_tenant_promotions_total",
+                "Starvation promotions by fair schedulers (a waiting item "
+                "admitted out of deficit order after max fair rounds)",
+                ("plane",),
+            ).labels(plane=plane)
+            _PROMOTIONS_CHILDREN[plane] = child
+    child.inc()
+
+
+def tenant_promotions() -> Dict[str, int]:
+    with _PROMOTIONS_LOCK:
+        return dict(_PROMOTIONS)
 
 
 class BrownoutController:
@@ -343,7 +410,45 @@ class AdmissionController:
         self._occ_window_s = max(
             0.0, _env_float("KAKVEDA_ADMIT_OCC_WINDOW_S", 3.0))
         self._occ_peaks: deque = deque(maxlen=1024)
+        # --- tenant plane (docs/robustness.md § multi-tenancy) ----------
+        # One bounded LRU table of per-tenant records; EVERY mutation goes
+        # through _set_tenant_state (single-writer, lint-enforced). A
+        # record: per-class in-flight, admit/shed counts, and the same
+        # drain-rate window the class keeps — the input to per-tenant
+        # Retry-After. Overflow past the bound evicts the stalest idle
+        # tenant, else folds into the aggregate "other" bucket, which
+        # NEVER quota-sheds (no per-tenant resolution → fail open).
+        self._tenant_fair = tenant_fair_enabled()
+        self._tenant_table_max = max(2, _env_int("KAKVEDA_TENANT_TABLE", 512))
+        self._tenant_share = min(1.0, max(
+            0.01, _env_float("KAKVEDA_TENANT_MAX_SHARE", 0.5)))
+        self._tenant_topk = max(1, _env_int("KAKVEDA_TENANT_TOPK", 16))
+        self._tenants: "OrderedDict[str, dict]" = OrderedDict()
+        # Fail-OPEN chaos site: armed → quota bookkeeping is skipped and
+        # the request admits on class capacity alone (degraded fairness,
+        # never a shed storm). Resolved once, like every site.
+        self._fault_tenant = _faults.site("admission.tenant_quota")
         reg = _metrics.get_registry()
+        self._g_tenant_table = reg.gauge(
+            "kakveda_tenant_table_size",
+            "Live per-tenant state-table rows per plane (bounded by "
+            "KAKVEDA_TENANT_TABLE / KAKVEDA_RATELIMIT_MAX_KEYS)",
+            ("plane",),
+        ).labels(plane="admission")
+        c_tenant_shed = reg.counter(
+            "kakveda_admission_tenant_shed_total",
+            "Requests shed per tenant label (top-K first-seen shed tenants; "
+            "the rest aggregate under tenant=\"other\" — "
+            "docs/observability.md cardinality policy)",
+            ("tenant",),
+        )
+        self._c_tenant_shed = c_tenant_shed
+        self._tenant_shed_children: Dict[str, object] = {}
+        self._c_tenant_degraded = reg.counter(
+            "kakveda_admission_tenant_quota_degraded_total",
+            "Admissions where tenant-quota bookkeeping failed open "
+            "(admission.tenant_quota fault site)",
+        )
         g_inflight = reg.gauge(
             "kakveda_admission_inflight",
             "In-flight (admitted, not yet released) requests per admission "
@@ -452,10 +557,15 @@ class AdmissionController:
             self._done_count[klass] = 0
             self._done_t0[klass] = now
 
-    def retry_after(self, klass: str) -> float:
+    def retry_after(self, klass: str, tenant: str = "") -> float:
         """Seconds until the class's backlog plausibly drains: in-flight /
         observed drain rate, clamped to [0.5, 30], then spread by a bounded
         multiplicative jitter (±``KAKVEDA_ADMIT_RA_JITTER``, default 0.25).
+
+        With a ``tenant`` whose drain rate has been observed, the estimate
+        is THAT tenant's own backlog over its own rate instead — a
+        quota-shed flooder is told when ITS slots free up, not when the
+        class (which other tenants keep busy) does.
 
         The jitter is load-bearing, not cosmetic: without it every client
         shed in the same saturation window gets the SAME drain-derived
@@ -472,6 +582,17 @@ class AdmissionController:
                 if self._done_count[klass] and dt > 0.05:
                     rate = self._done_count[klass] / dt
             backlog = self._inflight[klass]
+            if self._tenant_fair and tenant:
+                rec = self._tenants.get(tenant)
+                if rec is not None:
+                    trate = rec["rate"]
+                    if trate <= 0.0:
+                        dt = time.monotonic() - rec["t0"]
+                        if rec["done"] and dt > 0.05:
+                            trate = rec["done"] / dt
+                    if trate > 0.0:
+                        rate = trate
+                        backlog = rec["inflight"].get(klass, 0)
         if rate <= 0.0:
             base = 1.0
         else:
@@ -510,9 +631,150 @@ class AdmissionController:
             load = self._inflight[klass] / max(1, self.limits[klass])
         return p95 * (1.0 + load)
 
+    # -- tenant plane ----------------------------------------------------
+
+    def _tenant_cap(self, klass: str) -> int:
+        return max(1, int(self.limits[klass] * self._tenant_share))
+
+    def _set_tenant_state(
+        self,
+        tenant: Optional[str],
+        klass: Optional[str] = None,
+        *,
+        inflight_delta: int = 0,
+        shed: bool = False,
+        done: bool = False,
+        retry_after: float = 0.0,
+        clear: bool = False,
+    ) -> Optional[dict]:
+        """ONE definition of a tenant-table mutation: the bounded LRU table
+        (touch / create / evict / overflow-fold), per-class in-flight and
+        admit/shed/drain accounting, the table-size gauge, the capped
+        per-tenant shed counter and the flight recorder all move together.
+        Caller holds ``_lock``. Returns the (possibly "other") record."""
+        if clear:
+            self._tenants.clear()
+            self._g_tenant_table.set(0.0)
+            return None
+        assert tenant
+        now = time.monotonic()
+        rec = self._tenants.get(tenant)
+        if rec is None:
+            if len(self._tenants) >= self._tenant_table_max:
+                # Evict the stalest tenant with nothing in flight; if every
+                # row is live (pathological), fold THIS tenant into the
+                # aggregate bucket instead of growing.
+                victim = None
+                for k, r in self._tenants.items():  # LRU order, oldest first
+                    if k != "other" and not any(r["inflight"].values()):
+                        victim = k
+                        break
+                if victim is not None:
+                    del self._tenants[victim]
+                else:
+                    tenant = "other"
+                    rec = self._tenants.get("other")
+            if rec is None:
+                rec = {
+                    "key": tenant,
+                    "inflight": {},
+                    "admits": 0,
+                    "sheds": 0,
+                    "done": 0,
+                    "t0": now,
+                    "rate": 0.0,
+                }
+                self._tenants[tenant] = rec
+        self._tenants.move_to_end(tenant)
+        self._g_tenant_table.set(float(len(self._tenants)))
+        if inflight_delta:
+            held = rec["inflight"].get(klass, 0) + inflight_delta
+            rec["inflight"][klass] = max(0, held)
+            if inflight_delta > 0:
+                rec["admits"] += 1
+        if done:
+            # Same fold-at-5s drain-rate window the class keeps — the
+            # per-tenant Retry-After input.
+            rec["done"] += 1
+            dt = now - rec["t0"]
+            if dt >= 5.0:
+                rate = rec["done"] / dt
+                prev = rec["rate"]
+                rec["rate"] = rate if prev == 0.0 else 0.5 * prev + 0.5 * rate
+                rec["done"] = 0
+                rec["t0"] = now
+        if shed:
+            rec["sheds"] += 1
+            label = tenant if (
+                tenant in self._tenant_shed_children
+                or len(self._tenant_shed_children) < self._tenant_topk
+            ) else "other"
+            child = self._tenant_shed_children.get(label)
+            if child is None:
+                child = self._c_tenant_shed.labels(tenant=label)
+                self._tenant_shed_children[label] = child
+            child.inc()
+            if self.recorder is not None:
+                self.recorder.record(
+                    "tenant_shed", tenant=tenant, klass=klass or "",
+                    retry_after=round(retry_after, 2),
+                )
+        return rec
+
+    def _tenant_quota_locked(self, klass: str, tenant: str) -> Optional[Tuple[int, int]]:
+        """None → admit; (held, cap) → quota shed. Caller holds ``_lock``
+        and has already established class capacity. The quota is
+        WORK-CONSERVING: it only binds while OTHER tenants hold in-flight
+        work in the class — a lone tenant may use the whole bound. The
+        ``admission.tenant_quota`` site fails OPEN (skip quota, admit)."""
+        if not (self._tenant_fair and tenant):
+            return None
+        try:
+            self._fault_tenant.fire()
+        except _faults.FaultInjected:
+            self._c_tenant_degraded.inc()
+            return None
+        rec = self._set_tenant_state(tenant)
+        if rec is None or rec["key"] == "other":
+            return None
+        held = rec["inflight"].get(klass, 0)
+        cap = self._tenant_cap(klass)
+        if held >= cap and held < self._inflight[klass]:
+            return held, cap
+        return None
+
+    def tenants_info(self) -> dict:
+        """The tenant-plane report for info()/readyz → cli status/doctor:
+        top shed tenants (with shed rate for the pinned-at-100% doctor
+        check), live quota occupancy, table bound, promotions."""
+        with self._lock:
+            fair = self._tenant_fair
+            size = len(self._tenants)
+            rows = [
+                {
+                    "tenant": k,
+                    "sheds": r["sheds"],
+                    "admits": r["admits"],
+                    "shed_rate": round(
+                        r["sheds"] / max(1, r["sheds"] + r["admits"]), 4),
+                    "inflight": {c: n for c, n in r["inflight"].items() if n},
+                }
+                for k, r in self._tenants.items()
+            ]
+        rows.sort(key=lambda r: (-r["sheds"], r["tenant"]))
+        return {
+            "fair": fair,
+            "table_size": size,
+            "table_max": self._tenant_table_max,
+            "max_share": self._tenant_share,
+            "top_shed": rows[:8],
+            "promotions": tenant_promotions(),
+        }
+
     # -- admit / release -------------------------------------------------
 
-    def try_admit(self, klass: str, deadline_s: Optional[float] = None) -> None:
+    def try_admit(self, klass: str, deadline_s: Optional[float] = None,
+                  tenant: str = "") -> None:
         """Admit or raise :class:`OverloadError`. Callers MUST pair a
         successful return with :meth:`release` (use :meth:`slot`)."""
         if klass not in self._inflight:
@@ -525,7 +787,7 @@ class AdmissionController:
             self._m_admitted[klass].inc()
             return
         if self.brownout.class_shed(klass):
-            self.shed(klass, "brownout")
+            self.shed(klass, "brownout", tenant=tenant)
         with self._lock:
             busy = self._inflight[klass] > 0
         if deadline_s is not None and busy:
@@ -537,7 +799,9 @@ class AdmissionController:
                     klass, "deadline",
                     detail=f"predicted queue wait {predicted:.2f}s exceeds "
                            f"deadline {deadline_s:.2f}s",
+                    tenant=tenant,
                 )
+        quota: Optional[Tuple[int, int]] = None
         with self._lock:
             if self._inflight[klass] >= self.limits[klass]:
                 # Shed-at-limit is peak load too: between two short-lived
@@ -546,52 +810,80 @@ class AdmissionController:
                 self._note_peak_locked(time.monotonic())
                 pressure = self._pressure_locked()
             else:
-                self._inflight[klass] += 1
+                quota = self._tenant_quota_locked(klass, tenant)
+                if quota is None:
+                    self._inflight[klass] += 1
+                    if self._tenant_fair and tenant:
+                        self._set_tenant_state(tenant, klass, inflight_delta=1)
+                    self._note_peak_locked(time.monotonic())
+                    self._m_inflight[klass].set(self._inflight[klass])
+                    self._m_admitted[klass].inc()
+                    pressure = self._pressure_locked()
+                    self.brownout.note_pressure(pressure)
+                    return
+                # Quota shed is tenant-local demand, not class pressure —
+                # record the peak (real arriving load) but shed below.
                 self._note_peak_locked(time.monotonic())
-                self._m_inflight[klass].set(self._inflight[klass])
-                self._m_admitted[klass].inc()
                 pressure = self._pressure_locked()
-                self.brownout.note_pressure(pressure)
-                return
         self.brownout.note_pressure(pressure)
-        self.shed(klass, "queue_full")
+        if quota is not None:
+            held, cap = quota
+            self.shed(
+                klass, "tenant_quota",
+                detail=f"tenant {tenant!r} holds {held}/{cap} {klass} slots "
+                       "while other tenants wait",
+                tenant=tenant,
+            )
+        self.shed(klass, "queue_full", tenant=tenant)
 
-    def note_shed(self, klass: str, reason: str, retry_after: float = 1.0) -> None:
+    def note_shed(self, klass: str, reason: str, retry_after: float = 1.0,
+                  tenant: str = "") -> None:
         """Record a shed decided OUTSIDE the controller (token bucket,
         micro-batcher bound) so every rejection lands on one counter."""
         self._c_shed.labels(klass=klass, reason=reason).inc()
         key = f"{klass}/{reason}"
         with self._lock:
             self._sheds[key] = self._sheds.get(key, 0) + 1
+            if self._tenant_fair and tenant:
+                self._set_tenant_state(
+                    tenant, klass, shed=True, retry_after=retry_after)
         if self.recorder is not None:
             self.recorder.record(
                 "shed", klass=klass, reason=reason,
                 retry_after=round(retry_after, 2),
+                **({"tenant": tenant} if tenant else {}),
             )
 
-    def shed(self, klass: str, reason: str, detail: str = "") -> None:
+    def shed(self, klass: str, reason: str, detail: str = "",
+             tenant: str = "") -> None:
         """Record + raise: THE rejection path (429 + Retry-After at the
         HTTP tier)."""
-        ra = self.retry_after(klass)
-        self.note_shed(klass, reason, retry_after=ra)
+        ra = self.retry_after(klass, tenant=tenant)
+        self.note_shed(klass, reason, retry_after=ra, tenant=tenant)
         msg = f"{klass} request shed ({reason})"
         if detail:
             msg += f": {detail}"
-        raise OverloadError(msg, retry_after=ra, klass=klass, reason=reason)
+        raise OverloadError(msg, retry_after=ra, klass=klass, reason=reason,
+                            tenant=tenant)
 
-    def release(self, klass: str, wait_s: Optional[float] = None) -> None:
+    def release(self, klass: str, wait_s: Optional[float] = None,
+                tenant: str = "") -> None:
         with self._lock:
             self._inflight[klass] = max(0, self._inflight[klass] - 1)
             self._note_done_locked(klass)
+            if self._tenant_fair and tenant:
+                self._set_tenant_state(tenant, klass, inflight_delta=-1,
+                                       done=True)
             pressure = self._pressure_locked()
         self._m_inflight[klass].set(self._inflight[klass])
         if wait_s is not None:
             self.note_wait(klass, wait_s)
         self.brownout.note_pressure(pressure)
 
-    def slot(self, klass: str, deadline_s: Optional[float] = None) -> "_Slot":
+    def slot(self, klass: str, deadline_s: Optional[float] = None,
+             tenant: str = "") -> "_Slot":
         """Context-manager admission: sheds on entry, releases on exit."""
-        return _Slot(self, klass, deadline_s)
+        return _Slot(self, klass, deadline_s, tenant)
 
     def shed_counts(self) -> Dict[str, float]:
         """{"klass/reason": count} for THIS controller instance — bench +
@@ -619,6 +911,7 @@ class AdmissionController:
             # own state — a rumor latch. The floor is reported separately.
             "occupancy": round(occupancy, 4),
             "fleet_pressure": round(self.fleet_pressure(), 4),
+            "tenants": self.tenants_info(),
         }
 
     def reset(self) -> None:
@@ -628,6 +921,7 @@ class AdmissionController:
             self._sheds.clear()
             self._fleet_pressure = (0.0, 0.0)
             self._occ_peaks.clear()
+            self._set_tenant_state(None, clear=True)
             for k in CLASSES:
                 self._inflight[k] = 0
                 self._waits[k].clear()
@@ -640,18 +934,20 @@ class AdmissionController:
 
 
 class _Slot:
-    __slots__ = ("_adm", "_klass", "_deadline", "_t0")
+    __slots__ = ("_adm", "_klass", "_deadline", "_tenant", "_t0")
 
-    def __init__(self, adm: AdmissionController, klass: str, deadline_s):
+    def __init__(self, adm: AdmissionController, klass: str, deadline_s,
+                 tenant: str = ""):
         self._adm, self._klass, self._deadline = adm, klass, deadline_s
+        self._tenant = tenant
 
     def __enter__(self):
-        self._adm.try_admit(self._klass, self._deadline)
+        self._adm.try_admit(self._klass, self._deadline, tenant=self._tenant)
         self._t0 = time.monotonic()
         return self
 
     def __exit__(self, *exc):
-        self._adm.release(self._klass)
+        self._adm.release(self._klass, tenant=self._tenant)
         return False
 
 
@@ -871,3 +1167,5 @@ def reset_for_tests() -> None:
             _ADMISSION.reset()
         _ADMISSION = None
         _DEVICE_HEALTH = None
+    with _PROMOTIONS_LOCK:
+        _PROMOTIONS.clear()

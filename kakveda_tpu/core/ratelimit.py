@@ -17,7 +17,10 @@ from __future__ import annotations
 import asyncio
 import os
 import time
+from collections import OrderedDict
 from typing import Dict, Optional, Tuple
+
+from kakveda_tpu.core import metrics as _metrics
 
 _UNSET = object()
 
@@ -104,17 +107,42 @@ class TokenBucket:
     sheds with (docs/robustness.md). In-memory only by design: per-client
     smoothing is a node-local concern; cross-fleet quotas stay on the
     Redis fixed-window tier.
+
+    The table is HARD-bounded: the refill sweep drops idle keys, but a
+    key-churn flood (1M distinct app ids inside one burst window) would
+    still grow it between sweeps, so past ``KAKVEDA_RATELIMIT_MAX_KEYS``
+    the least-recently-seen bucket is evicted on insert. Eviction is
+    semantics-preserving in the only direction that matters — an evicted
+    key re-enters FULL, exactly what its bucket would have refilled to by
+    the time a genuinely idle client returns; a churn attacker evicting
+    hot keys only ever GRANTS tokens, never wrongly denies. Table size is
+    exported on the ``kakveda_tenant_table_size{plane="ratelimit"}``
+    gauge.
     """
 
     _SWEEP_EVERY = 1024
 
-    def __init__(self, rps: float, burst: Optional[float] = None):
+    def __init__(self, rps: float, burst: Optional[float] = None,
+                 max_keys: Optional[int] = None):
         if rps <= 0:
             raise ValueError(f"rps must be positive, got {rps}")
         self.rps = float(rps)
         self.burst = float(burst) if burst is not None else max(1.0, 2.0 * rps)
-        self._buckets: Dict[str, Tuple[float, float]] = {}  # key -> (tokens, last_ts)
+        if max_keys is None:
+            try:
+                max_keys = int(os.environ.get("KAKVEDA_RATELIMIT_MAX_KEYS", "65536"))
+            except ValueError:
+                max_keys = 65536
+        self.max_keys = max(1, max_keys)
+        # key -> (tokens, last_ts), most-recently-seen last (LRU order).
+        self._buckets: "OrderedDict[str, Tuple[float, float]]" = OrderedDict()
         self._calls = 0
+        self._g_table = _metrics.get_registry().gauge(
+            "kakveda_tenant_table_size",
+            "Live per-tenant state-table rows per plane (bounded by "
+            "KAKVEDA_TENANT_TABLE / KAKVEDA_RATELIMIT_MAX_KEYS)",
+            ("plane",),
+        ).labels(plane="ratelimit")
 
     def allow(self, key: str, now: Optional[float] = None) -> Tuple[bool, float]:
         """(admitted, retry_after_s). ``retry_after`` is 0 when admitted,
@@ -126,13 +154,22 @@ class TokenBucket:
             # Drop keys whose bucket has fully refilled — idle clients
             # (IP-derived keys on unauthenticated routes) must not leak.
             full_age = self.burst / self.rps
-            self._buckets = {
-                k: v for k, v in self._buckets.items() if now - v[1] < full_age
-            }
-        tokens, last = self._buckets.get(key, (self.burst, now))
+            self._buckets = OrderedDict(
+                (k, v) for k, v in self._buckets.items() if now - v[1] < full_age
+            )
+        entry = self._buckets.get(key)
+        if entry is None:
+            tokens, last = self.burst, now
+            if len(self._buckets) >= self.max_keys:
+                self._buckets.popitem(last=False)  # least-recently-seen
+        else:
+            tokens, last = entry
+            self._buckets.move_to_end(key)
         tokens = min(self.burst, tokens + (now - last) * self.rps)
         if tokens >= 1.0:
             self._buckets[key] = (tokens - 1.0, now)
+            self._g_table.set(float(len(self._buckets)))
             return True, 0.0
         self._buckets[key] = (tokens, now)
+        self._g_table.set(float(len(self._buckets)))
         return False, (1.0 - tokens) / self.rps

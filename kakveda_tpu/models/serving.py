@@ -1427,6 +1427,24 @@ class ServingEngine:
         # of growing a queue nobody will drain before callers time out —
         # the HTTP tier surfaces it as 429 + Retry-After.
         self._admit_queue = int(os.environ.get("KAKVEDA_ADMIT_QUEUE", "64"))
+        # Per-tenant weighted-fair slot admission (docs/robustness.md
+        # § multi-tenancy): when enabled and submits carry a tenant, a
+        # freed slot goes to the waiting head of the LEAST-served tenant
+        # (deficit pick, per-tenant FIFO), with a starvation bound — any
+        # item passed over KAKVEDA_TENANT_PROMOTE_ROUNDS times is admitted
+        # next regardless of deficit (max-wait promotion). All tenant-blind
+        # or KAKVEDA_TENANT_FAIR=0 traffic degenerates to exact FIFO.
+        self._tenant_fair = _admission.tenant_fair_enabled()
+        self._promote_rounds = max(
+            1, int(os.environ.get("KAKVEDA_TENANT_PROMOTE_ROUNDS", "8")))
+        self._fair_table_max = max(
+            2, int(os.environ.get("KAKVEDA_TENANT_TABLE", "512")))
+        # Loop-owned under _submit_lock (picks happen inside the lock):
+        # recent slot admissions per tenant — the deficit input. Bounded +
+        # halved periodically so share means RECENT share.
+        self._fair_served: Dict[str, int] = {}
+        self._fair_picks = 0
+        self._fair_promotions = 0
         # Generation items: (ids, max_new, temp, on_tokens, t_submit,
         # deadline_abs_or_None, fut); control items: ("cancel"|"prefix", …, fut).
         self._q: "queue.Queue[tuple]" = queue.Queue()
@@ -1453,6 +1471,12 @@ class ServingEngine:
             snap["prefix"] = dict(self.cb.prefix_stats)
         snap["restarts"] = self._restarts
         snap["dead"] = self._dead.is_set()
+        with self._submit_lock:
+            snap["tenant_fair"] = {
+                "enabled": self._tenant_fair,
+                "served": dict(self._fair_served),
+                "promotions": self._fair_promotions,
+            }
         return snap
 
     def _bump(self, key: str, v: int = 1) -> None:
@@ -1525,6 +1549,7 @@ class ServingEngine:
         on_tokens=None,
         deadline_s: Optional[float] = None,
         klass: str = "interactive",
+        tenant: str = "",
     ) -> Future:
         """Enqueue a request; the Future resolves to the generated id list.
 
@@ -1546,6 +1571,10 @@ class ServingEngine:
         ``KAKVEDA_ADMIT_QUEUE`` sheds with :class:`OverloadError`; and a
         ``deadline_s`` the live queue-wait history says cannot be met is
         rejected NOW instead of burning a slot and expiring anyway.
+        ``tenant`` (optional, the app key) enters the request into the
+        weighted-fair slot scheduler and stamps shed provenance; empty
+        keeps the request tenant-blind (exact seed behavior).
+
         Neither error is a RuntimeError — shed work must surface as 429,
         never silently take the solo-decode fallback path."""
         _admission.get_device_health().check()
@@ -1553,7 +1582,7 @@ class ServingEngine:
         if adm.enabled:
             if adm.brownout.class_shed(klass):
                 self._m_requests.labels(engine=self.name, outcome="shed").inc()
-                adm.shed(klass, "brownout")
+                adm.shed(klass, "brownout", tenant=tenant)
             with self._submit_lock:
                 backlog = self._q.qsize() + len(self._waiting)
             if backlog >= self._admit_queue:
@@ -1561,6 +1590,7 @@ class ServingEngine:
                 adm.shed(
                     klass, "queue_full",
                     detail=f"engine backlog {backlog} >= {self._admit_queue}",
+                    tenant=tenant,
                 )
             if deadline_s is not None and backlog > 0:
                 # Deadline-aware shed: only with a LIVE backlog — an empty
@@ -1573,6 +1603,7 @@ class ServingEngine:
                         klass, "deadline",
                         detail=f"predicted queue wait {predicted:.2f}s exceeds "
                                f"deadline {deadline_s:.2f}s",
+                        tenant=tenant,
                     )
             cap = adm.brownout.token_cap()
             if cap is not None:
@@ -1596,6 +1627,11 @@ class ServingEngine:
             # the serialized traceparent is the only bridge to the
             # serving.request span recorded at _finish_telemetry.
             fut.traceparent = _trace.current_traceparent()
+            # Tenant identity + fairness counters ride the Future too (the
+            # traceparent precedent): the 7-field waiting-item layout and
+            # every item[5]/item[-1] access stay untouched.
+            fut.tenant = tenant
+            fut.fair_rounds = 0
             self._q.put(
                 (list(prompt_ids), max_new_tokens, temperature, on_tokens,
                  t0, deadline, fut)
@@ -1686,6 +1722,66 @@ class ServingEngine:
             self._pend.clear()
             self._track.clear()
 
+    def _pick_waiting_locked(self):
+        """Pop the next waiting generation item for a freed slot. Caller
+        holds ``_submit_lock`` and guarantees ``_waiting`` is non-empty.
+
+        Tenant-fair path (KAKVEDA_TENANT_FAIR=1, docs/robustness.md
+        § multi-tenancy):
+
+        1. Max-wait promotion — the earliest-queued item passed over
+           ``_promote_rounds`` times is taken regardless of deficit. This
+           is the starvation BOUND: every pick increments the skip count
+           of every item left behind, so any waiting item is admitted
+           within K scheduling rounds of reaching the front of its
+           tenant's subqueue, flood or no flood.
+        2. Deficit pick — among each tenant's FIFO head, take the tenant
+           with the fewest recent slot admissions. A light tenant beats a
+           flooder for every freed slot; per-tenant order stays FIFO.
+
+        Tenant-blind traffic (all tenants ``""``) reduces to index 0 both
+        ways — exact FIFO — and ``KAKVEDA_TENANT_FAIR=0`` short-circuits
+        to ``pop(0)`` before any of this runs (bit-for-bit seed)."""
+        if not self._tenant_fair or len(self._waiting) <= 1:
+            return self._waiting.pop(0)
+        pick = None
+        for i, item in enumerate(self._waiting):
+            if getattr(item[-1], "fair_rounds", 0) >= self._promote_rounds:
+                pick = i
+                self._fair_promotions += 1
+                _admission.note_tenant_promotion("serving")
+                break
+        if pick is None:
+            seen = set()
+            best = None
+            pick = 0
+            for i, item in enumerate(self._waiting):
+                t = getattr(item[-1], "tenant", "")
+                if t in seen:
+                    continue  # only each tenant's FIFO head competes
+                seen.add(t)
+                s = self._fair_served.get(t, 0)
+                if best is None or s < best:
+                    best, pick = s, i
+        item = self._waiting.pop(pick)
+        t = getattr(item[-1], "tenant", "")
+        if t not in self._fair_served and len(self._fair_served) >= self._fair_table_max:
+            # Bounded table: drop the heaviest-served key — it re-enters
+            # at zero (brief priority boost, the safe failure direction).
+            del self._fair_served[max(self._fair_served,
+                                      key=self._fair_served.get)]
+        self._fair_served[t] = self._fair_served.get(t, 0) + 1
+        self._fair_picks += 1
+        if self._fair_picks % 1024 == 0:
+            # Decay: fair share means RECENT share, and zeros drop.
+            self._fair_served = {
+                k: v // 2 for k, v in self._fair_served.items() if v // 2 > 0
+            }
+        for other in self._waiting:
+            fut = other[-1]
+            fut.fair_rounds = getattr(fut, "fair_rounds", 0) + 1
+        return item
+
     def _rebuild(self) -> None:
         """Rebuild the batcher after a loop death: a FRESH ContinuousBatcher
         (cache slabs re-zeroed by init_cache; gate/k/pipeline/adaptive state
@@ -1698,6 +1794,16 @@ class ServingEngine:
             self._params, self._cfg, name=self.name, recorder=self.recorder,
             **self._cb_kw,
         )
+        # Fairness state is RE-DERIVED from the surviving queue, never
+        # trusted from the crashed loop: served deficits reset and every
+        # waiting item's skip count restarts, so the rebuilt scheduler
+        # starts from what is actually still queued (ISSUE contract — a
+        # crash must not let stale counters starve or favor anyone).
+        with self._submit_lock:
+            self._fair_served.clear()
+            self._fair_picks = 0
+            for item in self._waiting:
+                item[-1].fair_rounds = 0
         for ids in list(self._prefix_ids):
             try:
                 self.cb.register_prefix(list(ids))
@@ -2003,7 +2109,7 @@ class ServingEngine:
                 with self._submit_lock:
                     if not self._waiting:
                         break
-                    item = self._waiting.pop(0)
+                    item = self._pick_waiting_locked()
                 if pending_spec is not None:
                     drain_spec()
                 self._admit_one(item)

@@ -326,6 +326,12 @@ def make_app(
     warn_batcher: MicroBatcher = MicroBatcher(
         run_warn_batch, max_batch=warn_max_batch, deadline_s=warn_deadline_s,
         max_queue=adm.limits["warn"], admission=adm,
+        # Tenant identity for weighted-fair batch composition + the
+        # tenant-aware queue bound (docs/robustness.md § multi-tenancy).
+        # The warn body is parsed BEFORE submit, so — unlike the ingest
+        # slots, which shed pre-parse by contract and stay tenant-blind —
+        # the app key is free here.
+        tenant_key=lambda r: r.app_id,
     )
     app[WARN_BATCHER_KEY] = warn_batcher
 

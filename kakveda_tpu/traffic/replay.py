@@ -93,6 +93,27 @@ class ReplayResult:
             out[r["klass"]][r["status"]] = out[r["klass"]].get(r["status"], 0) + 1
         return out
 
+    def tenant_counts(self, klass: Optional[str] = None) -> Dict[str, Dict[str, int]]:
+        """Per-tenant terminal buckets: {app: {status: n}} over records that
+        carry an app tag (events without one aggregate under ``""``). The
+        input to the noisy-neighbor gates — who absorbed the shed."""
+        out: Dict[str, Dict[str, int]] = {}
+        for r in self.records:
+            if klass is not None and r["klass"] != klass:
+                continue
+            app = r.get("app", "")
+            out.setdefault(app, {})
+            out[app][r["status"]] = out[app].get(r["status"], 0) + 1
+        return out
+
+    def tenant_latencies_ms(self, app: str, klass: str = "warn",
+                            phase: Optional[str] = None) -> List[float]:
+        """One tenant's ok-latency series (the victim-p95 gate input)."""
+        return [r["latency_ms"] for r in self.records
+                if r.get("app", "") == app and r["klass"] == klass
+                and r["status"] == "ok"
+                and (phase is None or r["phase"] == phase)]
+
     def generated(self, klass: str) -> int:
         # Skipped LOCAL events (no dispatcher provided) were never
         # generated INTO the system — they don't count as lost.
@@ -119,7 +140,11 @@ class ReplayResult:
 async def _dispatch(e: dict, sched_t: float, sem: asyncio.Semaphore,
                     post: PostFn, extra: Dict[str, LocalFn],
                     timeout_s: float, result: ReplayResult) -> None:
+    # "app" (tenant identity) + "t" (scheduled offset) feed the per-tenant
+    # SLO gates (max_victim_shed_rate / victim_p95_x_baseline /
+    # max_tenant_starvation_s) — untagged events simply leave them vacuous.
     rec = {"klass": e.get("klass", "warn"), "phase": e.get("phase", ""),
+           "app": e.get("app_id", ""), "t": float(e.get("t", 0.0)),
            "status": "error", "latency_ms": 0.0, "late_ms": 0.0}
     loop = asyncio.get_running_loop()
     # One span per dispatch, ended in the SAME finally that buckets the

@@ -510,6 +510,64 @@ def flash_crowd(seed: int = 0, *, baseline_s: float = 8.0,
     )
 
 
+def noisy_neighbor(seed: int = 0, *, duration_s: float = 10.0,
+                   victims: int = 3, victim_rps: float = 15.0,
+                   flood_rps: float = 150.0, flood_start_frac: float = 0.3,
+                   flood_app: str = "app-flood",
+                   max_victim_shed_rate: float = 0.05,
+                   victim_p95_x: float = 3.0,
+                   min_flood_shed_share: float = 0.9,
+                   starvation_s: float = 2.0) -> Scenario:
+    """THE tenant-isolation drill (docs/robustness.md § multi-tenancy):
+    well-behaved victim apps warm up alone, then ONE flooder opens up at
+    many multiples of the warn drain rate and keeps firing to the end.
+
+    * phase ``baseline`` ``[0, b)``: ``victims`` apps share ``victim_rps``
+      of warn traffic — comfortably under capacity; this phase is the
+      self-normalizing latency reference.
+    * phase ``flood`` ``[b, end)``: the same victim stream continues
+      unchanged while ``flood_app`` adds ``flood_rps`` on top — far past
+      the drain rate, so the warn queue saturates and SOMEONE must shed.
+
+    The SLO is the isolation contract: the shed lands on the flooder
+    (``min_flood_shed_share``), victims keep their admission rate
+    (``max_victim_shed_rate``) and near-baseline latency
+    (``victim_p95_x_baseline``), and no victim starves longer than
+    ``starvation_s`` of scheduled time without a success — the observed
+    end-to-end counterpart of the weighted-fair promotion bound
+    (``KAKVEDA_TENANT_PROMOTE_ROUNDS``). ``shed_only`` is cleared because
+    warn sheds are EXPECTED here — the whole point is who absorbs them.
+    The ``tenants`` bench row self-certifies this SLO in-run; without
+    tenant fairness (``KAKVEDA_TENANT_FAIR=0``) the flooder's backlog
+    sheds victims indiscriminately and the gates fail."""
+    rng = random.Random(seed)
+    b = round(duration_s * flood_start_frac, 3)
+    phase = lambda t: "baseline" if t < b else "flood"  # noqa: E731
+    events = [
+        _warn_event(t, f"app-v{rng.randrange(max(1, victims))}", i, phase(t))
+        for i, t in enumerate(_arrivals(rng, duration_s, lambda _t: victim_rps))
+    ]
+    for j, t in enumerate(_arrivals(rng, duration_s,
+                                    lambda t: flood_rps if t >= b else 0.0)):
+        events.append(_warn_event(t, flood_app, j, "flood"))
+    events.sort(key=lambda e: e["t"])
+    return Scenario(
+        name="noisy_neighbor", seed=seed, duration_s=duration_s,
+        events=events,
+        slo=SLO(
+            shed_only=(),  # warn sheds are the scenario's point
+            zero_hung=True,
+            zero_lost=("warn",),
+            flood_app=flood_app,
+            max_victim_shed_rate=max_victim_shed_rate,
+            victim_p95_x_baseline=victim_p95_x,
+            max_tenant_starvation_s=starvation_s,
+            min_flood_shed_share=min_flood_shed_share,
+        ),
+        notes={"flood_start_s": b},
+    )
+
+
 def aging(seed: int = 0, *, duration_s: float = 8.0,
           virtual_days: float = 28.0, cohorts: int = 4,
           warn_rps: float = 20.0, ingest_rps: float = 4.0,
@@ -568,6 +626,7 @@ SCENARIOS: Dict[str, Callable[..., Scenario]] = {
     "storm": storm,
     "rebalance_storm": rebalance_storm,
     "flash_crowd": flash_crowd,
+    "noisy_neighbor": noisy_neighbor,
     "aging": aging,
 }
 

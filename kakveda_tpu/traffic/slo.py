@@ -29,6 +29,16 @@ Gates (the storm bench row self-certifies all of them in-run):
   reversals (executed scale-up↔scale-down flips) during the run. Fed
   from ``ReplayResult.notes["scale_flaps"]`` (the replayer stuffs it
   when an autoscaler handle was threaded through ``run_scenario``).
+* Tenant-isolation arm (``flood_app`` names the flooder; every gate is
+  vacuous unless records carry app tags AND ``flood_app`` is set):
+  ``max_victim_shed_rate`` — shed-rate ceiling over NON-flooder traffic;
+  ``victim_p95_x_baseline`` — victim ok-p95 during the ``flood`` phase
+  bounded at a multiple of the same victims' ``baseline``-phase p95;
+  ``max_tenant_starvation_s`` — longest per-victim span of consecutive
+  non-ok dispatches (the weighted-fair promotion bound, observed
+  end-to-end); ``min_flood_shed_share`` — FLOOR on the fraction of all
+  sheds that landed on the flooder (quotas must aim the pain at whoever
+  owns the backlog).
 
 Table of which scenario declares what: docs/robustness.md § traffic
 harness.
@@ -73,6 +83,15 @@ class SLO:
     # (a 2→4→2 flash-crowd cycle is exactly one flap). Reads
     # result.notes["scale_flaps"]; vacuous when no autoscaler ran.
     max_scale_flaps: Optional[int] = None
+    # Tenant-isolation arm (noisy-neighbor drill). flood_app names the
+    # flooder tenant; victims = records with a non-empty app tag that
+    # isn't the flooder. All four gates are vacuous without app-tagged
+    # records and a flood_app.
+    flood_app: str = ""
+    max_victim_shed_rate: Optional[float] = None
+    victim_p95_x_baseline: Optional[float] = None
+    max_tenant_starvation_s: Optional[float] = None
+    min_flood_shed_share: Optional[float] = None
 
 
 @dataclass
@@ -127,6 +146,88 @@ def _exemplar_traces(records, status=None, klass=None, n=3) -> List[str]:
             and (klass is None or r.get("klass") == klass)]
     cand.sort(key=lambda r: r.get("latency_ms", 0.0), reverse=True)
     return [r["trace"] for r in cand[:n]]
+
+
+def _tenant_gates(slo: SLO, result, add) -> None:
+    """The noisy-neighbor isolation gates. Victims are app-tagged records
+    that aren't the flooder's; every gate passes vacuously when the replay
+    carried no tenant accounting (untagged captures, flood_app unset)."""
+    wants = (slo.max_victim_shed_rate is not None
+             or slo.victim_p95_x_baseline is not None
+             or slo.max_tenant_starvation_s is not None
+             or slo.min_flood_shed_share is not None)
+    if not wants:
+        return
+    records = getattr(result, "records", None) or []
+    tagged = [r for r in records if r.get("app")]
+    if not slo.flood_app or not tagged:
+        reason = "no tenant accounting"
+        if slo.max_victim_shed_rate is not None:
+            add("max_victim_shed_rate", True, reason, slo.max_victim_shed_rate)
+        if slo.victim_p95_x_baseline is not None:
+            add("victim_p95_x_baseline", True, reason, slo.victim_p95_x_baseline)
+        if slo.max_tenant_starvation_s is not None:
+            add("max_tenant_starvation_s", True, reason,
+                slo.max_tenant_starvation_s)
+        if slo.min_flood_shed_share is not None:
+            add("min_flood_shed_share", True, reason, slo.min_flood_shed_share)
+        return
+    victims = [r for r in tagged if r["app"] != slo.flood_app]
+
+    if slo.max_victim_shed_rate is not None:
+        shed = sum(1 for r in victims if r["status"] == "shed")
+        rate = round(shed / len(victims), 4) if victims else 0.0
+        add("max_victim_shed_rate", rate <= slo.max_victim_shed_rate,
+            rate, slo.max_victim_shed_rate)
+
+    if slo.victim_p95_x_baseline is not None:
+        base = [r["latency_ms"] for r in victims
+                if r["status"] == "ok" and r.get("phase") == "baseline"]
+        flood = [r["latency_ms"] for r in victims
+                 if r["status"] == "ok" and r.get("phase") == "flood"]
+        if base and flood:
+            ratio = round(percentile(flood, 95)
+                          / max(percentile(base, 95), 1e-9), 3)
+            add("victim_p95_x_baseline", ratio <= slo.victim_p95_x_baseline,
+                ratio, slo.victim_p95_x_baseline)
+        else:
+            add("victim_p95_x_baseline", True, "no baseline/flood phases",
+                slo.victim_p95_x_baseline)
+
+    if slo.max_tenant_starvation_s is not None:
+        # Longest per-victim stretch of consecutive non-ok dispatches,
+        # measured in scheduled time ("t"): how long one tenant went
+        # without a single success. The observed counterpart of the
+        # KAKVEDA_TENANT_PROMOTE_ROUNDS starvation bound.
+        worst = 0.0
+        by_app: Dict[str, List[dict]] = {}
+        for r in victims:
+            by_app.setdefault(r["app"], []).append(r)
+        for rows in by_app.values():
+            rows.sort(key=lambda r: r.get("t", 0.0))
+            run_start = None
+            for r in rows:
+                if r["status"] == "ok":
+                    run_start = None
+                    continue
+                t = float(r.get("t", 0.0))
+                if run_start is None:
+                    run_start = t
+                worst = max(worst, t - run_start)
+        add("max_tenant_starvation_s", worst <= slo.max_tenant_starvation_s,
+            round(worst, 3), slo.max_tenant_starvation_s)
+
+    if slo.min_flood_shed_share is not None:
+        sheds = [r for r in tagged if r["status"] == "shed"]
+        if sheds:
+            share = round(sum(1 for r in sheds
+                              if r["app"] == slo.flood_app) / len(sheds), 4)
+            add("min_flood_shed_share", share >= slo.min_flood_shed_share,
+                share, slo.min_flood_shed_share)
+        else:
+            # Nothing shed at all: isolation is trivially intact.
+            add("min_flood_shed_share", True, "no sheds",
+                slo.min_flood_shed_share)
 
 
 def evaluate(slo: SLO, result) -> SLOReport:
@@ -211,6 +312,8 @@ def evaluate(slo: SLO, result) -> SLOReport:
             add("max_scale_flaps", True, "no autoscaler accounting",
                 slo.max_scale_flaps)
 
+    _tenant_gates(slo, result, add)
+
     if slo.recovery_s is not None:
         rec = result.ladder_recovery_s
         if rec is None:
@@ -231,7 +334,8 @@ def evaluate(slo: SLO, result) -> SLOReport:
             klass = g.gate[len("max_shed_rate["):-1]
             g.exemplars = _exemplar_traces(
                 records, status="shed", klass=klass) or None
-        elif g.gate == "shed_only":
+        elif g.gate in ("shed_only", "max_victim_shed_rate",
+                        "min_flood_shed_share"):
             g.exemplars = _exemplar_traces(records, status="shed") or None
 
     return SLOReport(slo=slo.name, ok=all(g.ok for g in gates), gates=gates)
